@@ -1,0 +1,40 @@
+"""TPU-native Faster R-CNN framework.
+
+A brand-new JAX/XLA implementation with the capabilities of the PyTorch
+reference `juniorliu95/replication_faster_rcnn` (see SURVEY.md): VOC data
+pipeline, ResNet backbones with the conv1..layer3 / layer4 split, 9-anchor
+RPN, fixed-shape device-side proposal NMS, ROIPool/ROIAlign heads,
+device-side anchor/proposal target assignment, one jit-compiled train step,
+data-parallel over a TPU mesh via psum gradient allreduce.
+
+Design principle (SURVEY.md §7): every stage that is dynamic-shape and
+host-side in the reference (proposal NMS, target assignment) is fixed-shape,
+masked, vmapped and device-side here, so the whole train step is one XLA
+program.
+"""
+
+from replication_faster_rcnn_tpu.config import (
+    AnchorConfig,
+    DataConfig,
+    FasterRCNNConfig,
+    ModelConfig,
+    ProposalConfig,
+    ROITargetConfig,
+    RPNTargetConfig,
+    TrainConfig,
+    get_config,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AnchorConfig",
+    "DataConfig",
+    "FasterRCNNConfig",
+    "ModelConfig",
+    "ProposalConfig",
+    "ROITargetConfig",
+    "RPNTargetConfig",
+    "TrainConfig",
+    "get_config",
+]

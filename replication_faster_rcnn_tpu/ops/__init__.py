@@ -1,0 +1,1 @@
+from replication_faster_rcnn_tpu.ops import anchors, boxes, nms, roi_ops  # noqa: F401

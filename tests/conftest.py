@@ -1,0 +1,17 @@
+"""Test env: force JAX onto CPU with 8 virtual devices so multi-chip
+sharding paths are exercised without TPU hardware (SURVEY.md §4e).
+
+Must run before jax initializes its backends, hence module scope here.
+"""
+
+import os
+
+# The image's sitecustomize registers the experimental `axon` TPU plugin and
+# pins JAX_PLATFORMS=axon; tests must run CPU-only, so override both.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

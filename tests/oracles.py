@@ -1,0 +1,136 @@
+"""Independent numpy oracles for golden tests.
+
+These reimplement the *documented semantics* of the ops under test (greedy
+NMS, Caffe-style ROIPool, torchvision ROIAlign, the reference's box coder /
+IoU / target assignment) in straightforward numpy, written separately from
+the jnp implementations so a shared bug can't hide. The reference repo's
+numpy code is the behavioral spec (file:line cites in each function) but the
+code here is written fresh — torchvision is not installed in this image, so
+these stand in for the torchvision CPU goldens SURVEY.md §4b suggests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+# ---------------------------------------------------------------- box coder
+
+def encode_np(anchors: np.ndarray, boxes: np.ndarray) -> np.ndarray:
+    """Spec: reference bbox2reg (utils/utils.py:75-100)."""
+    ah = anchors[:, 2] - anchors[:, 0]
+    aw = anchors[:, 3] - anchors[:, 1]
+    ar = (anchors[:, 0] + anchors[:, 2]) / 2
+    ac = (anchors[:, 1] + anchors[:, 3]) / 2
+    bh = boxes[:, 2] - boxes[:, 0]
+    bw = boxes[:, 3] - boxes[:, 1]
+    br = (boxes[:, 0] + boxes[:, 2]) / 2
+    bc = (boxes[:, 1] + boxes[:, 3]) / 2
+    return np.stack(
+        [(br - ar) / ah, (bc - ac) / aw, np.log(bh / ah), np.log(bw / aw)], axis=1
+    )
+
+
+def decode_np(anchors: np.ndarray, deltas: np.ndarray) -> np.ndarray:
+    """Spec: reference reg2bbox (utils/utils.py:47-73)."""
+    ah = anchors[:, 2] - anchors[:, 0]
+    aw = anchors[:, 3] - anchors[:, 1]
+    ar = (anchors[:, 0] + anchors[:, 2]) / 2
+    ac = (anchors[:, 1] + anchors[:, 3]) / 2
+    r = deltas[:, 0] * ah + ar
+    c = deltas[:, 1] * aw + ac
+    h = np.exp(deltas[:, 2]) * ah
+    w = np.exp(deltas[:, 3]) * aw
+    return np.stack([r - h / 2, c - w / 2, r + h / 2, c + w / 2], axis=1)
+
+
+def iou_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Spec: reference bbox_iou (utils/utils.py:102-119), safe division."""
+    tl = np.maximum(a[:, None, :2], b[None, :, :2])
+    br = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(br - tl, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = area_a[:, None] + area_b[None, :] - inter
+    out = np.zeros_like(inter, dtype=np.float64)
+    np.divide(inter, union, out=out, where=union > 0)
+    return out
+
+
+# ------------------------------------------------------------------- NMS
+
+def nms_np(boxes: np.ndarray, scores: np.ndarray, thresh: float) -> list[int]:
+    """Sort-by-score greedy suppression (torchvision.ops.nms semantics:
+    drop IoU strictly greater than thresh)."""
+    order = np.argsort(-scores, kind="stable")
+    keep: list[int] = []
+    alive = np.ones(len(boxes), bool)
+    for i in order:
+        if not alive[i]:
+            continue
+        keep.append(int(i))
+        ious = iou_np(boxes[i : i + 1], boxes)[0]
+        alive &= ~(ious > thresh)
+    return keep
+
+
+# ----------------------------------------------------------------- ROI ops
+
+def roi_pool_np(feat: np.ndarray, rois: np.ndarray, out: int = 7) -> np.ndarray:
+    """Legacy Caffe/torchvision ROIPool: round coords, +1 extents,
+    floor/ceil bin edges, empty bin -> 0. feat [H, W, C] -> [R, out, out, C]."""
+    h, w, c = feat.shape
+    res = np.zeros((len(rois), out, out, c), feat.dtype)
+    for ri, roi in enumerate(rois):
+        r1, c1, r2, c2 = np.round(roi)
+        rh = max(r2 - r1 + 1, 1)
+        rw = max(c2 - c1 + 1, 1)
+        bh, bw = rh / out, rw / out
+        for i in range(out):
+            hs = int(np.clip(np.floor(i * bh) + r1, 0, h))
+            he = int(np.clip(np.ceil((i + 1) * bh) + r1, 0, h))
+            for j in range(out):
+                ws = int(np.clip(np.floor(j * bw) + c1, 0, w))
+                we = int(np.clip(np.ceil((j + 1) * bw) + c1, 0, w))
+                if he > hs and we > ws:
+                    res[ri, i, j] = feat[hs:he, ws:we].max(axis=(0, 1))
+    return res
+
+
+def roi_align_np(
+    feat: np.ndarray, rois: np.ndarray, out: int = 7, sampling: int = 2
+) -> np.ndarray:
+    """torchvision ROIAlign (aligned=False): fixed sampling^2 bilinear
+    samples per bin, averaged; out-of-range samples contribute 0."""
+    h, w, c = feat.shape
+
+    def bilin(r, cc):
+        if r < -1 or r > h or cc < -1 or cc > w:
+            return np.zeros(c, feat.dtype)
+        r = min(max(r, 0.0), h - 1.0)
+        cc = min(max(cc, 0.0), w - 1.0)
+        r0, c0 = int(np.floor(r)), int(np.floor(cc))
+        r1, c1 = min(r0 + 1, h - 1), min(c0 + 1, w - 1)
+        ar, ac = r - r0, cc - c0
+        return (
+            feat[r0, c0] * (1 - ar) * (1 - ac)
+            + feat[r0, c1] * (1 - ar) * ac
+            + feat[r1, c0] * ar * (1 - ac)
+            + feat[r1, c1] * ar * ac
+        )
+
+    res = np.zeros((len(rois), out, out, c), feat.dtype)
+    for ri, (r1, c1, r2, c2) in enumerate(rois):
+        bh = max(r2 - r1, 1.0) / out  # aligned=False: 1px minimum extent
+        bw = max(c2 - c1, 1.0) / out
+        for i in range(out):
+            for j in range(out):
+                acc = np.zeros(c, feat.dtype)
+                for si in range(sampling):
+                    for sj in range(sampling):
+                        rr = r1 + (i + (si + 0.5) / sampling) * bh
+                        cc2 = c1 + (j + (sj + 0.5) / sampling) * bw
+                        acc += bilin(rr, cc2)
+                res[ri, i, j] = acc / (sampling * sampling)
+    return res

@@ -1,0 +1,60 @@
+import numpy as np
+
+from replication_faster_rcnn_tpu.config import AnchorConfig, get_config
+from replication_faster_rcnn_tpu.ops import anchors as A
+
+
+def test_anchor_base_sizes():
+    """Reference utils/anchors.py:5-31: h = base*scale*sqrt(ratio),
+    w = base*scale/sqrt(ratio); ratio-major ordering."""
+    base = A.anchor_base()
+    assert base.shape == (9, 4)
+    h = base[:, 2] - base[:, 0]
+    w = base[:, 3] - base[:, 1]
+    # areas: (base*scale)^2 regardless of ratio
+    areas = h * w
+    np.testing.assert_allclose(
+        areas, np.array([128, 256, 512, 128, 256, 512, 128, 256, 512]) ** 2.0, rtol=1e-5
+    )
+    # ratio = h/w
+    np.testing.assert_allclose(h / w, [0.5] * 3 + [1.0] * 3 + [2.0] * 3, rtol=1e-5)
+    # centered at origin
+    np.testing.assert_allclose(base[:, :2] + base[:, 2:], 0, atol=1e-4)
+
+
+def test_grid_ordering_and_centers():
+    base = A.anchor_base()
+    g = A.grid_anchors(base, 16, 3, 5)
+    assert g.shape == (3 * 5 * 9, 4)
+    # anchor k at cell (r, c) lives at flat (r*W + c)*K + k
+    r, c, k = 1, 3, 6
+    a = g[(r * 5 + c) * 9 + k]
+    np.testing.assert_allclose(
+        a, base[k] + np.array([r * 16, c * 16, r * 16, c * 16]), rtol=1e-6
+    )
+    # correct row/col pairing: row coord moves with r, col coord with c
+    a_next_row = g[((r + 1) * 5 + c) * 9 + k]
+    np.testing.assert_allclose(a_next_row - a, [16, 0, 16, 0], atol=1e-5)
+    a_next_col = g[(r * 5 + (c + 1)) * 9 + k]
+    np.testing.assert_allclose(a_next_col - a, [0, 16, 0, 16], atol=1e-5)
+
+
+def test_full_config_anchor_count():
+    cfg = get_config("voc_resnet18")
+    assert cfg.feature_size() == (38, 38)  # 600 -> 38 at stride 16
+    anchors = A.make_anchors(cfg.anchors, cfg.feature_size())
+    assert anchors.shape == (38 * 38 * 9, 4)
+    assert cfg.num_anchors() == 38 * 38 * 9
+
+
+def test_feature_size_other_shapes():
+    cfg = get_config("voc_resnet18")
+    assert cfg.feature_size((128, 128)) == (8, 8)
+    assert cfg.feature_size((601, 333)) == (38, 21)
+
+
+def test_single_scale_config():
+    base = A.anchor_base(scales=(8.0,))
+    assert base.shape == (3, 4)
+    cfg = AnchorConfig(scales=(8.0,))
+    assert cfg.num_base_anchors == 3

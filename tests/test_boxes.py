@@ -1,0 +1,82 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from replication_faster_rcnn_tpu.ops import boxes as B
+from tests import oracles
+
+
+def rand_boxes(n, rng, size=100.0):
+    p1 = rng.uniform(0, size, (n, 2))
+    wh = rng.uniform(1, size / 2, (n, 2))
+    return np.concatenate([p1, p1 + wh], axis=1).astype(np.float32)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def test_encode_matches_oracle(rng):
+    a = rand_boxes(40, rng)
+    b = rand_boxes(40, rng)
+    got = np.asarray(B.encode(jnp.array(a), jnp.array(b)))
+    want = oracles.encode_np(a, b)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_decode_matches_oracle(rng):
+    a = rand_boxes(40, rng)
+    d = rng.normal(0, 0.3, (40, 4)).astype(np.float32)
+    got = np.asarray(B.decode(jnp.array(a), jnp.array(d)))
+    want = oracles.decode_np(a, d)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_encode_decode_roundtrip(rng):
+    a = rand_boxes(64, rng)
+    b = rand_boxes(64, rng)
+    back = B.decode(jnp.array(a), B.encode(jnp.array(a), jnp.array(b)))
+    np.testing.assert_allclose(np.asarray(back), b, rtol=1e-4, atol=1e-3)
+
+
+def test_iou_matches_oracle(rng):
+    a = rand_boxes(30, rng)
+    b = rand_boxes(50, rng)
+    got = np.asarray(B.iou(jnp.array(a), jnp.array(b)))
+    want = oracles.iou_np(a, b)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_iou_reference_seed_case():
+    """The reference's own IoU demo (utils/utils.py:280-284) as a seed case."""
+    anchors = np.array(
+        [[1, 2, 3, 4], [3, 5, 7, 8], [-1, -1, -1, -1], [3, 2, 4, 5]], np.float32
+    )
+    bboxes = np.array([[2, 3, 4, 5], [5, 6, 7, 8], [1, 2, 3, 4]], np.float32)
+    got = np.asarray(B.iou(jnp.array(anchors), jnp.array(bboxes)))
+    want = oracles.iou_np(anchors, bboxes)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # identical box -> IoU 1; disjoint -> 0
+    assert got[0, 2] == pytest.approx(1.0)
+    assert got[0, 1] == 0.0
+
+
+def test_iou_degenerate_box_is_zero_not_nan():
+    z = jnp.zeros((1, 4))
+    out = B.iou(z, z)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_clip(rng):
+    b = rng.uniform(-50, 150, (20, 4)).astype(np.float32)
+    got = np.asarray(B.clip(jnp.array(b), 100.0, 80.0))
+    assert (got[:, 0::2] >= 0).all() and (got[:, 0::2] <= 100).all()
+    assert (got[:, 1::2] >= 0).all() and (got[:, 1::2] <= 80).all()
+
+
+def test_decode_batched_shapes(rng):
+    a = np.stack([rand_boxes(10, rng)] * 3)  # [3, 10, 4]
+    d = rng.normal(0, 0.2, (3, 10, 4)).astype(np.float32)
+    out = B.decode(jnp.array(a), jnp.array(d))
+    assert out.shape == (3, 10, 4)

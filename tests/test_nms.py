@@ -1,0 +1,77 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from replication_faster_rcnn_tpu.ops.nms import batched_nms_fixed, nms_fixed
+from tests import oracles
+from tests.test_boxes import rand_boxes
+
+
+def _check_against_oracle(boxes, scores, thresh, max_out):
+    idx, valid = nms_fixed(jnp.array(boxes), jnp.array(scores), thresh, max_out)
+    idx = np.asarray(idx)
+    valid = np.asarray(valid)
+    keep = oracles.nms_np(boxes, scores, thresh)[:max_out]
+    got = list(idx[valid])
+    assert got == keep, f"nms mismatch: got {got} want {keep}"
+    # validity mask is a prefix
+    if not valid.all():
+        first_invalid = int(np.argmin(valid))
+        assert not valid[first_invalid:].any()
+
+
+def test_nms_random_cases():
+    rng = np.random.default_rng(1)
+    for n in [1, 7, 50, 300]:
+        boxes = rand_boxes(n, rng, size=60.0)
+        scores = rng.uniform(0, 1, n).astype(np.float32)
+        for thresh in [0.3, 0.5, 0.7]:
+            _check_against_oracle(boxes, scores, thresh, max_out=40)
+
+
+def test_nms_identical_boxes_keep_one():
+    boxes = np.tile(np.array([[0, 0, 10, 10]], np.float32), (5, 1))
+    scores = np.array([0.1, 0.9, 0.5, 0.3, 0.2], np.float32)
+    idx, valid = nms_fixed(jnp.array(boxes), jnp.array(scores), 0.5, 5)
+    assert int(np.asarray(valid).sum()) == 1
+    assert int(np.asarray(idx)[0]) == 1
+
+
+def test_nms_mask_excludes_candidates():
+    rng = np.random.default_rng(2)
+    boxes = rand_boxes(20, rng)
+    scores = rng.uniform(0, 1, 20).astype(np.float32)
+    mask = np.zeros(20, bool)
+    mask[:5] = True
+    idx, valid = nms_fixed(jnp.array(boxes), jnp.array(scores), 0.5, 10, mask=jnp.array(mask))
+    kept = np.asarray(idx)[np.asarray(valid)]
+    assert set(kept).issubset(set(range(5)))
+
+
+def test_nms_fewer_boxes_than_slots():
+    boxes = np.array([[0, 0, 10, 10], [50, 50, 60, 60]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    idx, valid = nms_fixed(jnp.array(boxes), jnp.array(scores), 0.5, 8)
+    assert int(np.asarray(valid).sum()) == 2
+
+
+def test_nms_vmaps():
+    rng = np.random.default_rng(3)
+    boxes = np.stack([rand_boxes(30, rng) for _ in range(4)])
+    scores = rng.uniform(0, 1, (4, 30)).astype(np.float32)
+    f = jax.vmap(lambda b, s: nms_fixed(b, s, 0.5, 10))
+    idx, valid = f(jnp.array(boxes), jnp.array(scores))
+    assert idx.shape == (4, 10)
+    for i in range(4):
+        keep = oracles.nms_np(boxes[i], scores[i], 0.5)[:10]
+        assert list(np.asarray(idx[i])[np.asarray(valid[i])]) == keep
+
+
+def test_batched_nms_classes_do_not_suppress_each_other():
+    boxes = np.tile(np.array([[0, 0, 10, 10]], np.float32), (4, 1))
+    scores = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
+    cls = np.array([0, 1, 2, 3], np.int32)
+    idx, valid = batched_nms_fixed(
+        jnp.array(boxes), jnp.array(scores), jnp.array(cls), 0.5, 4
+    )
+    assert int(np.asarray(valid).sum()) == 4

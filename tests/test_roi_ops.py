@@ -1,0 +1,89 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from replication_faster_rcnn_tpu.ops import roi_ops
+from tests import oracles
+
+
+def _rand_feat_rois(rng, h=12, w=14, c=5, n=6):
+    feat = rng.normal(0, 1, (h, w, c)).astype(np.float32)
+    p1 = rng.uniform(0, h - 2, (n, 1)), rng.uniform(0, w - 2, (n, 1))
+    hh = rng.uniform(1, h / 2, (n, 1))
+    ww = rng.uniform(1, w / 2, (n, 1))
+    rois = np.concatenate([p1[0], p1[1], p1[0] + hh, p1[1] + ww], axis=1).astype(
+        np.float32
+    )
+    return feat, rois
+
+
+def test_roi_pool_matches_oracle():
+    rng = np.random.default_rng(0)
+    feat, rois = _rand_feat_rois(rng)
+    got = np.asarray(roi_ops.roi_pool(jnp.array(feat), jnp.array(rois), 7))
+    want = oracles.roi_pool_np(feat, rois, 7)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_roi_pool_tiny_roi_nonempty():
+    feat = np.arange(36, dtype=np.float32).reshape(6, 6, 1)
+    rois = np.array([[2.2, 2.2, 2.4, 2.4]], np.float32)  # sub-pixel roi
+    out = np.asarray(roi_ops.roi_pool(jnp.array(feat), jnp.array(rois), 7))
+    want = oracles.roi_pool_np(feat, rois, 7)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+    assert np.isfinite(out).all()
+
+
+def test_roi_align_matches_oracle():
+    rng = np.random.default_rng(1)
+    feat, rois = _rand_feat_rois(rng)
+    got = np.asarray(
+        roi_ops.roi_align(jnp.array(feat), jnp.array(rois), 7, sampling_ratio=2)
+    )
+    want = oracles.roi_align_np(feat, rois, 7, sampling=2)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_align_border_rois():
+    """Rois touching / slightly crossing the border must stay finite and
+    match the oracle's zero-outside rule."""
+    rng = np.random.default_rng(2)
+    feat = rng.normal(0, 1, (8, 8, 3)).astype(np.float32)
+    rois = np.array(
+        [[-0.5, -0.5, 4.0, 4.0], [0, 0, 8, 8], [6.5, 6.5, 9.0, 9.0]], np.float32
+    )
+    got = np.asarray(roi_ops.roi_align(jnp.array(feat), jnp.array(rois), 4))
+    want = oracles.roi_align_np(feat, rois, 4)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_ops_vmap_over_batch():
+    rng = np.random.default_rng(3)
+    feats = np.stack([_rand_feat_rois(rng)[0] for _ in range(3)])
+    rois = np.stack([_rand_feat_rois(rng)[1] for _ in range(3)])
+    out = jax.vmap(lambda f, r: roi_ops.roi_align(f, r, 7))(
+        jnp.array(feats), jnp.array(rois)
+    )
+    assert out.shape == (3, rois.shape[1], 7, 7, feats.shape[-1])
+
+
+def test_roi_align_grad_flows_to_features():
+    rng = np.random.default_rng(4)
+    feat, rois = _rand_feat_rois(rng, h=8, w=8, c=2, n=3)
+
+    def loss(f):
+        return roi_ops.roi_align(f, jnp.array(rois), 4).sum()
+
+    g = jax.grad(loss)(jnp.array(feat))
+    assert np.abs(np.asarray(g)).sum() > 0
+
+
+def test_roi_pool_grad_flows_to_features():
+    rng = np.random.default_rng(5)
+    feat, rois = _rand_feat_rois(rng, h=8, w=8, c=2, n=3)
+
+    def loss(f):
+        return roi_ops.roi_pool(f, jnp.array(rois), 4).sum()
+
+    g = jax.grad(loss)(jnp.array(feat))
+    assert np.abs(np.asarray(g)).sum() > 0
