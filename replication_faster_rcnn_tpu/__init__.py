@@ -16,7 +16,9 @@ program.
 from replication_faster_rcnn_tpu.config import (
     AnchorConfig,
     DataConfig,
+    EvalConfig,
     FasterRCNNConfig,
+    MeshConfig,
     ModelConfig,
     ProposalConfig,
     ROITargetConfig,
@@ -30,7 +32,9 @@ __version__ = "0.1.0"
 __all__ = [
     "AnchorConfig",
     "DataConfig",
+    "EvalConfig",
     "FasterRCNNConfig",
+    "MeshConfig",
     "ModelConfig",
     "ProposalConfig",
     "ROITargetConfig",

@@ -1,0 +1,153 @@
+"""torch -> flax checkpoint conversion for pretrained ResNet backbones.
+
+The reference warm-starts from a torchvision resnet18 ``.pth`` loaded off
+disk (`nets/resnet_torch.py:392-409`, path conventions `readme.md:10-12`)
+and splits it into `features` (conv1..layer3) and `classifier` (layer4 +
+avgpool). This module performs the equivalent one-time conversion into the
+flax parameter trees of :class:`~replication_faster_rcnn_tpu.models.resnet`
+— a pure name/layout mapping, since the flax modules mirror the torch
+module names.
+
+Layout rules:
+  * torch conv weight [O, I, kh, kw]  -> flax kernel [kh, kw, I, O]
+  * torch linear weight [O, I]        -> flax kernel [I, O]
+  * torch BN {weight, bias} -> params {scale, bias};
+    {running_mean, running_var} -> batch_stats {mean, var}
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Tuple
+
+import numpy as np
+
+# torch is an optional dependency (CPU-only in this image); import lazily so
+# the framework itself never requires it.
+
+
+def _to_np(t: Any) -> np.ndarray:
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t)
+
+
+def _conv_kernel(w: Any) -> np.ndarray:
+    return _to_np(w).transpose(2, 3, 1, 0)  # OIHW -> HWIO
+
+
+def _split_state_dict(
+    state: Mapping[str, Any]
+) -> Tuple[Dict[str, Any], Dict[str, Any], Dict[str, Any]]:
+    """Split a torchvision resnet state_dict into (trunk, tail, fc) groups,
+    mirroring the reference's features/classifier split
+    (`nets/resnet_torch.py:399-403`)."""
+    trunk: Dict[str, Any] = {}
+    tail: Dict[str, Any] = {}
+    fc: Dict[str, Any] = {}
+    for k, v in state.items():
+        if k.startswith("fc."):
+            fc[k] = v
+        elif k.startswith("layer4."):
+            tail[k] = v
+        else:
+            trunk[k] = v
+    return trunk, tail, fc
+
+
+def _bn_entries(prefix: str, state: Mapping[str, Any]):
+    params = {
+        "scale": _to_np(state[f"{prefix}.weight"]),
+        "bias": _to_np(state[f"{prefix}.bias"]),
+    }
+    stats = {
+        "mean": _to_np(state[f"{prefix}.running_mean"]),
+        "var": _to_np(state[f"{prefix}.running_var"]),
+    }
+    return params, stats
+
+
+def _convert_block(prefix: str, state: Mapping[str, Any]):
+    """One BasicBlock/Bottleneck: torch `layerL.B.*` -> flax `layerL.B` dict."""
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    i = 1
+    while f"{prefix}.conv{i}.weight" in state:
+        params[f"conv{i}"] = {"kernel": _conv_kernel(state[f"{prefix}.conv{i}.weight"])}
+        p, s = _bn_entries(f"{prefix}.bn{i}", state)
+        params[f"bn{i}"] = p
+        stats[f"bn{i}"] = s
+        i += 1
+    if f"{prefix}.downsample.0.weight" in state:
+        params["downsample_conv"] = {
+            "kernel": _conv_kernel(state[f"{prefix}.downsample.0.weight"])
+        }
+        p, s = _bn_entries(f"{prefix}.downsample.1", state)
+        params["downsample_bn"] = p
+        stats["downsample_bn"] = s
+    return params, stats
+
+
+def _convert_stage(name: str, state: Mapping[str, Any]):
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    b = 0
+    while f"{name}.{b}.conv1.weight" in state:
+        p, s = _convert_block(f"{name}.{b}", state)
+        params[f"{name}.{b}"] = p
+        stats[f"{name}.{b}"] = s
+        b += 1
+    return params, stats
+
+
+def convert_trunk(state: Mapping[str, Any]):
+    """torch state_dict (full resnet) -> (params, batch_stats) for ResNetTrunk."""
+    params: Dict[str, Any] = {"conv1": {"kernel": _conv_kernel(state["conv1.weight"])}}
+    stats: Dict[str, Any] = {}
+    p, s = _bn_entries("bn1", state)
+    params["bn1"] = p
+    stats["bn1"] = s
+    for layer in ("layer1", "layer2", "layer3"):
+        p, s = _convert_stage(layer, state)
+        params.update(p)
+        stats.update(s)
+    return params, stats
+
+
+def convert_tail(state: Mapping[str, Any]):
+    """torch state_dict (full resnet) -> (params, batch_stats) for ResNetTail."""
+    return _convert_stage("layer4", state)
+
+
+def load_pretrained_backbone(pth_path: str):
+    """Load a torchvision resnet ``.pth`` and return flax-ready trees:
+    ((trunk_params, trunk_stats), (tail_params, tail_stats)).
+
+    Equivalent of reference ``resnet_backbone`` (`nets/resnet_torch.py:392-409`).
+    """
+    import torch
+
+    state = torch.load(pth_path, map_location="cpu", weights_only=True)
+    if hasattr(state, "state_dict"):
+        state = state.state_dict()
+    return convert_trunk(state), convert_tail(state)
+
+
+def graft_into_variables(variables: Dict[str, Any], pth_path: str) -> Dict[str, Any]:
+    """Return a copy of FasterRCNN `variables` with the pretrained trunk/tail
+    weights grafted in (trunk under `trunk`, tail under `head.tail`)."""
+    import jax
+
+    (tp, ts), (lp, ls) = load_pretrained_backbone(pth_path)
+    variables = jax.tree_util.tree_map(lambda x: x, variables)  # shallow copy
+    params = dict(variables["params"])
+    stats = dict(variables.get("batch_stats", {}))
+    params["trunk"] = {**params.get("trunk", {}), **tp}
+    stats["trunk"] = {**stats.get("trunk", {}), **ts}
+    head = dict(params.get("head", {}))
+    head["tail"] = {**head.get("tail", {}), **lp}
+    params["head"] = head
+    hstats = dict(stats.get("head", {}))
+    hstats["tail"] = {**hstats.get("tail", {}), **ls}
+    stats["head"] = hstats
+    out = dict(variables)
+    out["params"] = params
+    out["batch_stats"] = stats
+    return out

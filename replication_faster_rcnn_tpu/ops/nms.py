@@ -54,6 +54,10 @@ def nms_fixed(
     """
     n = boxes.shape[0]
     live_scores = scores.astype(jnp.float32)
+    # Non-finite scores (NaN from a diverging score head) must never win
+    # argmax — a NaN selection would mark the slot invalid without
+    # suppressing anything, stalling every remaining iteration.
+    live_scores = jnp.where(jnp.isfinite(live_scores), live_scores, _NEG)
     if mask is not None:
         live_scores = jnp.where(mask, live_scores, _NEG)
 

@@ -1,17 +1,33 @@
 """Test env: force JAX onto CPU with 8 virtual devices so multi-chip
 sharding paths are exercised without TPU hardware (SURVEY.md §4e).
 
-Must run before jax initializes its backends, hence module scope here.
+The image's sitecustomize registers the experimental `axon` TPU plugin at
+interpreter startup (before conftest runs), importing jax and pinning
+JAX_PLATFORMS=axon — so env-var changes here are too late. Instead we use
+`jax.config`, which takes effect at first backend initialization (no test
+has touched a backend yet at collection time). XLA_FLAGS is read by the CPU
+client at creation, so setting it here still works.
+
+Matmul/conv precision defaults to `highest` for tests: the framework's
+bfloat16 compute is a deliberate TPU choice, but golden tests compare
+against float64/float32 numpy+torch oracles.
 """
 
 import os
 
-# The image's sitecustomize registers the experimental `axon` TPU plugin and
-# pins JAX_PLATFORMS=axon; tests must run CPU-only, so override both.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def pytest_sessionstart(session):
+    devs = jax.devices()
+    assert devs[0].platform == "cpu", f"tests must run on CPU, got {devs[0]}"
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"

@@ -134,3 +134,43 @@ def roi_align_np(
                         acc += bilin(rr, cc2)
                 res[ri, i, j] = acc / (sampling * sampling)
     return res
+
+
+# ------------------------------------------------------ target assignment
+
+def anchor_labels_np(
+    anchors: np.ndarray,
+    gt: np.ndarray,
+    pos_thresh: float = 0.7,
+    neg_thresh: float = 0.3,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic part of reference AnchorTargetCreator._create_label
+    (utils/utils.py:176-189, before random subsampling): returns
+    (labels in {-1,0,1}, argmax gt per anchor with force-match redirects)."""
+    if len(gt) == 0:
+        # Reference: empty gt -> max_ious all 0 -> every anchor labeled
+        # negative (utils/utils.py:163,181-183).
+        return np.zeros(len(anchors), np.int32), np.zeros(len(anchors), np.int32)
+    ious = iou_np(anchors, gt)
+    argmax = ious.argmax(axis=1)
+    max_iou = ious.max(axis=1)
+    gt_best = ious.argmax(axis=0)
+    for g, a in enumerate(gt_best):
+        argmax[a] = g
+    labels = np.full(len(anchors), -1, np.int32)
+    labels[max_iou < neg_thresh] = 0
+    labels[max_iou >= pos_thresh] = 1
+    labels[gt_best] = 1
+    return labels, argmax
+
+
+def proposal_match_np(
+    rois: np.ndarray, gt: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic part of reference ProposalTargetCreator (utils/
+    utils.py:234-246): best gt index and IoU per candidate roi; empty gt
+    matches nothing (reference guards len(bbox)==0)."""
+    if len(gt) == 0:
+        return np.zeros(len(rois), np.int32), np.zeros(len(rois))
+    ious = iou_np(rois, gt)
+    return ious.argmax(axis=1), ious.max(axis=1)

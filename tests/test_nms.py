@@ -75,3 +75,19 @@ def test_batched_nms_classes_do_not_suppress_each_other():
         jnp.array(boxes), jnp.array(scores), jnp.array(cls), 0.5, 4
     )
     assert int(np.asarray(valid).sum()) == 4
+
+
+def test_nan_scores_do_not_stall_selection():
+    """A NaN score (diverging score head) must be skipped, not selected."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from replication_faster_rcnn_tpu.ops.nms import nms_fixed
+
+    boxes = jnp.asarray(
+        [[0, 0, 10, 10], [100, 100, 110, 110], [200, 200, 210, 210.0]]
+    )
+    scores = jnp.asarray([0.9, jnp.nan, 0.8])
+    idx, valid = nms_fixed(boxes, scores, 0.5, 3)
+    kept = np.asarray(idx)[np.asarray(valid)]
+    np.testing.assert_array_equal(sorted(kept), [0, 2])
