@@ -1,0 +1,10 @@
+"""Driver entry: prints ONE JSON line with the benchmark result.
+
+Thin shim over :mod:`replication_faster_rcnn_tpu.benchmark` (kept at the
+repo root per the driver contract).
+"""
+
+from replication_faster_rcnn_tpu.benchmark import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,223 @@
+"""Measure the PyTorch reference's training throughput on CPU.
+
+BASELINE.md: the reference publishes no numbers, so the 6x target needs a
+measured torch-CPU baseline. This script runs the REFERENCE code itself
+(`/root/reference/train.py` ``trainer.train_step``) on synthetic tensors and
+records images/sec into ``benchmarks/baseline_measured.json``.
+
+The image lacks three of the reference's dependencies, so minimal stand-ins
+are injected via sys.modules BEFORE importing it:
+  * ``skimage`` / ``xmltodict`` — only touched by the data loader, which
+    this benchmark bypasses (synthetic tensors); stubs are import-only.
+  * ``torchvision`` — the reference's NMS/RoIPool kernels (SURVEY.md §2.3).
+    Stand-ins are vectorized torch implementations below; they are a small
+    fraction of step time (the ResNet conv stacks via genuine ATen
+    dominate), so the baseline remains representative. matmul threads: the
+    host has 1 core, matching BASELINE.json's "single-host CPU" framing.
+
+Run: python benchmarks/reference_baseline.py [--steps N] [--batch B]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = "/root/reference"
+
+
+def _install_stubs() -> None:
+    import numpy as np
+    import torch
+
+    # ---- skimage (data-loader only; never exercised here)
+    skimage = types.ModuleType("skimage")
+    skimage_io = types.ModuleType("skimage.io")
+    skimage_io.imread = lambda p: np.zeros((600, 600, 3), np.uint8)
+    skimage_tr = types.ModuleType("skimage.transform")
+    skimage_tr.resize = lambda img, size: np.zeros((*size, 3), np.float64)
+    skimage.io = skimage_io
+    skimage.transform = skimage_tr
+    sys.modules["skimage"] = skimage
+    sys.modules["skimage.io"] = skimage_io
+    sys.modules["skimage.transform"] = skimage_tr
+
+    # ---- xmltodict (data-loader only)
+    xmltodict = types.ModuleType("xmltodict")
+    xmltodict.parse = lambda s: {}
+    sys.modules["xmltodict"] = xmltodict
+
+    # ---- torchvision: nms / roi_pool / transforms used by the reference
+    def nms(boxes: "torch.Tensor", scores: "torch.Tensor", iou_threshold: float):
+        order = scores.argsort(descending=True)
+        boxes = boxes.detach()
+        keep = []
+        suppressed = torch.zeros(len(boxes), dtype=torch.bool)
+        areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        for i in order:
+            if suppressed[i]:
+                continue
+            keep.append(i.item())
+            tl = torch.maximum(boxes[i, :2], boxes[:, :2])
+            br = torch.minimum(boxes[i, 2:], boxes[:, 2:])
+            wh = (br - tl).clamp(min=0)
+            inter = wh[:, 0] * wh[:, 1]
+            iou = inter / (areas[i] + areas - inter).clamp(min=1e-9)
+            suppressed |= iou > iou_threshold
+        return torch.as_tensor(keep, dtype=torch.long)
+
+    def roi_pool(features, boxes, output_size, spatial_scale=1.0):
+        if isinstance(output_size, int):
+            output_size = (output_size, output_size)
+        oh, ow = output_size
+        n, c, h, w = features.shape
+        out = features.new_zeros(len(boxes), c, oh, ow)
+        for k, row in enumerate(boxes):
+            b = int(row[0].item())
+            r1, c1, r2, c2 = [v.item() * spatial_scale for v in row[1:]]
+            r1, c1, r2, c2 = round(r1), round(c1), round(r2), round(c2)
+            rh = max(r2 - r1 + 1, 1)
+            rw = max(c2 - c1 + 1, 1)
+            for i in range(oh):
+                hs = int(max(min(np_floor(i * rh / oh) + r1, h), 0))
+                he = int(max(min(np_ceil((i + 1) * rh / oh) + r1, h), 0))
+                for j in range(ow):
+                    ws = int(max(min(np_floor(j * rw / ow) + c1, w), 0))
+                    we = int(max(min(np_ceil((j + 1) * rw / ow) + c1, w), 0))
+                    if he > hs and we > ws:
+                        out[k, :, i, j] = (
+                            features[b, :, hs:he, ws:we].amax(dim=(1, 2))
+                        )
+        return out
+
+    def np_floor(x):
+        import math
+
+        return math.floor(x)
+
+    def np_ceil(x):
+        import math
+
+        return math.ceil(x)
+
+    torchvision = types.ModuleType("torchvision")
+    tv_ops = types.ModuleType("torchvision.ops")
+    tv_ops.nms = nms
+    tv_ops.roi_pool = roi_pool
+    tv_ops_roi = types.ModuleType("torchvision.ops.roi_pool")
+    tv_ops_roi.roi_pool = roi_pool
+    tv_transforms = types.ModuleType("torchvision.transforms")
+
+    class _Compose:
+        def __init__(self, fs):
+            self.fs = fs
+
+        def __call__(self, x):
+            for f in self.fs:
+                x = f(x)
+            return x
+
+    tv_transforms.Compose = _Compose
+    tv_transforms.ToTensor = lambda: (lambda x: torch.as_tensor(x))
+    tv_transforms.Normalize = lambda m, s: (lambda x: x)
+    tv_datasets = types.ModuleType("torchvision.datasets")
+    torchvision.ops = tv_ops
+    torchvision.transforms = tv_transforms
+    torchvision.datasets = tv_datasets
+    sys.modules["torchvision"] = torchvision
+    sys.modules["torchvision.ops"] = tv_ops
+    sys.modules["torchvision.ops.roi_pool"] = tv_ops_roi
+    sys.modules["torchvision.transforms"] = tv_transforms
+    sys.modules["torchvision.datasets"] = tv_datasets
+
+
+def _prepare_workdir(tmp: str) -> None:
+    """The reference hard-codes relative paths: a resnet18 .pth at
+    data/resnet/ (`nets/resnet_torch.py:394`) and a VOC imageset list
+    (`utils/data_loader.py:48`). Create both so its constructors run."""
+    import torch
+
+    os.makedirs(os.path.join(tmp, "data/resnet"), exist_ok=True)
+    vocdir = os.path.join(tmp, "data/voc/VOCdevkit/VOC2012")
+    os.makedirs(os.path.join(vocdir, "ImageSets/Main"), exist_ok=True)
+    with open(os.path.join(vocdir, "ImageSets/Main/aeroplane_train.txt"), "w") as f:
+        f.write("fake_000001 1\n")
+
+    sys.path.insert(0, REFERENCE)
+    from nets.resnet_torch import resnet18  # reference's own definition
+
+    model = resnet18()
+    torch.save(model.state_dict(), os.path.join(tmp, "data/resnet/resnet18-5c106cde.pth"))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--warmup", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=2)  # reference default
+    args = ap.parse_args()
+
+    import numpy as np
+    import torch
+
+    _install_stubs()
+    tmp = "/tmp/reference_baseline_workdir"
+    os.makedirs(tmp, exist_ok=True)
+    cwd = os.getcwd()
+    os.chdir(tmp)
+    try:
+        _prepare_workdir(tmp)
+        from train import trainer  # the reference trainer
+
+        t = trainer()
+        t.optimizer = torch.optim.Adam(t.model.net.parameters(), lr=1e-4)
+
+        rng = np.random.RandomState(0)
+        image = torch.as_tensor(
+            rng.uniform(-1, 1, (args.batch, 3, 600, 600)).astype(np.float32)
+        )
+        # boxes/labels as numpy: the reference's target creators call numpy
+        # reductions on them (utils/utils.py:116), which numpy 2.x no longer
+        # accepts on torch tensors; its own loader yields numpy-backed
+        # tensors under the older numpy it was written against.
+        boxes = np.full((args.batch, 32, 4), -1.0, np.float32)
+        labels = np.full((args.batch, 32), -1.0, np.float32)
+        for i in range(args.batch):
+            boxes[i, 0] = [100.0, 120.0, 300.0, 350.0]
+            labels[i, 0] = 7
+            boxes[i, 1] = [50.0, 400.0, 200.0, 550.0]
+            labels[i, 1] = 12
+
+        for _ in range(args.warmup):
+            t.train_step(image, boxes, labels)
+        t0 = time.time()
+        for _ in range(args.steps):
+            t.train_step(image, boxes, labels)
+        dt = time.time() - t0
+        ips = args.steps * args.batch / dt
+    finally:
+        os.chdir(cwd)
+
+    out = {
+        "torch_cpu_images_per_sec": round(ips, 4),
+        "sec_per_step": round(dt / args.steps, 3),
+        "batch_size": args.batch,
+        "steps": args.steps,
+        "torch_version": torch.__version__,
+        "cpu_count": os.cpu_count(),
+        "notes": "reference train_step on synthetic 600x600 tensors; "
+        "torchvision nms/roi_pool stand-ins (not installed in image)",
+    }
+    path = os.path.join(REPO, "benchmarks", "baseline_measured.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

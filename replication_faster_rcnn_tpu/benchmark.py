@@ -1,0 +1,102 @@
+"""Benchmark: jitted train-step throughput on the flagship config.
+
+(Importable package module; the repo-root ``bench.py`` is a thin shim so
+the driver can run it from the checkout root.)
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: VOC-shaped (600x600, synthetic tensors — dataset-independent)
+training images/sec on the available device(s). ``vs_baseline`` is the
+ratio against the measured single-host PyTorch-CPU reference throughput
+(BASELINE.md: the reference publishes no numbers, so the baseline is
+measured by benchmarks/reference_baseline.py and cached in
+benchmarks/baseline_measured.json; target is >= 6x).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+
+
+def main() -> None:
+    from replication_faster_rcnn_tpu.config import (
+        DataConfig,
+        MeshConfig,
+        TrainConfig,
+        get_config,
+    )
+    from replication_faster_rcnn_tpu.data import SyntheticDataset
+    from replication_faster_rcnn_tpu.data.loader import collate
+    from replication_faster_rcnn_tpu.parallel import make_mesh, replicate_tree, shard_batch
+    from replication_faster_rcnn_tpu.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    n_dev = len(jax.devices())
+    batch_size = 8 * n_dev
+    cfg = get_config("voc_resnet18").replace(
+        data=DataConfig(dataset="synthetic", image_size=(600, 600), max_boxes=32),
+        train=TrainConfig(batch_size=batch_size),
+        mesh=MeshConfig(num_data=n_dev),
+    )
+    mesh = make_mesh(cfg.mesh)
+    tx, _ = make_optimizer(cfg, steps_per_epoch=100)
+    model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
+    state = replicate_tree(state, mesh)
+
+    ds = SyntheticDataset(cfg.data, length=batch_size)
+    batch = collate([ds[i] for i in range(batch_size)])
+    device_batch = shard_batch(batch, mesh, cfg.mesh)
+
+    step = jax.jit(make_train_step(model, cfg, tx), donate_argnums=(0,))
+
+    # warmup (compile) + 2 steps to stabilize. NOTE: sync via device_get of
+    # the scalar metrics, not block_until_ready — the remote-TPU plugin in
+    # this image returns from block_until_ready before execution finishes,
+    # which inflated throughput ~100x; a host transfer genuinely waits.
+    for _ in range(3):
+        state, metrics = step(state, device_batch)
+    jax.device_get(metrics)
+
+    n_steps = 10
+    t0 = time.time()
+    for _ in range(n_steps):
+        state, metrics = step(state, device_batch)
+    jax.device_get(metrics)  # forces the whole dependency chain
+    dt = time.time() - t0
+    images_per_sec = n_steps * batch_size / dt
+
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks",
+        "baseline_measured.json",
+    )
+    vs_baseline = float("nan")
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+        ref = baseline.get("torch_cpu_images_per_sec")
+        if ref:
+            vs_baseline = images_per_sec / ref
+
+    print(
+        json.dumps(
+            {
+                "metric": "train_images_per_sec_600x600",
+                "value": round(images_per_sec, 3),
+                "unit": "images/sec",
+                "vs_baseline": round(vs_baseline, 3) if np.isfinite(vs_baseline) else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

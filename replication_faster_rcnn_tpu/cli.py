@@ -1,0 +1,167 @@
+"""Command-line interface — the config/flag layer the reference never had
+(SURVEY.md §5: hyperparameters live in scattered constants and a flagless
+``__main__`` at reference `train.py:153-161`; BASELINE.json requires a
+``--device=tpu`` path).
+
+Subcommands:
+  train  — run the jitted SPMD trainer
+  eval   — run inference + VOC mAP over a dataset split
+  bench  — train-step throughput (same measurement as bench.py)
+
+``--config`` selects one of the five BASELINE presets (config.CONFIGS);
+individual flags override preset fields.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from typing import List, Optional
+
+
+def _apply_device(device: str) -> None:
+    """--device=tpu|cpu: pick the JAX backend before any computation."""
+    import jax
+
+    if device != "auto":
+        jax.config.update("jax_platforms", device)
+
+
+def _build_config(args):
+    from replication_faster_rcnn_tpu.config import get_config
+
+    cfg = get_config(args.config)
+    if args.dataset:
+        cfg = cfg.replace(data=dataclasses.replace(cfg.data, dataset=args.dataset))
+    if args.data_root:
+        cfg = cfg.replace(data=dataclasses.replace(cfg.data, root_dir=args.data_root))
+    if args.image_size:
+        cfg = cfg.replace(
+            data=dataclasses.replace(
+                cfg.data, image_size=(args.image_size, args.image_size)
+            )
+        )
+    train_kw = {}
+    if args.lr is not None:
+        train_kw["lr"] = args.lr
+    if args.batch_size is not None:
+        train_kw["batch_size"] = args.batch_size
+    if args.epochs is not None:
+        train_kw["n_epoch"] = args.epochs
+    if args.seed is not None:
+        train_kw["seed"] = args.seed
+    if train_kw:
+        cfg = cfg.replace(train=dataclasses.replace(cfg.train, **train_kw))
+    if args.backbone or args.roi_op:
+        model_kw = {}
+        if args.backbone:
+            model_kw["backbone"] = args.backbone
+        if args.roi_op:
+            model_kw["roi_op"] = args.roi_op
+        cfg = cfg.replace(model=dataclasses.replace(cfg.model, **model_kw))
+    return cfg
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", default="voc_resnet18",
+                   help="preset name (see replication_faster_rcnn_tpu.config.CONFIGS)")
+    p.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"],
+                   help="JAX backend (BASELINE --device flag)")
+    p.add_argument("--dataset", default=None, choices=[None, "voc", "coco", "synthetic"])
+    p.add_argument("--data-root", default=None)
+    p.add_argument("--image-size", type=int, default=None)
+    p.add_argument("--backbone", default=None,
+                   choices=[None, "resnet18", "resnet34", "resnet50", "resnet101"])
+    p.add_argument("--roi-op", default=None, choices=[None, "align", "pool"])
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+
+
+def cmd_train(args) -> int:
+    _apply_device(args.device)
+    from replication_faster_rcnn_tpu.train import Trainer
+
+    cfg = _build_config(args)
+    trainer = Trainer(cfg, workdir=args.workdir)
+    if args.pretrained_backbone:
+        trainer.load_pretrained_backbone(args.pretrained_backbone)
+    if args.steps:
+        # bounded-step mode (smoke/CI): iterate the loader cyclically
+        import itertools
+
+        it = itertools.cycle(iter(trainer.loader))
+        for i in range(args.steps):
+            metrics = trainer.train_one_batch(next(it))
+            if i % max(1, args.log_every) == 0:
+                import jax
+
+                vals = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                trainer.logger.log(i, vals)
+        return 0
+    trainer.train(resume=args.resume, log_every=args.log_every)
+    trainer.save()
+    return 0
+
+
+def cmd_eval(args) -> int:
+    _apply_device(args.device)
+    from replication_faster_rcnn_tpu.data import make_dataset
+    from replication_faster_rcnn_tpu.eval import Evaluator
+    from replication_faster_rcnn_tpu.train.trainer import load_eval_variables
+
+    cfg = _build_config(args)
+    model, variables = load_eval_variables(cfg, args.workdir, args.checkpoint_step)
+    dataset = make_dataset(cfg.data, args.split)
+    ev = Evaluator(cfg, model)
+    result = ev.evaluate(
+        variables, dataset, batch_size=cfg.train.batch_size,
+        max_images=args.max_images,
+    )
+    print(f"mAP@{cfg.eval.iou_thresh}: {result['mAP']:.4f}")
+    return 0
+
+
+def cmd_bench(args) -> int:
+    _apply_device(args.device)
+    from replication_faster_rcnn_tpu.benchmark import main as bench_main
+
+    bench_main()
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="replication_faster_rcnn_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_train = sub.add_parser("train", help="train a detector")
+    _add_common(p_train)
+    p_train.add_argument("--workdir", default="checkpoints")
+    p_train.add_argument("--steps", type=int, default=0,
+                         help="run exactly N steps instead of the epoch loop")
+    p_train.add_argument("--log-every", type=int, default=10)
+    p_train.add_argument("--resume", action="store_true")
+    p_train.add_argument("--pretrained-backbone", default=None,
+                         help="torch resnet .pth to graft (reference readme.md:10-12)")
+    p_train.set_defaults(fn=cmd_train)
+
+    p_eval = sub.add_parser("eval", help="evaluate mAP")
+    _add_common(p_eval)
+    p_eval.add_argument("--workdir", default="checkpoints")
+    p_eval.add_argument("--split", default="val")
+    p_eval.add_argument("--checkpoint-step", type=int, default=None)
+    p_eval.add_argument("--max-images", type=int, default=None)
+    p_eval.set_defaults(fn=cmd_eval)
+
+    p_bench = sub.add_parser("bench", help="train-step throughput")
+    _add_common(p_bench)
+    p_bench.set_defaults(fn=cmd_bench)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,110 @@
+"""VOC-style mAP@IoU evaluator (numpy, host-side).
+
+The reference contains no evaluation at all (SURVEY.md §2.1 #15), so this
+implements the standard Pascal VOC protocol from its published definition:
+per-class ranked matching of detections to gt at an IoU threshold, each gt
+matched at most once, precision/recall curve summarized either by the
+VOC2007 11-point interpolation or the VOC2010+ area-under-curve (both
+offered; EvalConfig.use_07_metric selects).
+
+Inputs are plain numpy accumulated across the eval set — metric math stays
+off-device (tiny, branchy, once per epoch).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+def _ap_from_pr(recall: np.ndarray, precision: np.ndarray, use_07: bool) -> float:
+    if use_07:
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            p = precision[recall >= t].max() if (recall >= t).any() else 0.0
+            ap += p / 11.0
+        return float(ap)
+    # VOC2010+: area under the monotonically-decreasing precision envelope
+    mrec = np.concatenate([[0.0], recall, [1.0]])
+    mpre = np.concatenate([[0.0], precision, [0.0]])
+    for i in range(len(mpre) - 2, -1, -1):
+        mpre[i] = max(mpre[i], mpre[i + 1])
+    changed = np.where(mrec[1:] != mrec[:-1])[0]
+    return float(np.sum((mrec[changed + 1] - mrec[changed]) * mpre[changed + 1]))
+
+
+def _iou_one_to_many(box: np.ndarray, boxes: np.ndarray) -> np.ndarray:
+    tl = np.maximum(box[:2], boxes[:, :2])
+    br = np.minimum(box[2:], boxes[:, 2:])
+    wh = np.clip(br - tl, 0, None)
+    inter = wh[:, 0] * wh[:, 1]
+    a = (box[2] - box[0]) * (box[3] - box[1])
+    b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    union = a + b - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-9), 0.0)
+
+
+def voc_ap(
+    detections: Sequence[Dict[str, np.ndarray]],
+    ground_truths: Sequence[Dict[str, np.ndarray]],
+    num_classes: int,
+    iou_thresh: float = 0.5,
+    use_07_metric: bool = False,
+) -> Dict[str, float]:
+    """Compute per-class AP and mAP.
+
+    Args (parallel lists over images):
+      detections[i]: {'boxes' [D,4], 'scores' [D], 'classes' [D]} (valid only)
+      ground_truths[i]: {'boxes' [G,4], 'labels' [G]} (valid only)
+
+    Returns {'mAP': float, 'ap_per_class': [num_classes] (nan where no gt)}.
+    """
+    aps = np.full(num_classes, np.nan)
+    for cls in range(1, num_classes):
+        # gather this class's gt per image
+        gt_boxes: List[np.ndarray] = []
+        n_gt = 0
+        for g in ground_truths:
+            sel = g["labels"] == cls
+            gt_boxes.append(g["boxes"][sel])
+            n_gt += int(sel.sum())
+
+        # flatten detections of this class across images
+        recs = []
+        for img_i, d in enumerate(detections):
+            sel = d["classes"] == cls
+            for b, s in zip(d["boxes"][sel], d["scores"][sel]):
+                recs.append((float(s), img_i, b))
+        if n_gt == 0:
+            continue  # AP undefined with no gt of this class
+        if not recs:
+            aps[cls] = 0.0
+            continue
+
+        recs.sort(key=lambda t: -t[0])
+        matched = [np.zeros(len(b), bool) for b in gt_boxes]
+        tp = np.zeros(len(recs))
+        fp = np.zeros(len(recs))
+        for k, (_, img_i, box) in enumerate(recs):
+            gts = gt_boxes[img_i]
+            if len(gts) == 0:
+                fp[k] = 1
+                continue
+            ious = _iou_one_to_many(box, gts)
+            j = int(ious.argmax())
+            if ious[j] >= iou_thresh and not matched[img_i][j]:
+                tp[k] = 1
+                matched[img_i][j] = True
+            else:
+                fp[k] = 1
+
+        ctp = np.cumsum(tp)
+        cfp = np.cumsum(fp)
+        recall = ctp / n_gt
+        precision = ctp / np.maximum(ctp + cfp, 1e-9)
+        aps[cls] = _ap_from_pr(recall, precision, use_07_metric)
+
+    valid = ~np.isnan(aps[1:])
+    m_ap = float(aps[1:][valid].mean()) if valid.any() else 0.0
+    return {"mAP": m_ap, "ap_per_class": aps}

@@ -1,0 +1,8 @@
+from replication_faster_rcnn_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    initialize_distributed,
+    make_mesh,
+    replicate_tree,
+    replicated,
+    shard_batch,
+)

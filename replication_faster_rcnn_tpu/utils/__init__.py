@@ -1,0 +1,1 @@
+from replication_faster_rcnn_tpu.utils.logging import MetricLogger  # noqa: F401

@@ -1,0 +1,174 @@
+"""Data pipeline tests: fixed-shape invariants, determinism, VOC parsing
+against a miniature on-disk devkit."""
+
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+from replication_faster_rcnn_tpu.config import DataConfig
+from replication_faster_rcnn_tpu.data import (
+    DataLoader,
+    SyntheticDataset,
+    VOCDataset,
+    collate,
+    make_dataset,
+)
+
+
+def _cfg(**kw):
+    defaults = dict(dataset="synthetic", image_size=(64, 64), max_boxes=8)
+    defaults.update(kw)
+    return DataConfig(**defaults)
+
+
+class TestSynthetic:
+    def test_shapes_and_mask(self):
+        ds = SyntheticDataset(_cfg(), length=4)
+        s = ds[0]
+        assert s["image"].shape == (64, 64, 3)
+        assert s["boxes"].shape == (8, 4)
+        assert s["labels"].shape == (8,)
+        assert (s["mask"] == (s["labels"] >= 0)).all()
+        assert s["mask"].any()
+        # padded entries are -1 like the reference (`data_loader.py:88-89`)
+        assert (s["boxes"][~s["mask"]] == -1).all()
+
+    def test_deterministic(self):
+        ds = SyntheticDataset(_cfg(), length=4)
+        a, b = ds[2], SyntheticDataset(_cfg(), length=4)[2]
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["boxes"], b["boxes"])
+
+    def test_objects_are_painted(self):
+        ds = SyntheticDataset(_cfg(), length=2)
+        s = ds[0]
+        r1, c1, r2, c2 = s["boxes"][s["mask"]][0].astype(int)
+        inside = s["image"][r1:r2, c1:c2].mean()
+        outside = s["image"].mean()
+        assert inside > outside  # bright object on dark background
+
+
+class TestLoader:
+    def test_batching_and_drop_last(self):
+        ds = SyntheticDataset(_cfg(), length=10)
+        loader = DataLoader(ds, batch_size=4, shuffle=False, prefetch=0)
+        batches = list(loader)
+        assert len(batches) == 2  # 10 // 4, tail dropped
+        assert batches[0]["image"].shape == (4, 64, 64, 3)
+
+    def test_shuffle_deterministic_per_epoch(self):
+        ds = SyntheticDataset(_cfg(), length=16)
+        l1 = DataLoader(ds, batch_size=4, shuffle=True, seed=1)
+        l2 = DataLoader(ds, batch_size=4, shuffle=True, seed=1)
+        l1.set_epoch(3)
+        l2.set_epoch(3)
+        np.testing.assert_array_equal(l1._order(), l2._order())
+        l2.set_epoch(4)
+        assert not np.array_equal(l1._order(), l2._order())
+
+    def test_prefetch_yields_all(self):
+        ds = SyntheticDataset(_cfg(), length=12)
+        loader = DataLoader(ds, batch_size=4, shuffle=False, prefetch=2)
+        assert sum(1 for _ in loader) == 3
+
+    def test_worker_error_propagates(self):
+        class Bad:
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                raise RuntimeError("boom")
+
+        loader = DataLoader(Bad(), batch_size=2, shuffle=False, prefetch=1)
+        with pytest.raises(RuntimeError, match="boom"):
+            list(loader)
+
+    def test_collate(self):
+        ds = SyntheticDataset(_cfg(), length=3)
+        b = collate([ds[0], ds[1]])
+        assert b["labels"].shape == (2, 8)
+
+
+def _write_voc(root, ids, difficult_flags=None):
+    from PIL import Image
+
+    os.makedirs(os.path.join(root, "ImageSets/Main"), exist_ok=True)
+    os.makedirs(os.path.join(root, "JPEGImages"), exist_ok=True)
+    os.makedirs(os.path.join(root, "Annotations"), exist_ok=True)
+    with open(os.path.join(root, "ImageSets/Main/train.txt"), "w") as f:
+        f.write("\n".join(ids) + "\n")
+    for n, img_id in enumerate(ids):
+        Image.new("RGB", (100, 50), (128, 64, 32)).save(
+            os.path.join(root, "JPEGImages", img_id + ".jpg")
+        )  # 100 wide, 50 tall
+        ann = ET.Element("annotation")
+        for obj_i in range(2):
+            obj = ET.SubElement(ann, "object")
+            ET.SubElement(obj, "name").text = "dog" if obj_i == 0 else "cat"
+            diff = "0"
+            if difficult_flags and difficult_flags[n] and obj_i == 1:
+                diff = "1"
+            ET.SubElement(obj, "difficult").text = diff
+            bnd = ET.SubElement(obj, "bndbox")
+            ET.SubElement(bnd, "xmin").text = "10"
+            ET.SubElement(bnd, "ymin").text = "5"
+            ET.SubElement(bnd, "xmax").text = "60"
+            ET.SubElement(bnd, "ymax").text = "45"
+        ET.ElementTree(ann).write(os.path.join(root, "Annotations", img_id + ".xml"))
+
+
+class TestVOC:
+    def test_parse_scale_and_pad(self, tmp_path):
+        root = str(tmp_path / "VOC2007")
+        _write_voc(root, ["img0", "img1"])
+        cfg = _cfg(dataset="voc", root_dir=root)
+        ds = VOCDataset(cfg, "train")
+        assert len(ds) == 2
+        s = ds[0]
+        assert s["image"].shape == (64, 64, 3)
+        assert int(s["mask"].sum()) == 2
+        # original 100x50 (w x h) -> 64x64: row scale 64/50, col scale 64/100
+        # xml (xmin=10, ymin=5, xmax=60, ymax=45) -> rows [5,45], cols [10,60]
+        np.testing.assert_allclose(
+            s["boxes"][0],
+            np.round([5 * 64 / 50, 10 * 64 / 100, 45 * 64 / 50, 60 * 64 / 100]),
+        )
+        from replication_faster_rcnn_tpu.config import VOC_CLASSES
+
+        assert s["labels"][0] == VOC_CLASSES.index("dog")
+        assert (s["labels"][2:] == -1).all()
+
+    def test_difficult_masked_unless_enabled(self, tmp_path):
+        root = str(tmp_path / "VOC2007")
+        _write_voc(root, ["img0"], difficult_flags=[True])
+        ds = VOCDataset(_cfg(dataset="voc", root_dir=root), "train")
+        s = ds[0]
+        assert int(s["mask"].sum()) == 1  # difficult cat masked out
+        ds2 = VOCDataset(
+            _cfg(dataset="voc", root_dir=root, use_difficult=True), "train"
+        )
+        assert int(ds2[0]["mask"].sum()) == 2
+
+    def test_unknown_class_raises(self, tmp_path):
+        root = str(tmp_path / "VOC2007")
+        _write_voc(root, ["img0"])
+        xml = os.path.join(root, "Annotations", "img0.xml")
+        tree = ET.parse(xml)
+        tree.getroot().find("object").find("name").text = "dragon"
+        tree.write(xml)
+        ds = VOCDataset(_cfg(dataset="voc", root_dir=root), "train")
+        with pytest.raises(ValueError, match="dragon"):
+            ds[0]
+
+
+def test_make_dataset_dispatch(tmp_path):
+    assert isinstance(
+        make_dataset(_cfg(), "train"), SyntheticDataset
+    )
+    root = str(tmp_path / "VOC2007")
+    _write_voc(root, ["img0"])
+    assert isinstance(
+        make_dataset(_cfg(dataset="voc", root_dir=root), "train"), VOCDataset
+    )
