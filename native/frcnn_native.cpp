@@ -1,0 +1,115 @@
+// Native host-side kernels for the data pipeline and CPU post-processing.
+//
+// The reference delegates its host-side heavy lifting to compiled kernels it
+// doesn't ship (skimage's C resize at utils/data_loader.py:72, torchvision's
+// C++ NMS at nets/rpn.py:75 — see SURVEY.md §2.3). This library is the
+// framework's own native layer for the host side of the pipeline: the TPU
+// compute path is XLA, but image preprocessing happens on CPU per sample and
+// in Python it costs more than the device step at high chip counts.
+//
+// Exposed via a C ABI, loaded with ctypes (no pybind11 in this image).
+// Build: make -C native  (g++ -O3 -shared -fPIC)
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+
+extern "C" {
+
+// Bilinear resize (align_corners=False sampling: src = (dst + .5) * scale
+// - .5) of an HWC uint8 RGB image, fused with /255 + mean/std normalization
+// into float32 output. Matches data/native_ops.py:_resize_normalize_numpy
+// exactly; parity-tested in tests/test_native.py.
+void resize_bilinear_normalize(const uint8_t* src, int sh, int sw,
+                               float* dst, int dh, int dw,
+                               const float* mean, const float* stddev) {
+  const float rscale = static_cast<float>(sh) / dh;
+  const float cscale = static_cast<float>(sw) / dw;
+  const float inv_std[3] = {1.0f / stddev[0], 1.0f / stddev[1], 1.0f / stddev[2]};
+  for (int r = 0; r < dh; ++r) {
+    float sr = (r + 0.5f) * rscale - 0.5f;
+    sr = std::min(std::max(sr, 0.0f), static_cast<float>(sh - 1));
+    const int r0 = static_cast<int>(sr);
+    const int r1 = std::min(r0 + 1, sh - 1);
+    const float fr = sr - r0;
+    for (int c = 0; c < dw; ++c) {
+      float sc = (c + 0.5f) * cscale - 0.5f;
+      sc = std::min(std::max(sc, 0.0f), static_cast<float>(sw - 1));
+      const int c0 = static_cast<int>(sc);
+      const int c1 = std::min(c0 + 1, sw - 1);
+      const float fc = sc - c0;
+      const float w00 = (1 - fr) * (1 - fc), w01 = (1 - fr) * fc;
+      const float w10 = fr * (1 - fc), w11 = fr * fc;
+      const uint8_t* p00 = src + (static_cast<int64_t>(r0) * sw + c0) * 3;
+      const uint8_t* p01 = src + (static_cast<int64_t>(r0) * sw + c1) * 3;
+      const uint8_t* p10 = src + (static_cast<int64_t>(r1) * sw + c0) * 3;
+      const uint8_t* p11 = src + (static_cast<int64_t>(r1) * sw + c1) * 3;
+      float* out = dst + (static_cast<int64_t>(r) * dw + c) * 3;
+      for (int ch = 0; ch < 3; ++ch) {
+        const float v =
+            p00[ch] * w00 + p01[ch] * w01 + p10[ch] * w10 + p11[ch] * w11;
+        out[ch] = (v * (1.0f / 255.0f) - mean[ch]) * inv_std[ch];
+      }
+    }
+  }
+}
+
+// Greedy score-sorted NMS (torchvision semantics: suppress IoU strictly
+// greater than thresh). boxes are [n, 4] row-major [r1, c1, r2, c2].
+// Writes up to max_keep kept indices; returns how many were written.
+int nms_greedy(const float* boxes, const float* scores, int n, float thresh,
+               int* keep, int max_keep) {
+  if (n <= 0 || max_keep <= 0) return 0;
+  // argsort by descending score (stable for deterministic ties)
+  int* order = new int[n];
+  for (int i = 0; i < n; ++i) order[i] = i;
+  std::stable_sort(order, order + n,
+                   [&](int a, int b) { return scores[a] > scores[b]; });
+  float* areas = new float[n];
+  for (int i = 0; i < n; ++i) {
+    const float* b = boxes + static_cast<int64_t>(i) * 4;
+    areas[i] = (b[2] - b[0]) * (b[3] - b[1]);
+  }
+  bool* dead = new bool[n]();
+  int n_keep = 0;
+  for (int oi = 0; oi < n && n_keep < max_keep; ++oi) {
+    const int i = order[oi];
+    if (dead[i]) continue;
+    keep[n_keep++] = i;
+    const float* bi = boxes + static_cast<int64_t>(i) * 4;
+    for (int oj = oi + 1; oj < n; ++oj) {
+      const int j = order[oj];
+      if (dead[j]) continue;
+      const float* bj = boxes + static_cast<int64_t>(j) * 4;
+      const float tr = std::max(bi[0], bj[0]);
+      const float tc = std::max(bi[1], bj[1]);
+      const float br = std::min(bi[2], bj[2]);
+      const float bc = std::min(bi[3], bj[3]);
+      const float ih = br - tr, iw = bc - tc;
+      if (ih <= 0 || iw <= 0) continue;
+      const float inter = ih * iw;
+      const float uni = areas[i] + areas[j] - inter;
+      if (uni > 0 && inter / uni > thresh) dead[j] = true;
+    }
+  }
+  delete[] order;
+  delete[] areas;
+  delete[] dead;
+  return n_keep;
+}
+
+// Scale + round padded [m, 4] boxes from original to resized image coords,
+// preserving -1 padding (reference utils/data_loader.py:66-69,115).
+void scale_boxes(float* boxes, const int32_t* labels, int m, float row_scale,
+                 float col_scale) {
+  for (int i = 0; i < m; ++i) {
+    if (labels[i] < 0) continue;
+    float* b = boxes + static_cast<int64_t>(i) * 4;
+    b[0] = std::round(b[0] * row_scale);
+    b[1] = std::round(b[1] * col_scale);
+    b[2] = std::round(b[2] * row_scale);
+    b[3] = std::round(b[3] * col_scale);
+  }
+}
+
+}  // extern "C"

@@ -23,7 +23,11 @@ import jax
 import numpy as np
 
 
-def main() -> None:
+def main(config=None) -> None:
+    """Measure the jitted train step of ``config`` (default: the flagship
+    voc_resnet18 at 600x600, batch 8/device) on all available devices."""
+    import dataclasses
+
     from replication_faster_rcnn_tpu.config import (
         DataConfig,
         MeshConfig,
@@ -40,12 +44,26 @@ def main() -> None:
     )
 
     n_dev = len(jax.devices())
-    batch_size = 8 * n_dev
-    cfg = get_config("voc_resnet18").replace(
-        data=DataConfig(dataset="synthetic", image_size=(600, 600), max_boxes=32),
-        train=TrainConfig(batch_size=batch_size),
-        mesh=MeshConfig(num_data=n_dev),
-    )
+    if config is None:
+        batch_size = 8 * n_dev
+        cfg = get_config("voc_resnet18").replace(
+            data=DataConfig(dataset="synthetic", image_size=(600, 600), max_boxes=32),
+            train=TrainConfig(batch_size=batch_size),
+            mesh=MeshConfig(num_data=n_dev),
+        )
+    else:
+        # honor the caller's model/image/batch choices; force synthetic data
+        # (dataset-independent measurement) and a mesh over every device
+        cfg = config.replace(
+            data=dataclasses.replace(config.data, dataset="synthetic"),
+            mesh=MeshConfig(num_data=n_dev),
+        )
+        batch_size = cfg.train.batch_size
+        if batch_size % n_dev != 0:
+            batch_size = max(1, batch_size // n_dev) * n_dev
+            cfg = cfg.replace(
+                train=dataclasses.replace(cfg.train, batch_size=batch_size)
+            )
     mesh = make_mesh(cfg.mesh)
     tx, _ = make_optimizer(cfg, steps_per_epoch=100)
     model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)

@@ -128,7 +128,15 @@ def cmd_bench(args) -> int:
     _apply_device(args.device)
     from replication_faster_rcnn_tpu.benchmark import main as bench_main
 
-    bench_main()
+    # pass flag overrides through; None keeps the flagship default setup
+    flagged = any(
+        v is not None
+        for v in (
+            args.dataset, args.data_root, args.image_size, args.backbone,
+            args.roi_op, args.batch_size, args.lr, args.epochs, args.seed,
+        )
+    ) or args.config != "voc_resnet18"
+    bench_main(_build_config(args) if flagged else None)
     return 0
 
 

@@ -129,6 +129,7 @@ class ModelConfig:
     roi_op: str = "align"  # "align" (bilinear ROIAlign) | "pool" (quantized ROIPool)
     roi_sampling_ratio: int = 2  # ROIAlign samples per bin side
     fpn: bool = False  # FPN neck (BASELINE config #3)
+    fpn_channels: int = 256  # P-level width (FPN paper)
     # compute dtype for conv stacks; params/losses stay float32
     compute_dtype: str = "bfloat16"
 

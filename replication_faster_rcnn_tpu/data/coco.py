@@ -63,10 +63,9 @@ class COCODataset:
         img_id = self.ids[idx]
         info = self.images[img_id]
         path = os.path.join(self.cfg.root_dir, self.split, info["file_name"])
-        image, orig_h, orig_w = _load_image(path, self.cfg.image_size)
-        mean = np.asarray(self.cfg.pixel_mean, np.float32)
-        std = np.asarray(self.cfg.pixel_std, np.float32)
-        image = (image - mean) / std
+        image, orig_h, orig_w = _load_image(
+            path, self.cfg.image_size, self.cfg.pixel_mean, self.cfg.pixel_std
+        )
 
         m = self.cfg.max_boxes
         labels = np.full((m,), -1, np.int32)
@@ -87,4 +86,6 @@ class COCODataset:
             "boxes": boxes,
             "labels": labels,
             "mask": labels >= 0,
+            # COCO has no 'difficult' notion; uniform key for collate
+            "difficult": np.zeros((m,), bool),
         }

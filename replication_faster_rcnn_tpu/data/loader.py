@@ -82,18 +82,27 @@ class DataLoader:
         for i in range(0, end, bs):
             yield order[i : i + bs]
 
-    def _build(self, idxs: np.ndarray) -> Dict[str, np.ndarray]:
-        if self.num_workers == 1 or len(idxs) == 1:
+    def _build(
+        self, idxs: np.ndarray, pool: Optional[futures.ThreadPoolExecutor]
+    ) -> Dict[str, np.ndarray]:
+        if pool is None or len(idxs) == 1:
             return collate([self.dataset[int(i)] for i in idxs])
-        # bounded pool: num_workers is the concurrency cap, not threads/sample
-        with futures.ThreadPoolExecutor(self.num_workers) as pool:
-            samples = list(pool.map(lambda i: self.dataset[int(i)], idxs))
-        return collate(samples)
+        return collate(list(pool.map(lambda i: self.dataset[int(i)], idxs)))
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        # one pool per iteration, reused across every batch (pool
+        # creation/teardown per batch is measurable on the hot input path)
+        pool: Optional[futures.ThreadPoolExecutor] = None
+        if self.num_workers > 1:
+            pool = futures.ThreadPoolExecutor(self.num_workers)
+
         if self.prefetch <= 0:
-            for idxs in self._batches():
-                yield self._build(idxs)
+            try:
+                for idxs in self._batches():
+                    yield self._build(idxs, pool)
+            finally:
+                if pool is not None:
+                    pool.shutdown(wait=False)
             return
 
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
@@ -116,12 +125,14 @@ class DataLoader:
                 for idxs in self._batches():
                     if stop.is_set():
                         return
-                    if not put_unless_stopped(self._build(idxs)):
+                    if not put_unless_stopped(self._build(idxs, pool)):
                         return
             except BaseException as e:  # surface worker errors to the consumer
                 err.append(e)
             finally:
                 put_unless_stopped(None)
+                if pool is not None:
+                    pool.shutdown(wait=False)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()

@@ -1,0 +1,150 @@
+"""ctypes bindings for the native host-side kernels (native/frcnn_native.cpp)
+with exact-equivalent numpy fallbacks.
+
+The native library replaces, in the framework's own code, the compiled host
+kernels the reference borrows from skimage/torchvision (SURVEY.md §2.3):
+fused bilinear-resize+normalize for the data pipeline and greedy NMS for
+CPU-side post-processing. If the ``.so`` is absent, a best-effort ``make``
+builds it; failing that, the numpy fallbacks keep everything working (the
+fallbacks ARE the behavioral spec — parity is tested both ways).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SO_PATH = os.path.join(_REPO, "native", "build", "libfrcnn_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_checked = False
+
+
+def _load_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_checked
+    if _lib_checked:
+        return _lib
+    _lib_checked = True
+    if not os.path.exists(_SO_PATH):
+        try:  # best-effort build; numpy fallback covers failure
+            subprocess.run(
+                ["make", "-C", os.path.join(_REPO, "native")],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.resize_bilinear_normalize.argtypes = [
+        u8p, ctypes.c_int, ctypes.c_int, f32p, ctypes.c_int, ctypes.c_int,
+        f32p, f32p,
+    ]
+    lib.resize_bilinear_normalize.restype = None
+    lib.nms_greedy.argtypes = [
+        f32p, f32p, ctypes.c_int, ctypes.c_float, i32p, ctypes.c_int,
+    ]
+    lib.nms_greedy.restype = ctypes.c_int
+    lib.scale_boxes.argtypes = [
+        f32p, i32p, ctypes.c_int, ctypes.c_float, ctypes.c_float,
+    ]
+    lib.scale_boxes.restype = None
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load_lib() is not None
+
+
+def _resize_normalize_numpy(
+    img: np.ndarray, out_hw: Tuple[int, int], mean: np.ndarray, std: np.ndarray
+) -> np.ndarray:
+    """The behavioral spec of the C++ kernel: bilinear with
+    align_corners=False sampling, fused /255 + mean/std normalization."""
+    sh, sw = img.shape[:2]
+    dh, dw = out_hw
+    sr = np.clip((np.arange(dh) + 0.5) * (sh / dh) - 0.5, 0, sh - 1)
+    sc = np.clip((np.arange(dw) + 0.5) * (sw / dw) - 0.5, 0, sw - 1)
+    r0 = sr.astype(np.int32)
+    c0 = sc.astype(np.int32)
+    r1 = np.minimum(r0 + 1, sh - 1)
+    c1 = np.minimum(c0 + 1, sw - 1)
+    fr = (sr - r0).astype(np.float32)[:, None, None]
+    fc = (sc - c0).astype(np.float32)[None, :, None]
+    im = img.astype(np.float32)
+    top = im[r0][:, c0] * (1 - fc) + im[r0][:, c1] * fc
+    bot = im[r1][:, c0] * (1 - fc) + im[r1][:, c1] * fc
+    out = top * (1 - fr) + bot * fr
+    return ((out / 255.0 - mean) / std).astype(np.float32)
+
+
+def resize_normalize(
+    img: np.ndarray,
+    out_hw: Tuple[int, int],
+    mean,
+    std,
+) -> np.ndarray:
+    """uint8 HWC RGB -> normalized float32 [out_h, out_w, 3]."""
+    img = np.ascontiguousarray(img, np.uint8)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    lib = _load_lib()
+    if lib is None:
+        return _resize_normalize_numpy(img, out_hw, mean, std)
+    dst = np.empty((out_hw[0], out_hw[1], 3), np.float32)
+    lib.resize_bilinear_normalize(
+        img, img.shape[0], img.shape[1], dst, out_hw[0], out_hw[1], mean, std
+    )
+    return dst
+
+
+def _nms_numpy(
+    boxes: np.ndarray, scores: np.ndarray, thresh: float, max_keep: int
+) -> np.ndarray:
+    order = np.argsort(-scores, kind="stable")
+    dead = np.zeros(len(boxes), bool)
+    area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    keep = []
+    for i in order:
+        if dead[i] or len(keep) >= max_keep:
+            if len(keep) >= max_keep:
+                break
+            continue
+        keep.append(int(i))
+        tl = np.maximum(boxes[i, :2], boxes[:, :2])
+        br = np.minimum(boxes[i, 2:], boxes[:, 2:])
+        wh = np.clip(br - tl, 0, None)
+        inter = wh[:, 0] * wh[:, 1]
+        union = area[i] + area - inter
+        iou = np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+        dead |= iou > thresh
+    return np.asarray(keep, np.int32)
+
+
+def nms(
+    boxes: np.ndarray, scores: np.ndarray, thresh: float, max_keep: int = 1 << 30
+) -> np.ndarray:
+    """Greedy NMS on host; returns kept indices in descending score order."""
+    boxes = np.ascontiguousarray(boxes, np.float32)
+    scores = np.ascontiguousarray(scores, np.float32)
+    max_keep = int(min(max_keep, len(boxes)))
+    lib = _load_lib()
+    if lib is None:
+        return _nms_numpy(boxes, scores, thresh, max_keep)
+    keep = np.empty((max(max_keep, 1),), np.int32)
+    n = lib.nms_greedy(boxes, scores, len(boxes), thresh, keep, max_keep)
+    return keep[:n]

@@ -75,4 +75,5 @@ class SyntheticDataset:
             "boxes": boxes,
             "labels": labels,
             "mask": labels >= 0,
+            "difficult": np.zeros((m,), bool),
         }

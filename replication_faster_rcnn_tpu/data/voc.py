@@ -33,15 +33,23 @@ import numpy as np
 from replication_faster_rcnn_tpu.config import DataConfig, VOC_CLASSES
 
 
-def _load_image(path: str, image_size) -> np.ndarray:
+def _load_image(path: str, image_size, pixel_mean, pixel_std):
+    """JPEG -> normalized float32 [H, W, 3] + original size.
+
+    Decode via PIL; resize+normalize via the native C++ kernel
+    (data/native_ops.py, numpy fallback) — the fused host-side fast path
+    standing in for the reference's skimage resize + torch Normalize
+    (`utils/data_loader.py:38,72`)."""
     from PIL import Image
+
+    from replication_faster_rcnn_tpu.data import native_ops
 
     with Image.open(path) as im:
         im = im.convert("RGB")
         orig_w, orig_h = im.size
-        im = im.resize((image_size[1], image_size[0]), Image.BILINEAR)
-        arr = np.asarray(im, np.float32) / 255.0
-    return arr, orig_h, orig_w
+        arr = np.asarray(im, np.uint8)
+    out = native_ops.resize_normalize(arr, image_size, pixel_mean, pixel_std)
+    return out, orig_h, orig_w
 
 
 class VOCDataset:
@@ -76,10 +84,16 @@ class VOCDataset:
         return len(self.ids)
 
     def _parse_annotation(self, xml_path: str):
-        """XML -> (labels [M], boxes [M, 4]) padded with -1."""
+        """XML -> (labels [M], boxes [M, 4], difficult [M]) padded with -1.
+
+        Labels always carry the class (also for difficult objects); the
+        ``difficult`` flags let training mask them out (reference behavior,
+        `data_loader.py:108-109`) while evaluation treats them as
+        ignore-regions per the official VOC protocol."""
         m = self.cfg.max_boxes
         labels = np.full((m,), -1, np.int32)
         boxes = np.full((m, 4), -1.0, np.float32)
+        difficult = np.zeros((m,), bool)
         root = ET.parse(xml_path).getroot()
         i = 0
         for obj in root.iter("object"):
@@ -95,36 +109,35 @@ class VOCDataset:
                 float(bnd.findtext("ymax")),
                 float(bnd.findtext("xmax")),
             ]
-            difficult = obj.findtext("difficult", default="0").strip() == "1"
-            if difficult and not self.cfg.use_difficult:
-                labels[i] = -1  # kept in the array but masked, like the ref
-            else:
-                labels[i] = self.class_to_id[name]
+            labels[i] = self.class_to_id[name]
+            difficult[i] = obj.findtext("difficult", default="0").strip() == "1"
             i += 1
-        return labels, boxes
+        return labels, boxes, difficult
 
     def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
         img_id = self.ids[idx]
         img_path = os.path.join(self.root, "JPEGImages", img_id + ".jpg")
         xml_path = os.path.join(self.root, "Annotations", img_id + ".xml")
 
-        image, orig_h, orig_w = _load_image(img_path, self.cfg.image_size)
-        mean = np.asarray(self.cfg.pixel_mean, np.float32)
-        std = np.asarray(self.cfg.pixel_std, np.float32)
-        image = (image - mean) / std
-
-        labels, boxes = self._parse_annotation(xml_path)
-        valid = labels >= 0
+        image, orig_h, orig_w = _load_image(
+            img_path, self.cfg.image_size, self.cfg.pixel_mean, self.cfg.pixel_std
+        )
+        labels, boxes, difficult = self._parse_annotation(xml_path)
+        real = labels >= 0
         new_h, new_w = self.cfg.image_size
         scale = np.asarray(
             [new_h / orig_h, new_w / orig_w, new_h / orig_h, new_w / orig_w],
             np.float32,
         )
-        boxes = np.where(valid[:, None], np.round(boxes * scale), -1.0)
+        boxes = np.where(real[:, None], np.round(boxes * scale), -1.0)
 
+        # training mask excludes difficult objects unless enabled (reference
+        # `data_loader.py:108-109`); eval reads `difficult` to ignore them
+        mask = real if self.cfg.use_difficult else (real & ~difficult)
         return {
             "image": image.astype(np.float32),
             "boxes": boxes.astype(np.float32),
             "labels": labels,
-            "mask": valid,
+            "mask": mask,
+            "difficult": difficult & real,
         }

@@ -72,11 +72,19 @@ class Evaluator:
                         "classes": out["classes"][i][valid],
                     }
                 )
-                mask = batch["mask"][i]
+                # gt includes difficult objects flagged as ignore — the VOC
+                # protocol scores them as neither TP nor FP
+                lab = batch["labels"][i]
+                diff = batch.get("difficult")
+                diff = (
+                    diff[i] if diff is not None else np.zeros_like(lab, bool)
+                )
+                real = lab >= 0
                 gts.append(
                     {
-                        "boxes": batch["boxes"][i][mask],
-                        "labels": batch["labels"][i][mask],
+                        "boxes": batch["boxes"][i][real],
+                        "labels": lab[real],
+                        "ignore": diff[real],
                     }
                 )
             seen += n

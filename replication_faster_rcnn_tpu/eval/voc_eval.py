@@ -56,7 +56,10 @@ def voc_ap(
 
     Args (parallel lists over images):
       detections[i]: {'boxes' [D,4], 'scores' [D], 'classes' [D]} (valid only)
-      ground_truths[i]: {'boxes' [G,4], 'labels' [G]} (valid only)
+      ground_truths[i]: {'boxes' [G,4], 'labels' [G], optional 'ignore' [G]}
+        — 'ignore' marks VOC "difficult" objects: excluded from the gt count
+        and detections matching them score as neither TP nor FP (official
+        devkit semantics).
 
     Returns {'mAP': float, 'ap_per_class': [num_classes] (nan where no gt)}.
     """
@@ -64,11 +67,16 @@ def voc_ap(
     for cls in range(1, num_classes):
         # gather this class's gt per image
         gt_boxes: List[np.ndarray] = []
+        gt_ignore: List[np.ndarray] = []
         n_gt = 0
         for g in ground_truths:
             sel = g["labels"] == cls
+            ig = np.asarray(
+                g.get("ignore", np.zeros(len(g["labels"]), bool))
+            )[sel]
             gt_boxes.append(g["boxes"][sel])
-            n_gt += int(sel.sum())
+            gt_ignore.append(ig)
+            n_gt += int((~ig).sum())
 
         # flatten detections of this class across images
         recs = []
@@ -93,9 +101,14 @@ def voc_ap(
                 continue
             ious = _iou_one_to_many(box, gts)
             j = int(ious.argmax())
-            if ious[j] >= iou_thresh and not matched[img_i][j]:
-                tp[k] = 1
-                matched[img_i][j] = True
+            if ious[j] >= iou_thresh:
+                if gt_ignore[img_i][j]:
+                    pass  # difficult gt: neither TP nor FP
+                elif not matched[img_i][j]:
+                    tp[k] = 1
+                    matched[img_i][j] = True
+                else:
+                    fp[k] = 1
             else:
                 fp[k] = 1
 

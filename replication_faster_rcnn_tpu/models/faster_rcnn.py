@@ -48,29 +48,79 @@ class FasterRCNN(nn.Module):
     def setup(self) -> None:
         cfg = self.config
         dtype = jnp.dtype(cfg.model.compute_dtype)
-        self.trunk = ResNetTrunk(cfg.model.backbone, dtype)
-        self.rpn = RPNHead(
-            num_anchors=cfg.anchors.num_base_anchors,
-            mid_channels=cfg.model.rpn_mid_channels,
-            dtype=dtype,
-        )
-        self.head = DetectionHead(
-            arch=cfg.model.backbone,
-            num_classes=cfg.model.num_classes,
-            roi_size=cfg.model.roi_size,
-            roi_op=cfg.model.roi_op,
-            sampling_ratio=cfg.model.roi_sampling_ratio,
-            dtype=dtype,
-        )
+        if cfg.model.fpn:
+            from replication_faster_rcnn_tpu.models.fpn import FPNNeck, ResNetFeatures
+            from replication_faster_rcnn_tpu.models.head import FPNDetectionHead
+
+            self.trunk = ResNetFeatures(cfg.model.backbone, dtype)
+            self.neck = FPNNeck(cfg.model.fpn_channels, dtype)
+            self.rpn = RPNHead(
+                num_anchors=cfg.anchors.num_base_anchors,
+                mid_channels=cfg.model.fpn_channels,
+                dtype=dtype,
+            )
+            self.head = FPNDetectionHead(
+                num_classes=cfg.model.num_classes,
+                roi_size=cfg.model.roi_size,
+                sampling_ratio=cfg.model.roi_sampling_ratio,
+                dtype=dtype,
+            )
+        else:
+            self.trunk = ResNetTrunk(cfg.model.backbone, dtype)
+            self.rpn = RPNHead(
+                num_anchors=cfg.anchors.num_base_anchors,
+                mid_channels=cfg.model.rpn_mid_channels,
+                dtype=dtype,
+            )
+            self.head = DetectionHead(
+                arch=cfg.model.backbone,
+                num_classes=cfg.model.num_classes,
+                roi_size=cfg.model.roi_size,
+                roi_op=cfg.model.roi_op,
+                sampling_ratio=cfg.model.roi_sampling_ratio,
+                dtype=dtype,
+            )
 
     # --- stage methods (used individually by the trainer) ---
 
-    def extract_features(self, images: Array, train: bool = False) -> Array:
-        """images NHWC [N, H, W, 3] -> trunk features [N, H/16, W/16, C]."""
+    def extract_features(self, images: Array, train: bool = False):
+        """images NHWC [N, H, W, 3] -> shared features.
+
+        Single-scale: one [N, H/16, W/16, C] map. FPN: list [P2..P6]."""
+        if self.config.model.fpn:
+            return self.neck(self.trunk(images, train))
         return self.trunk(images, train)
 
-    def rpn_forward(self, feat: Array) -> Tuple[Array, Array, Array]:
-        """feat -> (logits [N, A, 2], deltas [N, A, 4], anchors [A, 4])."""
+    def rpn_forward(self, feat) -> Tuple[Array, Array, Array]:
+        """features -> (logits [N, A, 2], deltas [N, A, 4], anchors [A, 4]).
+
+        FPN: the SAME RPN head runs on every level (FPN paper: shared
+        heads); per-level outputs and anchors concatenate fine->coarse, so
+        downstream proposal/target code is level-agnostic.
+        """
+        if self.config.model.fpn:
+            from replication_faster_rcnn_tpu.models.fpn import FPN_STRIDES
+
+            logits_l, deltas_l, anchors_l = [], [], []
+            for level, stride in zip(feat, FPN_STRIDES):
+                lg, dl = self.rpn(level)
+                logits_l.append(lg)
+                deltas_l.append(dl)
+                base = anchor_ops.anchor_base(
+                    stride, self.config.anchors.ratios, self.config.anchors.scales
+                )
+                anchors_l.append(
+                    anchor_ops.grid_anchors(
+                        base, stride, level.shape[1], level.shape[2]
+                    )
+                )
+            import numpy as np
+
+            return (
+                jnp.concatenate(logits_l, axis=1),
+                jnp.concatenate(deltas_l, axis=1),
+                jnp.asarray(np.concatenate(anchors_l, axis=0)),
+            )
         logits, deltas = self.rpn(feat)
         anchors = jnp.asarray(
             anchor_ops.make_anchors(
@@ -95,7 +145,7 @@ class FasterRCNN(nn.Module):
 
     def head_forward(
         self,
-        feat: Array,
+        feat,
         rois: Array,
         img_h: float,
         img_w: float,

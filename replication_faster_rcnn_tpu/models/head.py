@@ -97,6 +97,61 @@ class DetectionHead(nn.Module):
         return cls.reshape(n, r, -1), reg.reshape(n, r, -1)
 
 
+class FPNDetectionHead(nn.Module):
+    """FPN variant of the detection head: multilevel ROIAlign + the paper's
+    two-fc (1024-1024) box head instead of the ResNet layer4 tail (which the
+    FPN backbone consumes as C5).
+
+    __call__(feats [P2..P6 list], rois [N, R, 4], img_h, img_w, train)
+      -> (cls_logits [N, R, num_classes], reg [N, R, num_classes*4]).
+    """
+
+    num_classes: int = 21
+    roi_size: int = 7
+    sampling_ratio: int = 2
+    mlp_dim: int = 1024
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(
+        self,
+        feats,
+        rois: Array,
+        img_h: float,
+        img_w: float,
+        train: bool = False,
+    ) -> Tuple[Array, Array]:
+        from replication_faster_rcnn_tpu.models.fpn import multilevel_roi_align
+
+        n, r = rois.shape[0], rois.shape[1]
+        crops = multilevel_roi_align(
+            feats, rois, img_h, img_w, self.roi_size, self.sampling_ratio
+        )  # [N, R, s, s, C]
+        x = crops.reshape(n * r, -1).astype(self.dtype)
+        # dtype=self.dtype keeps the two big matmuls on the MXU in bf16
+        # (param_dtype stays f32; flax would otherwise promote to f32).
+        x = nn.relu(
+            nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=jnp.float32, name="fc6")(x)
+        )
+        x = nn.relu(
+            nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=jnp.float32, name="fc7")(x)
+        )
+        x = x.astype(jnp.float32)  # cls/reg logits in f32
+        cls = nn.Dense(
+            self.num_classes,
+            kernel_init=nn.initializers.normal(stddev=0.01),
+            param_dtype=jnp.float32,
+            name="cls",
+        )(x)
+        reg = nn.Dense(
+            self.num_classes * 4,
+            kernel_init=nn.initializers.normal(stddev=0.001),
+            param_dtype=jnp.float32,
+            name="reg",
+        )(x)
+        return cls.reshape(n, r, -1), reg.reshape(n, r, -1)
+
+
 def select_class_deltas(reg: Array, labels: Array) -> Array:
     """Pick each roi's box deltas for a given class id.
 

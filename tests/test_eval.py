@@ -144,3 +144,42 @@ def test_evaluator_end_to_end():
     res = ev.evaluate(variables, ds, batch_size=2)
     assert 0.0 <= res["mAP"] <= 1.0
     assert res["ap_per_class"].shape == (cfg.model.num_classes,)
+
+
+class TestDifficultIgnore:
+    """Official VOC protocol: difficult gt are neither TP nor FP."""
+
+    def _gt(self, boxes, labels, ignore):
+        return {
+            "boxes": np.asarray(boxes, np.float32),
+            "labels": np.asarray(labels),
+            "ignore": np.asarray(ignore, bool),
+        }
+
+    def _det(self, boxes, scores, classes):
+        return {
+            "boxes": np.asarray(boxes, np.float32),
+            "scores": np.asarray(scores, np.float32),
+            "classes": np.asarray(classes),
+        }
+
+    def test_detection_on_difficult_not_fp(self):
+        gts = [self._gt([[0, 0, 10, 10], [30, 30, 40, 40]], [1, 1], [False, True])]
+        # high-ranked detection on the difficult gt must not poison precision
+        dets = [
+            self._det([[30, 30, 40, 40], [0, 0, 10, 10]], [0.95, 0.9], [1, 1])
+        ]
+        res = voc_ap(dets, gts, num_classes=2)
+        assert res["mAP"] == 1.0
+
+    def test_difficult_not_counted_in_recall(self):
+        gts = [self._gt([[0, 0, 10, 10], [30, 30, 40, 40]], [1, 1], [False, True])]
+        dets = [self._det([[0, 0, 10, 10]], [0.9], [1])]  # misses only the difficult
+        res = voc_ap(dets, gts, num_classes=2)
+        assert res["mAP"] == 1.0
+
+    def test_only_difficult_gt_means_undefined_ap(self):
+        gts = [self._gt([[0, 0, 10, 10]], [1], [True])]
+        dets = [self._det([[0, 0, 10, 10]], [0.9], [1])]
+        res = voc_ap(dets, gts, num_classes=2)
+        assert np.isnan(res["ap_per_class"][1])

@@ -1,0 +1,148 @@
+"""FPN tests: pyramid shapes, level assignment, multilevel ROIAlign
+blending, shared-RPN anchor alignment, and a jitted FPN train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from replication_faster_rcnn_tpu.config import (
+    AnchorConfig,
+    DataConfig,
+    FasterRCNNConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from replication_faster_rcnn_tpu.models import faster_rcnn
+from replication_faster_rcnn_tpu.models.fpn import (
+    FPNNeck,
+    ResNetFeatures,
+    multilevel_roi_align,
+    roi_levels,
+)
+
+
+def _fpn_cfg(img=128):
+    return FasterRCNNConfig(
+        model=ModelConfig(backbone="resnet18", fpn=True, compute_dtype="float32"),
+        anchors=AnchorConfig(scales=(8.0,)),
+        data=DataConfig(dataset="synthetic", image_size=(img, img), max_boxes=8),
+        train=TrainConfig(batch_size=2),
+        mesh=MeshConfig(num_data=1),
+    )
+
+
+class TestBackboneNeck:
+    def test_feature_strides_and_channels(self):
+        m = ResNetFeatures("resnet18", jnp.float32)
+        x = jnp.zeros((1, 128, 128, 3))
+        vars_ = m.init(jax.random.PRNGKey(0), x, train=False)
+        c2, c3, c4, c5 = m.apply(vars_, x, train=False)
+        assert c2.shape == (1, 32, 32, 64)
+        assert c3.shape == (1, 16, 16, 128)
+        assert c4.shape == (1, 8, 8, 256)
+        assert c5.shape == (1, 4, 4, 512)
+
+    def test_neck_pyramid(self):
+        neck = FPNNeck(channels=64, dtype=jnp.float32)
+        feats = [
+            jnp.zeros((1, 32, 32, 64)),
+            jnp.zeros((1, 16, 16, 128)),
+            jnp.zeros((1, 8, 8, 256)),
+            jnp.zeros((1, 4, 4, 512)),
+        ]
+        vars_ = neck.init(jax.random.PRNGKey(0), feats)
+        ps = neck.apply(vars_, feats)
+        assert [p.shape for p in ps] == [
+            (1, 32, 32, 64), (1, 16, 16, 64), (1, 8, 8, 64),
+            (1, 4, 4, 64), (1, 2, 2, 64),
+        ]
+
+    def test_neck_odd_sizes(self):
+        # 600-input pyramid has odd levels (75 -> 38 -> 19): upsample must crop
+        neck = FPNNeck(channels=32, dtype=jnp.float32)
+        feats = [
+            jnp.zeros((1, 150, 150, 64)),
+            jnp.zeros((1, 75, 75, 128)),
+            jnp.zeros((1, 38, 38, 256)),
+            jnp.zeros((1, 19, 19, 512)),
+        ]
+        vars_ = neck.init(jax.random.PRNGKey(0), feats)
+        ps = neck.apply(vars_, feats)
+        assert [p.shape[1] for p in ps] == [150, 75, 38, 19, 10]
+
+
+class TestLevelAssignment:
+    def test_canonical_sizes(self):
+        # 224x224 roi -> k=4 -> P4 (index 2); tiny roi -> P2; huge -> P5
+        rois = jnp.asarray(
+            [
+                [0, 0, 224, 224],
+                [0, 0, 32, 32],
+                [0, 0, 512, 512],
+                [0, 0, 112, 112],
+            ],
+            jnp.float32,
+        )
+        lv = np.asarray(roi_levels(rois))
+        np.testing.assert_array_equal(lv, [2, 0, 3, 1])
+
+    def test_multilevel_align_uses_assigned_level_only(self):
+        # constant-value levels: the pooled value identifies the level used
+        feats = [
+            jnp.full((1, 32, 32, 1), float(i + 1)) for i in range(4)
+        ]
+        rois = jnp.asarray([[[0, 0, 20, 20], [0, 0, 224, 224]]], jnp.float32)
+        out = multilevel_roi_align(feats, rois, 256.0, 256.0, out_size=2)
+        vals = np.asarray(out)[0, :, 0, 0, 0]
+        assert vals[0] == 1.0  # small roi -> P2
+        assert vals[1] == 3.0  # canonical roi -> P4
+
+
+class TestFPNModel:
+    def test_forward_shapes(self):
+        cfg = _fpn_cfg()
+        model, variables = faster_rcnn.init_variables(cfg, jax.random.PRNGKey(0))
+        logits, deltas, rois, valid, cls, reg, anchors = model.apply(
+            variables, jnp.zeros((1, 128, 128, 3)), train=False
+        )
+        # 3 ratios x 1 scale over levels 32,16,8,4,2
+        expect = 3 * (32 * 32 + 16 * 16 + 8 * 8 + 4 * 4 + 2 * 2)
+        assert anchors.shape == (expect, 4)
+        assert logits.shape == (1, expect, 2)
+        assert cls.shape[2] == cfg.model.num_classes
+
+    def test_anchor_sizes_follow_levels(self):
+        cfg = _fpn_cfg()
+        model, variables = faster_rcnn.init_variables(cfg, jax.random.PRNGKey(0))
+        feats = model.apply(
+            variables, jnp.zeros((1, 128, 128, 3)), False, method="extract_features"
+        )
+        _, _, anchors = model.apply(variables, feats, method="rpn_forward")
+        a = np.asarray(anchors)
+        heights = a[:, 2] - a[:, 0]
+        # first level (stride 4, scale 8, ratio 1 in the middle): ~32 px;
+        # last level (stride 64): ~512 px
+        n2 = 3 * 32 * 32
+        assert 20 <= np.median(heights[:n2]) <= 48
+        assert heights[-1] > 300
+
+    def test_fpn_train_step(self):
+        from replication_faster_rcnn_tpu.data import SyntheticDataset
+        from replication_faster_rcnn_tpu.data.loader import collate
+        from replication_faster_rcnn_tpu.train.train_step import (
+            create_train_state,
+            make_optimizer,
+            make_train_step,
+        )
+
+        cfg = _fpn_cfg(img=64)
+        tx, _ = make_optimizer(cfg, steps_per_epoch=10)
+        model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
+        step = jax.jit(make_train_step(model, cfg, tx))
+        ds = SyntheticDataset(cfg.data, length=2)
+        batch = {k: jnp.asarray(v) for k, v in collate([ds[0], ds[1]]).items()}
+        new_state, metrics = step(state, batch)
+        vals = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        assert all(np.isfinite(v) for v in vals.values()), vals
+        assert int(new_state.step) == 1
