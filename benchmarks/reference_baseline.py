@@ -52,24 +52,20 @@ def _install_stubs() -> None:
     xmltodict.parse = lambda s: {}
     sys.modules["xmltodict"] = xmltodict
 
-    # ---- torchvision: nms / roi_pool / transforms used by the reference
+    # ---- torchvision: nms / roi_pool / transforms used by the reference.
+    # NMS routes to this repo's native C++ greedy NMS (same semantics as
+    # torchvision's C++ kernel) so the baseline isn't slowed by a Python
+    # stand-in; numpy fallback inside native_ops covers a missing .so.
+    sys.path.insert(0, REPO)
+    from replication_faster_rcnn_tpu.data import native_ops
+
     def nms(boxes: "torch.Tensor", scores: "torch.Tensor", iou_threshold: float):
-        order = scores.argsort(descending=True)
-        boxes = boxes.detach()
-        keep = []
-        suppressed = torch.zeros(len(boxes), dtype=torch.bool)
-        areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
-        for i in order:
-            if suppressed[i]:
-                continue
-            keep.append(i.item())
-            tl = torch.maximum(boxes[i, :2], boxes[:, :2])
-            br = torch.minimum(boxes[i, 2:], boxes[:, 2:])
-            wh = (br - tl).clamp(min=0)
-            inter = wh[:, 0] * wh[:, 1]
-            iou = inter / (areas[i] + areas - inter).clamp(min=1e-9)
-            suppressed |= iou > iou_threshold
-        return torch.as_tensor(keep, dtype=torch.long)
+        keep = native_ops.nms(
+            boxes.detach().cpu().numpy(),
+            scores.detach().cpu().numpy(),
+            float(iou_threshold),
+        )
+        return torch.as_tensor(np.asarray(keep), dtype=torch.long)
 
     def roi_pool(features, boxes, output_size, spatial_scale=1.0):
         if isinstance(output_size, int):

@@ -14,6 +14,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from typing import Optional, Tuple
 
 import numpy as np
@@ -25,9 +26,18 @@ _SO_PATH = os.path.join(_REPO, "native", "build", "libfrcnn_native.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _lib_checked = False
+_lib_lock = threading.Lock()  # loader threads race here on first batch
 
 
 def _load_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_checked
+    if _lib_checked:
+        return _lib
+    with _lib_lock:
+        return _load_lib_locked()
+
+
+def _load_lib_locked() -> Optional[ctypes.CDLL]:
     global _lib, _lib_checked
     if _lib_checked:
         return _lib
@@ -110,6 +120,26 @@ def resize_normalize(
         img, img.shape[0], img.shape[1], dst, out_hw[0], out_hw[1], mean, std
     )
     return dst
+
+
+def scale_boxes(
+    boxes: np.ndarray,
+    labels: np.ndarray,
+    row_scale: float,
+    col_scale: float,
+) -> np.ndarray:
+    """Scale + round padded [m, 4] boxes to resized-image coords, leaving
+    entries with label < 0 untouched (reference
+    `utils/data_loader.py:66-69,115` semantics)."""
+    boxes = np.ascontiguousarray(boxes, np.float32).copy()
+    labels = np.ascontiguousarray(labels, np.int32)
+    lib = _load_lib()
+    if lib is None:
+        real = labels >= 0
+        scale = np.asarray([row_scale, col_scale, row_scale, col_scale], np.float32)
+        return np.where(real[:, None], np.round(boxes * scale), boxes)
+    lib.scale_boxes(boxes, labels, len(boxes), row_scale, col_scale)
+    return boxes
 
 
 def _nms_numpy(

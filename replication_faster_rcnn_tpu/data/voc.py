@@ -31,6 +31,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from replication_faster_rcnn_tpu.config import DataConfig, VOC_CLASSES
+from replication_faster_rcnn_tpu.data import native_ops
 
 
 def _load_image(path: str, image_size, pixel_mean, pixel_std):
@@ -41,8 +42,6 @@ def _load_image(path: str, image_size, pixel_mean, pixel_std):
     standing in for the reference's skimage resize + torch Normalize
     (`utils/data_loader.py:38,72`)."""
     from PIL import Image
-
-    from replication_faster_rcnn_tpu.data import native_ops
 
     with Image.open(path) as im:
         im = im.convert("RGB")
@@ -125,11 +124,9 @@ class VOCDataset:
         labels, boxes, difficult = self._parse_annotation(xml_path)
         real = labels >= 0
         new_h, new_w = self.cfg.image_size
-        scale = np.asarray(
-            [new_h / orig_h, new_w / orig_w, new_h / orig_h, new_w / orig_w],
-            np.float32,
+        boxes = native_ops.scale_boxes(
+            boxes, labels, new_h / orig_h, new_w / orig_w
         )
-        boxes = np.where(real[:, None], np.round(boxes * scale), -1.0)
 
         # training mask excludes difficult objects unless enabled (reference
         # `data_loader.py:108-109`); eval reads `difficult` to ignore them

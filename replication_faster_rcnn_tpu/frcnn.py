@@ -83,15 +83,13 @@ class FRCNN:
     def load_param(self, load_path: str) -> None:
         """Warm-start from a checkpoint dir (reference `frcnn.py:29-31`
         loads a torch state_dict; torch resnet ``.pth`` files are also
-        accepted and grafted into the backbone)."""
+        accepted and grafted into the backbone). The trainer's save
+        directory is left untouched — loading must not redirect where new
+        checkpoints go."""
         if load_path.endswith((".pth", ".pt")):
             self.trainer.load_pretrained_backbone(load_path)
         else:
-            import orbax.checkpoint as ocp  # noqa: F401
-
-            self.trainer.workdir = load_path
-            self.trainer._ckpt_mgr = None
-            self.trainer.restore()
+            self.trainer.restore(directory=load_path)
 
     def save_param(self, save_path: str) -> None:
         """Save a checkpoint (fixes reference `frcnn.py:33-35`, which calls

@@ -131,22 +131,37 @@ def load_pretrained_backbone(pth_path: str):
 
 
 def graft_into_variables(variables: Dict[str, Any], pth_path: str) -> Dict[str, Any]:
-    """Return a copy of FasterRCNN `variables` with the pretrained trunk/tail
-    weights grafted in (trunk under `trunk`, tail under `head.tail`)."""
+    """Return a copy of FasterRCNN `variables` with the pretrained weights
+    grafted in, preserving the pytree structure (so optimizer state built
+    from the original params stays valid).
+
+    Two layouts exist:
+      * single-scale: conv1..layer3 under `trunk`, layer4 under `head.tail`
+        (the reference's features/classifier split);
+      * FPN: the whole resnet incl. layer4 under `trunk` (ResNetFeatures);
+        the two-fc head has no pretrained counterpart.
+    The layout is detected from the variables themselves.
+    """
     import jax
 
     (tp, ts), (lp, ls) = load_pretrained_backbone(pth_path)
     variables = jax.tree_util.tree_map(lambda x: x, variables)  # shallow copy
     params = dict(variables["params"])
     stats = dict(variables.get("batch_stats", {}))
+
+    fpn = "layer4.0" in params.get("trunk", {})
     params["trunk"] = {**params.get("trunk", {}), **tp}
     stats["trunk"] = {**stats.get("trunk", {}), **ts}
-    head = dict(params.get("head", {}))
-    head["tail"] = {**head.get("tail", {}), **lp}
-    params["head"] = head
-    hstats = dict(stats.get("head", {}))
-    hstats["tail"] = {**hstats.get("tail", {}), **ls}
-    stats["head"] = hstats
+    if fpn:
+        params["trunk"].update(lp)
+        stats["trunk"].update(ls)
+    else:
+        head = dict(params.get("head", {}))
+        head["tail"] = {**head.get("tail", {}), **lp}
+        params["head"] = head
+        hstats = dict(stats.get("head", {}))
+        hstats["tail"] = {**hstats.get("tail", {}), **ls}
+        stats["head"] = hstats
     out = dict(variables)
     out["params"] = params
     out["batch_stats"] = stats

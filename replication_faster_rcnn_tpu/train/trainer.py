@@ -121,11 +121,19 @@ class Trainer:
         )
         self.checkpoint_manager.wait_until_finished()
 
-    def restore(self, step: Optional[int] = None) -> int:
-        """Exact resume: params, BN stats, optimizer state AND step."""
+    def restore(
+        self, step: Optional[int] = None, directory: Optional[str] = None
+    ) -> int:
+        """Exact resume: params, BN stats, optimizer state AND step.
+
+        ``directory`` restores from a different checkpoint dir WITHOUT
+        changing where this trainer saves (warm-start semantics)."""
         import orbax.checkpoint as ocp
 
-        mgr = self.checkpoint_manager
+        if directory is None:
+            mgr = self.checkpoint_manager
+        else:
+            mgr = ocp.CheckpointManager(os.path.abspath(directory))
         step = mgr.latest_step() if step is None else step
         if step is None:
             return 0

@@ -146,3 +146,50 @@ class TestFPNModel:
         vals = {k: float(v) for k, v in jax.device_get(metrics).items()}
         assert all(np.isfinite(v) for v in vals.values()), vals
         assert int(new_state.step) == 1
+
+
+def test_fpn_pretrained_graft_preserves_structure(tmp_path):
+    """Grafting a torch resnet into the FPN layout must put layer4 into the
+    trunk (ResNetFeatures owns it) and keep the params pytree structure
+    unchanged (optimizer state stays valid)."""
+    torch = __import__("pytest").importorskip("torch")
+    from replication_faster_rcnn_tpu.models import convert, faster_rcnn
+
+    cfg = _fpn_cfg(img=64)
+    model, variables = faster_rcnn.init_variables(cfg, jax.random.PRNGKey(0))
+
+    state = {}
+    def leaves(tree, path=""):
+        for k, v in tree.items():
+            p = f"{path}.{k}" if path else k
+            if isinstance(v, dict) and not any(x in v for x in ("kernel", "scale", "mean")):
+                yield from leaves(v, p)
+            else:
+                yield p, v
+
+    for p, leaf in leaves(variables["params"]["trunk"]):
+        t = p.replace("downsample_conv", "downsample.0").replace("downsample_bn", "downsample.1")
+        if "kernel" in leaf:
+            kh, kw, i, o = leaf["kernel"].shape
+            state[f"{t}.weight"] = torch.randn(o, i, kh, kw)
+        else:
+            n = leaf["scale"].shape[0]
+            state[f"{t}.weight"] = torch.randn(n)
+            state[f"{t}.bias"] = torch.randn(n)
+    for p, leaf in leaves(variables["batch_stats"]["trunk"]):
+        t = p.replace("downsample_bn", "downsample.1")
+        n = leaf["mean"].shape[0]
+        state[f"{t}.running_mean"] = torch.randn(n)
+        state[f"{t}.running_var"] = torch.rand(n)
+    pth = str(tmp_path / "r18.pth")
+    torch.save(state, pth)
+
+    grafted = convert.graft_into_variables(variables, pth)
+    # structure identical (tree_map raises on mismatch)
+    jax.tree_util.tree_map(lambda a, b: None, variables["params"], grafted["params"])
+    # layer4 grafted into the trunk
+    before = np.asarray(variables["params"]["trunk"]["layer4.0"]["conv1"]["kernel"])
+    after = np.asarray(grafted["params"]["trunk"]["layer4.0"]["conv1"]["kernel"])
+    assert not np.allclose(before, after)
+    # no stray head.tail injected
+    assert "tail" not in grafted["params"]["head"]

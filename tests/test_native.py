@@ -99,3 +99,17 @@ def test_loader_uses_native_path(tmp_path, lib_available):
         raw, (64, 64), cfg.pixel_mean, cfg.pixel_std
     )
     np.testing.assert_allclose(s["image"], expect, atol=1e-6)
+
+
+class TestScaleBoxes:
+    def test_matches_numpy_semantics(self, lib_available):
+        boxes = np.asarray(
+            [[5, 10, 45, 60], [-1, -1, -1, -1], [7.4, 3.3, 20.6, 30.9]], np.float32
+        )
+        labels = np.asarray([1, -1, 5], np.int32)
+        out = native_ops.scale_boxes(boxes, labels, 1.28, 0.64)
+        scale = np.asarray([1.28, 0.64, 1.28, 0.64], np.float32)
+        expect = np.where((labels >= 0)[:, None], np.round(boxes * scale), boxes)
+        np.testing.assert_allclose(out, expect)
+        # input untouched (copy semantics)
+        assert boxes[0, 0] == 5.0
