@@ -100,15 +100,17 @@ int nms_greedy(const float* boxes, const float* scores, int n, float thresh,
 
 // Scale + round padded [m, 4] boxes from original to resized image coords,
 // preserving -1 padding (reference utils/data_loader.py:66-69,115).
+// nearbyint (FE_TONEAREST = half-to-even) matches numpy's np.round — the
+// Python fallback is the behavioral spec, so ties must round identically.
 void scale_boxes(float* boxes, const int32_t* labels, int m, float row_scale,
                  float col_scale) {
   for (int i = 0; i < m; ++i) {
     if (labels[i] < 0) continue;
     float* b = boxes + static_cast<int64_t>(i) * 4;
-    b[0] = std::round(b[0] * row_scale);
-    b[1] = std::round(b[1] * col_scale);
-    b[2] = std::round(b[2] * row_scale);
-    b[3] = std::round(b[3] * col_scale);
+    b[0] = std::nearbyint(b[0] * row_scale);
+    b[1] = std::nearbyint(b[1] * col_scale);
+    b[2] = std::nearbyint(b[2] * row_scale);
+    b[3] = std::nearbyint(b[3] * col_scale);
   }
 }
 

@@ -140,6 +140,26 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_predict(args) -> int:
+    _apply_device(args.device)
+    import json
+
+    from replication_faster_rcnn_tpu.eval.predict import (
+        draw_detections,
+        predict_image,
+    )
+    from replication_faster_rcnn_tpu.train.trainer import load_eval_variables
+
+    cfg = _build_config(args)
+    model, variables = load_eval_variables(cfg, args.workdir, args.checkpoint_step)
+    dets = predict_image(cfg, model, variables, args.image, args.score_thresh)
+    print(json.dumps(dets, indent=2))
+    if args.output:
+        draw_detections(args.image, dets, args.output)
+        print(f"annotated image written to {args.output}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(prog="replication_faster_rcnn_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -166,6 +186,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_bench = sub.add_parser("bench", help="train-step throughput")
     _add_common(p_bench)
     p_bench.set_defaults(fn=cmd_bench)
+
+    p_pred = sub.add_parser("predict", help="detect objects in one image")
+    _add_common(p_pred)
+    p_pred.add_argument("--image", required=True)
+    p_pred.add_argument("--workdir", default="checkpoints")
+    p_pred.add_argument("--checkpoint-step", type=int, default=None)
+    p_pred.add_argument("--score-thresh", type=float, default=0.5)
+    p_pred.add_argument("--output", default=None,
+                        help="write the image with boxes drawn to this path")
+    p_pred.set_defaults(fn=cmd_predict)
 
     args = parser.parse_args(argv)
     return args.fn(args)

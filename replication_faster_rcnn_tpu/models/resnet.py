@@ -159,21 +159,32 @@ class ResNetTrunk(nn.Module):
 
     Input NHWC [N, H, W, 3]; output [N, ceil(H/16), ceil(W/16), C] with
     C = 256 (resnet18/34) or 1024 (resnet50/101).
+
+    ``stem='cifar'`` swaps the 7x7/s2 + maxpool ImageNet stem for a 3x3/s1
+    conv — the reference's hand-written CIFAR variant (`nets/resnet.py:
+    109-114`), used for small-image backbone pretraining; output stride is
+    then 4 instead of 16.
     """
 
     arch: str = "resnet18"
     dtype: Any = jnp.bfloat16
+    stem: str = "imagenet"  # "imagenet" | "cifar"
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
         block, depths = _SPECS[self.arch]
         x = x.astype(self.dtype)
-        x = _conv(64, 7, 2, 3, self.dtype, "conv1")(x)
-        x = _norm(self.dtype, train, "bn1")(x)
-        x = nn.relu(x)
-        x = nn.max_pool(
-            x, window_shape=(3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
-        )
+        if self.stem == "cifar":
+            x = _conv(64, 3, 1, 1, self.dtype, "conv1")(x)
+            x = _norm(self.dtype, train, "bn1")(x)
+            x = nn.relu(x)
+        else:
+            x = _conv(64, 7, 2, 3, self.dtype, "conv1")(x)
+            x = _norm(self.dtype, train, "bn1")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(
+                x, window_shape=(3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
+            )
         x = _stage(block, x, _WIDTHS[0], depths[0], 1, self.dtype, train, "layer1")
         x = _stage(block, x, _WIDTHS[1], depths[1], 2, self.dtype, train, "layer2")
         x = _stage(block, x, _WIDTHS[2], depths[2], 2, self.dtype, train, "layer3")
@@ -201,17 +212,23 @@ class ResNetTail(nn.Module):
 
 
 class ResNetClassifier(nn.Module):
-    """Full ImageNet classifier (trunk + tail + fc) — capability parity with
-    the reference's standalone ResNet (`nets/resnet_torch.py:126-258`), used
-    for backbone pretraining/verification rather than detection."""
+    """Full classifier (trunk + tail + fc) — capability parity with the
+    reference's standalone ResNets: the torchvision-style ImageNet model
+    (`nets/resnet_torch.py:126-258`) with the default stem, and the
+    hand-written CIFAR variant the author pretrained to ~0.93 on CIFAR10
+    (`nets/resnet.py`, `readme.md:15`) with ``stem='cifar'``. Used for
+    backbone pretraining/verification rather than detection; the
+    trunk/tail split matches the detector's, so pretrained weights carry
+    over directly."""
 
     arch: str = "resnet18"
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    stem: str = "imagenet"
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
-        x = ResNetTrunk(self.arch, self.dtype, name="trunk")(x, train)
+        x = ResNetTrunk(self.arch, self.dtype, self.stem, name="trunk")(x, train)
         x = ResNetTail(self.arch, self.dtype, name="tail")(x, train)
         return nn.Dense(self.num_classes, param_dtype=jnp.float32, name="fc")(
             x.astype(jnp.float32)

@@ -130,15 +130,20 @@ class Trainer:
         changing where this trainer saves (warm-start semantics)."""
         import orbax.checkpoint as ocp
 
-        if directory is None:
-            mgr = self.checkpoint_manager
-        else:
+        ephemeral = directory is not None
+        if ephemeral:
             mgr = ocp.CheckpointManager(os.path.abspath(directory))
-        step = mgr.latest_step() if step is None else step
-        if step is None:
-            return 0
-        template = jax.device_get(self.state)
-        restored = mgr.restore(step, args=ocp.args.StandardRestore(template))
+        else:
+            mgr = self.checkpoint_manager
+        try:
+            step = mgr.latest_step() if step is None else step
+            if step is None:
+                return 0
+            template = jax.device_get(self.state)
+            restored = mgr.restore(step, args=ocp.args.StandardRestore(template))
+        finally:
+            if ephemeral:
+                mgr.close()
         self.state = replicate_tree(restored, self.mesh)
         return int(self.state.step)
 

@@ -113,3 +113,11 @@ class TestScaleBoxes:
         np.testing.assert_allclose(out, expect)
         # input untouched (copy semantics)
         assert boxes[0, 0] == 5.0
+
+    def test_half_tie_rounds_to_even_like_numpy(self, lib_available):
+        # scale 1.5 x coord 3 = 4.5: np.round gives 4 (half-to-even); the
+        # native kernel must agree (nearbyint, not round)
+        boxes = np.asarray([[3, 1, 5, 3]], np.float32)
+        labels = np.asarray([1], np.int32)
+        out = native_ops.scale_boxes(boxes, labels, 1.5, 1.5)
+        np.testing.assert_array_equal(out[0], np.round(boxes[0] * 1.5))

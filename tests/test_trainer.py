@@ -162,3 +162,23 @@ def test_pretrained_graft_changes_trunk(tmp_path):
     np.testing.assert_allclose(
         after, np.asarray(state["conv1.weight"]).transpose(2, 3, 1, 0), rtol=1e-6
     )
+
+
+def test_cli_predict_on_image(tmp_path, capsys):
+    from PIL import Image
+
+    img_path = str(tmp_path / "test.jpg")
+    Image.new("RGB", (120, 80), (100, 150, 60)).save(img_path)
+    rc = cli.main(
+        [
+            "predict", "--dataset", "synthetic", "--image-size", "64",
+            "--image", img_path, "--workdir", str(tmp_path / "none"),
+            "--score-thresh", "0.0",
+            "--output", str(tmp_path / "out.jpg"),
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "annotated image written" in out
+    import os
+    assert os.path.exists(tmp_path / "out.jpg")
