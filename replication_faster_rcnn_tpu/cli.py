@@ -120,7 +120,14 @@ def cmd_eval(args) -> int:
         variables, dataset, batch_size=cfg.train.batch_size,
         max_images=args.max_images,
     )
-    print(f"mAP@{cfg.eval.iou_thresh}: {result['mAP']:.4f}")
+    if cfg.eval.metric == "coco":
+        print(
+            f"mAP@[.50:.95]: {result['mAP']:.4f} "
+            f"(AP50 {result.get('AP50', float('nan')):.4f}, "
+            f"AP75 {result.get('AP75', float('nan')):.4f})"
+        )
+    else:
+        print(f"mAP@{cfg.eval.iou_thresh}: {result['mAP']:.4f}")
     return 0
 
 

@@ -189,6 +189,7 @@ class EvalConfig:
     max_detections: int = 100
     iou_thresh: float = 0.5  # mAP@0.5
     use_07_metric: bool = False  # area-under-PR by default; True = 11-point
+    metric: str = "voc"  # "voc" (mAP@iou_thresh) | "coco" (mAP@[.50:.95])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -270,6 +271,7 @@ CONFIGS = {
         model=ModelConfig(backbone="resnet50", num_classes=COCO_NUM_CLASSES, roi_op="align"),
         data=DataConfig(dataset="coco", root_dir="data/coco", max_boxes=100),
         train=TrainConfig(batch_size=32),
+        eval=EvalConfig(metric="coco"),
     ),
 }
 

@@ -16,7 +16,7 @@ import numpy as np
 from replication_faster_rcnn_tpu.config import FasterRCNNConfig
 from replication_faster_rcnn_tpu.data import DataLoader
 from replication_faster_rcnn_tpu.eval.detect import batched_decode
-from replication_faster_rcnn_tpu.eval.voc_eval import voc_ap
+from replication_faster_rcnn_tpu.eval.voc_eval import coco_map, voc_ap
 from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
 
 
@@ -90,6 +90,8 @@ class Evaluator:
             seen += n
             if max_images is not None and seen >= max_images:
                 break
+        if self.config.eval.metric == "coco":
+            return coco_map(detections, gts, self.config.model.num_classes)
         return voc_ap(
             detections,
             gts,

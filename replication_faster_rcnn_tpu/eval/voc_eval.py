@@ -13,7 +13,7 @@ off-device (tiny, branchy, once per epoch).
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -45,6 +45,69 @@ def _iou_one_to_many(box: np.ndarray, boxes: np.ndarray) -> np.ndarray:
     return np.where(union > 0, inter / np.maximum(union, 1e-9), 0.0)
 
 
+def _class_records(detections, ground_truths, cls):
+    """Threshold-independent per-class matching state.
+
+    Returns (recs, n_gt, gt_counts) where recs is score-sorted
+    [(score, img_i, gt_j, iou, gt_is_ignore)] — gt_j/iou from the pure
+    argmax-IoU assignment, which does not depend on the threshold — and
+    n_gt counts non-ignored gt. Computing this once lets an IoU-threshold
+    sweep (COCO-style) replay only the cheap matched-flag pass.
+    """
+    gt_boxes = []
+    gt_ignore = []
+    n_gt = 0
+    for g in ground_truths:
+        sel = g["labels"] == cls
+        ig = np.asarray(g.get("ignore", np.zeros(len(g["labels"]), bool)))[sel]
+        gt_boxes.append(g["boxes"][sel])
+        gt_ignore.append(ig)
+        n_gt += int((~ig).sum())
+
+    recs = []
+    for img_i, d in enumerate(detections):
+        sel = d["classes"] == cls
+        for b, s in zip(d["boxes"][sel], d["scores"][sel]):
+            gts = gt_boxes[img_i]
+            if len(gts) == 0:
+                recs.append((float(s), img_i, -1, 0.0, False))
+                continue
+            ious = _iou_one_to_many(b, gts)
+            j = int(ious.argmax())
+            recs.append((float(s), img_i, j, float(ious[j]), bool(gt_ignore[img_i][j])))
+    recs.sort(key=lambda t: -t[0])
+    gt_counts = [len(b) for b in gt_boxes]
+    return recs, n_gt, gt_counts
+
+
+def _ap_from_records(recs, n_gt, gt_counts, iou_thresh, use_07_metric):
+    """AP at one threshold from precomputed records (devkit semantics:
+    match to the argmax-IoU gt; ignored gt -> neither TP nor FP)."""
+    if n_gt == 0:
+        return np.nan
+    if not recs:
+        return 0.0
+    matched = [np.zeros(n, bool) for n in gt_counts]
+    tp = np.zeros(len(recs))
+    fp = np.zeros(len(recs))
+    for k, (_, img_i, j, iou, is_ignore) in enumerate(recs):
+        if j >= 0 and iou >= iou_thresh:
+            if is_ignore:
+                pass  # difficult gt: neither TP nor FP
+            elif not matched[img_i][j]:
+                tp[k] = 1
+                matched[img_i][j] = True
+            else:
+                fp[k] = 1
+        else:
+            fp[k] = 1
+    ctp = np.cumsum(tp)
+    cfp = np.cumsum(fp)
+    recall = ctp / n_gt
+    precision = ctp / np.maximum(ctp + cfp, 1e-9)
+    return _ap_from_pr(recall, precision, use_07_metric)
+
+
 def voc_ap(
     detections: Sequence[Dict[str, np.ndarray]],
     ground_truths: Sequence[Dict[str, np.ndarray]],
@@ -65,59 +128,43 @@ def voc_ap(
     """
     aps = np.full(num_classes, np.nan)
     for cls in range(1, num_classes):
-        # gather this class's gt per image
-        gt_boxes: List[np.ndarray] = []
-        gt_ignore: List[np.ndarray] = []
-        n_gt = 0
-        for g in ground_truths:
-            sel = g["labels"] == cls
-            ig = np.asarray(
-                g.get("ignore", np.zeros(len(g["labels"]), bool))
-            )[sel]
-            gt_boxes.append(g["boxes"][sel])
-            gt_ignore.append(ig)
-            n_gt += int((~ig).sum())
-
-        # flatten detections of this class across images
-        recs = []
-        for img_i, d in enumerate(detections):
-            sel = d["classes"] == cls
-            for b, s in zip(d["boxes"][sel], d["scores"][sel]):
-                recs.append((float(s), img_i, b))
-        if n_gt == 0:
-            continue  # AP undefined with no gt of this class
-        if not recs:
-            aps[cls] = 0.0
-            continue
-
-        recs.sort(key=lambda t: -t[0])
-        matched = [np.zeros(len(b), bool) for b in gt_boxes]
-        tp = np.zeros(len(recs))
-        fp = np.zeros(len(recs))
-        for k, (_, img_i, box) in enumerate(recs):
-            gts = gt_boxes[img_i]
-            if len(gts) == 0:
-                fp[k] = 1
-                continue
-            ious = _iou_one_to_many(box, gts)
-            j = int(ious.argmax())
-            if ious[j] >= iou_thresh:
-                if gt_ignore[img_i][j]:
-                    pass  # difficult gt: neither TP nor FP
-                elif not matched[img_i][j]:
-                    tp[k] = 1
-                    matched[img_i][j] = True
-                else:
-                    fp[k] = 1
-            else:
-                fp[k] = 1
-
-        ctp = np.cumsum(tp)
-        cfp = np.cumsum(fp)
-        recall = ctp / n_gt
-        precision = ctp / np.maximum(ctp + cfp, 1e-9)
-        aps[cls] = _ap_from_pr(recall, precision, use_07_metric)
+        recs, n_gt, gt_counts = _class_records(detections, ground_truths, cls)
+        aps[cls] = _ap_from_records(recs, n_gt, gt_counts, iou_thresh, use_07_metric)
 
     valid = ~np.isnan(aps[1:])
     m_ap = float(aps[1:][valid].mean()) if valid.any() else 0.0
     return {"mAP": m_ap, "ap_per_class": aps}
+
+
+def coco_map(
+    detections: Sequence[Dict[str, np.ndarray]],
+    ground_truths: Sequence[Dict[str, np.ndarray]],
+    num_classes: int,
+    iou_thresholds: Optional[Sequence[float]] = None,
+) -> Dict[str, float]:
+    """COCO-style mAP: mean AP over IoU thresholds .50:.05:.95 (for the
+    COCO-2017 config, BASELINE.json #5). IoU matching is computed once per
+    class; the threshold sweep replays only the matched-flag pass."""
+    if iou_thresholds is None:
+        iou_thresholds = np.arange(0.5, 1.0, 0.05)
+    per_class = {
+        cls: _class_records(detections, ground_truths, cls)
+        for cls in range(1, num_classes)
+    }
+    per_thresh = []
+    for t in iou_thresholds:
+        aps = np.asarray(
+            [
+                _ap_from_records(*per_class[cls], float(t), False)
+                for cls in range(1, num_classes)
+            ]
+        )
+        valid = ~np.isnan(aps)
+        per_thresh.append(float(aps[valid].mean()) if valid.any() else 0.0)
+    out = {"mAP": float(np.mean(per_thresh))}
+    for t, v in zip(iou_thresholds, per_thresh):
+        if abs(t - 0.5) < 1e-9:
+            out["AP50"] = v
+        if abs(t - 0.75) < 1e-9:
+            out["AP75"] = v
+    return out

@@ -1,0 +1,68 @@
+"""Worker script for the multi-host distributed test (launched as a
+subprocess by tests/test_multihost.py, twice).
+
+Each process initializes jax.distributed against a shared coordinator,
+contributes its local virtual CPU devices to the global mesh, and runs a
+psum over the full device set — the cross-process allreduce path
+(`parallel.initialize_distributed`, SURVEY.md §2.4 DCN equivalent).
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    coordinator = sys.argv[1]
+    process_id = int(sys.argv[2])
+    num_processes = int(sys.argv[3])
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+    import jax
+
+    from replication_faster_rcnn_tpu.parallel import initialize_distributed
+
+    initialize_distributed(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n_global = len(jax.devices())
+    n_local = len(jax.local_devices())
+    assert n_global == 4 * num_processes, (n_global, n_local)
+
+    mesh = Mesh(jax.devices(), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+
+    # each global device contributes its (global) index + 1
+    import numpy as np
+
+    local_vals = np.asarray(
+        [jax.devices().index(d) + 1 for d in jax.local_devices()], np.float32
+    )
+    arr = jax.make_array_from_process_local_data(
+        sharding, local_vals, (n_global,)
+    )
+
+    @jax.jit
+    def total(x):
+        return jnp.sum(x)  # cross-process reduction under the hood
+
+    result = float(total(arr))
+    expect = n_global * (n_global + 1) / 2
+    assert result == expect, (result, expect)
+    print(f"proc {process_id}: global devices={n_global} allreduce={result} OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
