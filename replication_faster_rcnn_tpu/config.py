@@ -122,7 +122,10 @@ class ModelConfig:
     """Network architecture (reference `nets/` — resnet_torch.py:392-409 split,
     rpn.py:82-100, heads.py:7-26)."""
 
-    backbone: str = "resnet18"  # resnet18 | resnet34 | resnet50 | resnet101
+    # any arch from the reference's constructor table (`nets/resnet_torch.py:
+    # 271-390`): resnet18/34/50/101/152, resnext50_32x4d, resnext101_32x8d,
+    # wide_resnet50_2, wide_resnet101_2
+    backbone: str = "resnet18"
     num_classes: int = VOC_NUM_CLASSES
     rpn_mid_channels: int = 256
     roi_size: int = 7
@@ -133,19 +136,34 @@ class ModelConfig:
     # compute dtype for conv stacks; params/losses stay float32
     compute_dtype: str = "bfloat16"
 
+    def __post_init__(self):
+        if self.roi_op not in ("align", "pool"):
+            raise ValueError(f"roi_op must be 'align' or 'pool', got {self.roi_op!r}")
+
     @property
     def backbone_channels(self) -> int:
-        """Feature channels out of the stride-16 trunk (conv1..layer3)."""
-        return {"resnet18": 256, "resnet34": 256, "resnet50": 1024, "resnet101": 1024}[
-            self.backbone
-        ]
+        """Feature channels out of the stride-16 trunk (conv1..layer3, or
+        conv5_3 for VGG16). Delegates to the model layer's arch tables so
+        unknown names fail fast here (at config time) rather than deep
+        inside model init."""
+        if self.backbone == "vgg16":
+            from replication_faster_rcnn_tpu.models.vgg import VGG16_TRUNK_CHANNELS
+
+            return VGG16_TRUNK_CHANNELS
+        from replication_faster_rcnn_tpu.models.resnet import trunk_channels
+
+        return trunk_channels(self.backbone)
 
     @property
     def head_channels(self) -> int:
-        """Channels out of the layer4+avgpool classifier tail."""
-        return {"resnet18": 512, "resnet34": 512, "resnet50": 2048, "resnet101": 2048}[
-            self.backbone
-        ]
+        """Channels out of the classifier tail (layer4+avgpool, or fc7)."""
+        if self.backbone == "vgg16":
+            from replication_faster_rcnn_tpu.models.vgg import VGG16_TAIL_CHANNELS
+
+            return VGG16_TAIL_CHANNELS
+        from replication_faster_rcnn_tpu.models.resnet import tail_channels
+
+        return tail_channels(self.backbone)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -190,6 +208,10 @@ class EvalConfig:
     iou_thresh: float = 0.5  # mAP@0.5
     use_07_metric: bool = False  # area-under-PR by default; True = 11-point
     metric: str = "voc"  # "voc" (mAP@iou_thresh) | "coco" (mAP@[.50:.95])
+
+    def __post_init__(self):
+        if self.metric not in ("voc", "coco"):
+            raise ValueError(f"metric must be 'voc' or 'coco', got {self.metric!r}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -271,6 +293,21 @@ CONFIGS = {
         model=ModelConfig(backbone="resnet50", num_classes=COCO_NUM_CLASSES, roi_op="align"),
         data=DataConfig(dataset="coco", root_dir="data/coco", max_boxes=100),
         train=TrainConfig(batch_size=32),
+        eval=EvalConfig(metric="coco"),
+    ),
+    # 6. The py-faster-rcnn VGG16 COCO net the reference documents via its
+    #    checked-in Caffe prototxt (`reference/train_frcnn.prototxt`: VGG16
+    #    features, 512-wide RPN conv, 12 anchors = 3 ratios x 4 scales
+    #    [num_output 48 = 4*12 at :410-417], RoIPool 7x7, 81 classes).
+    "coco_vgg16": _cfg(
+        model=ModelConfig(
+            backbone="vgg16",
+            num_classes=COCO_NUM_CLASSES,
+            roi_op="pool",
+            rpn_mid_channels=512,
+        ),
+        anchors=AnchorConfig(scales=(4.0, 8.0, 16.0, 32.0)),
+        data=DataConfig(dataset="coco", root_dir="data/coco", max_boxes=100),
         eval=EvalConfig(metric="coco"),
     ),
 }

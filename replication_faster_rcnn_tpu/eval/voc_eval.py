@@ -45,15 +45,12 @@ def _iou_one_to_many(box: np.ndarray, boxes: np.ndarray) -> np.ndarray:
     return np.where(union > 0, inter / np.maximum(union, 1e-9), 0.0)
 
 
-def _class_records(detections, ground_truths, cls):
-    """Threshold-independent per-class matching state.
-
-    Returns (recs, n_gt, gt_counts) where recs is score-sorted
-    [(score, img_i, gt_j, iou, gt_is_ignore)] — gt_j/iou from the pure
-    argmax-IoU assignment, which does not depend on the threshold — and
-    n_gt counts non-ignored gt. Computing this once lets an IoU-threshold
-    sweep (COCO-style) replay only the cheap matched-flag pass.
-    """
+def _class_iou_rows(detections, ground_truths, cls):
+    """Per-class matching state shared by both metrics: score-sorted
+    [(score, img_i, iou_row)] with the FULL IoU vector against that image's
+    gts kept per detection, plus per-image ignore masks and the non-ignored
+    gt count. The VOC devkit path freezes each detection's argmax from the
+    row; the COCO sweep re-matches per threshold."""
     gt_boxes = []
     gt_ignore = []
     n_gt = 0
@@ -69,30 +66,35 @@ def _class_records(detections, ground_truths, cls):
         sel = d["classes"] == cls
         for b, s in zip(d["boxes"][sel], d["scores"][sel]):
             gts = gt_boxes[img_i]
-            if len(gts) == 0:
-                recs.append((float(s), img_i, -1, 0.0, False))
-                continue
-            ious = _iou_one_to_many(b, gts)
-            j = int(ious.argmax())
-            recs.append((float(s), img_i, j, float(ious[j]), bool(gt_ignore[img_i][j])))
+            iou_row = _iou_one_to_many(b, gts) if len(gts) else np.zeros(0)
+            recs.append((float(s), img_i, iou_row))
     recs.sort(key=lambda t: -t[0])
-    gt_counts = [len(b) for b in gt_boxes]
-    return recs, n_gt, gt_counts
+    return recs, n_gt, gt_ignore
 
 
-def _ap_from_records(recs, n_gt, gt_counts, iou_thresh, use_07_metric):
-    """AP at one threshold from precomputed records (devkit semantics:
-    match to the argmax-IoU gt; ignored gt -> neither TP nor FP)."""
+def _pr_tail(tp, fp, n_gt, use_07_metric):
+    ctp = np.cumsum(tp)
+    cfp = np.cumsum(fp)
+    recall = ctp / n_gt
+    precision = ctp / np.maximum(ctp + cfp, 1e-9)
+    return _ap_from_pr(recall, precision, use_07_metric)
+
+
+def _ap_devkit(recs, n_gt, gt_ignore, iou_thresh, use_07_metric):
+    """AP at one threshold with VOC-devkit semantics: each detection is
+    pinned to its argmax-IoU gt; if that gt clears the threshold it is a TP
+    once and an FP on re-detection; ignored (difficult) gt -> neither."""
     if n_gt == 0:
         return np.nan
     if not recs:
         return 0.0
-    matched = [np.zeros(n, bool) for n in gt_counts]
+    matched = [np.zeros(len(ig), bool) for ig in gt_ignore]
     tp = np.zeros(len(recs))
     fp = np.zeros(len(recs))
-    for k, (_, img_i, j, iou, is_ignore) in enumerate(recs):
-        if j >= 0 and iou >= iou_thresh:
-            if is_ignore:
+    for k, (_, img_i, iou_row) in enumerate(recs):
+        j = int(iou_row.argmax()) if len(iou_row) else -1
+        if j >= 0 and iou_row[j] >= iou_thresh:
+            if gt_ignore[img_i][j]:
                 pass  # difficult gt: neither TP nor FP
             elif not matched[img_i][j]:
                 tp[k] = 1
@@ -101,11 +103,7 @@ def _ap_from_records(recs, n_gt, gt_counts, iou_thresh, use_07_metric):
                 fp[k] = 1
         else:
             fp[k] = 1
-    ctp = np.cumsum(tp)
-    cfp = np.cumsum(fp)
-    recall = ctp / n_gt
-    precision = ctp / np.maximum(ctp + cfp, 1e-9)
-    return _ap_from_pr(recall, precision, use_07_metric)
+    return _pr_tail(tp, fp, n_gt, use_07_metric)
 
 
 def voc_ap(
@@ -128,12 +126,40 @@ def voc_ap(
     """
     aps = np.full(num_classes, np.nan)
     for cls in range(1, num_classes):
-        recs, n_gt, gt_counts = _class_records(detections, ground_truths, cls)
-        aps[cls] = _ap_from_records(recs, n_gt, gt_counts, iou_thresh, use_07_metric)
+        recs, n_gt, gt_ignore = _class_iou_rows(detections, ground_truths, cls)
+        aps[cls] = _ap_devkit(recs, n_gt, gt_ignore, iou_thresh, use_07_metric)
 
     valid = ~np.isnan(aps[1:])
     m_ap = float(aps[1:][valid].mean()) if valid.any() else 0.0
     return {"mAP": m_ap, "ap_per_class": aps}
+
+
+def _ap_greedy(recs, n_gt, gt_ignore, iou_thresh, use_07_metric):
+    """AP at one threshold with pycocotools matching semantics: each
+    detection (in score order) takes the highest-IoU *still-unmatched,
+    non-ignored* gt with IoU >= t; if none, an ignored gt with IoU >= t
+    absorbs it (neither TP nor FP, and ignored gts may absorb several);
+    otherwise FP."""
+    if n_gt == 0:
+        return np.nan
+    if not recs:
+        return 0.0
+    matched = [np.zeros(len(ig), bool) for ig in gt_ignore]
+    tp, fp = [], []
+    for score, img_i, iou_row in recs:
+        ok = iou_row >= iou_thresh
+        real = ok & ~gt_ignore[img_i] & ~matched[img_i]
+        if real.any():
+            j = int(np.where(real, iou_row, -1.0).argmax())
+            matched[img_i][j] = True
+            tp.append(1.0)
+            fp.append(0.0)
+        elif (ok & gt_ignore[img_i]).any():
+            continue  # matched an ignored gt: excluded from the PR curve
+        else:
+            tp.append(0.0)
+            fp.append(1.0)
+    return _pr_tail(np.asarray(tp), np.asarray(fp), n_gt, use_07_metric)
 
 
 def coco_map(
@@ -143,19 +169,21 @@ def coco_map(
     iou_thresholds: Optional[Sequence[float]] = None,
 ) -> Dict[str, float]:
     """COCO-style mAP: mean AP over IoU thresholds .50:.05:.95 (for the
-    COCO-2017 config, BASELINE.json #5). IoU matching is computed once per
-    class; the threshold sweep replays only the matched-flag pass."""
+    COCO-2017 config, BASELINE.json #5). Per-class IoU rows are computed
+    once; each threshold re-runs the greedy best-unmatched-gt assignment
+    (pycocotools semantics — a detection may match different gts at
+    different thresholds, unlike the VOC devkit's frozen argmax)."""
     if iou_thresholds is None:
         iou_thresholds = np.arange(0.5, 1.0, 0.05)
     per_class = {
-        cls: _class_records(detections, ground_truths, cls)
+        cls: _class_iou_rows(detections, ground_truths, cls)
         for cls in range(1, num_classes)
     }
     per_thresh = []
     for t in iou_thresholds:
         aps = np.asarray(
             [
-                _ap_from_records(*per_class[cls], float(t), False)
+                _ap_greedy(*per_class[cls], float(t), False)
                 for cls in range(1, num_classes)
             ]
         )

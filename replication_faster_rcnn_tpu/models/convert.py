@@ -116,17 +116,63 @@ def convert_tail(state: Mapping[str, Any]):
     return _convert_stage("layer4", state)
 
 
+# torchvision vgg16 `features` Sequential index -> our conv name
+# (reference documents this net via `reference/train_frcnn.prototxt`)
+_VGG16_FEATURE_IDX = {
+    0: "conv1_1", 2: "conv1_2",
+    5: "conv2_1", 7: "conv2_2",
+    10: "conv3_1", 12: "conv3_2", 14: "conv3_3",
+    17: "conv4_1", 19: "conv4_2", 21: "conv4_3",
+    24: "conv5_1", 26: "conv5_2", 28: "conv5_3",
+}
+
+
+def _fc_kernel_from_chw(w: Any, c: int, h: int, ww: int) -> np.ndarray:
+    """torch Linear weight [O, c*h*w] consuming a CHW-flattened input ->
+    flax kernel [h*w*c, O] consuming our HWC flatten."""
+    wn = _to_np(w)
+    return wn.reshape(-1, c, h, ww).transpose(2, 3, 1, 0).reshape(h * ww * c, -1)
+
+
+def convert_vgg16(state: Mapping[str, Any], roi_size: int = 7):
+    """torchvision vgg16 state_dict -> (trunk_params, tail_params) for
+    VGG16Trunk / VGG16Tail. fc6's kernel is re-laid-out from torch's
+    CHW-flatten to our NHWC-flatten; fc8 (ImageNet logits) is dropped."""
+    trunk = {
+        name: {
+            "kernel": _conv_kernel(state[f"features.{idx}.weight"]),
+            "bias": _to_np(state[f"features.{idx}.bias"]),
+        }
+        for idx, name in _VGG16_FEATURE_IDX.items()
+    }
+    tail = {
+        "fc6": {
+            "kernel": _fc_kernel_from_chw(
+                state["classifier.0.weight"], 512, roi_size, roi_size
+            ),
+            "bias": _to_np(state["classifier.0.bias"]),
+        },
+        "fc7": {
+            "kernel": _to_np(state["classifier.3.weight"]).T,
+            "bias": _to_np(state["classifier.3.bias"]),
+        },
+    }
+    return trunk, tail
+
+
+def _load_state_dict(pth_path: str) -> Mapping[str, Any]:
+    import torch
+
+    return torch.load(pth_path, map_location="cpu", weights_only=True)
+
+
 def load_pretrained_backbone(pth_path: str):
     """Load a torchvision resnet ``.pth`` and return flax-ready trees:
     ((trunk_params, trunk_stats), (tail_params, tail_stats)).
 
     Equivalent of reference ``resnet_backbone`` (`nets/resnet_torch.py:392-409`).
     """
-    import torch
-
-    state = torch.load(pth_path, map_location="cpu", weights_only=True)
-    if hasattr(state, "state_dict"):
-        state = state.state_dict()
+    state = _load_state_dict(pth_path)
     return convert_trunk(state), convert_tail(state)
 
 
@@ -144,10 +190,34 @@ def graft_into_variables(variables: Dict[str, Any], pth_path: str) -> Dict[str, 
     """
     import jax
 
-    (tp, ts), (lp, ls) = load_pretrained_backbone(pth_path)
     variables = jax.tree_util.tree_map(lambda x: x, variables)  # shallow copy
     params = dict(variables["params"])
     stats = dict(variables.get("batch_stats", {}))
+
+    if "conv1_1" in params.get("trunk", {}):  # VGG16 layout (no BN stats)
+        # derive the model's roi_size from its fc6 kernel so a non-7x7
+        # configuration fails fast here instead of as an XLA shape error
+        fc6_rows = params["head"]["tail"]["fc6"]["kernel"].shape[0]
+        roi_size = int(round((fc6_rows // 512) ** 0.5))
+        if roi_size * roi_size * 512 != fc6_rows:
+            raise ValueError(f"unexpected fc6 in-features {fc6_rows}")
+        tp, lp = convert_vgg16(_load_state_dict(pth_path), roi_size=roi_size)
+        if lp["fc6"]["kernel"].shape[0] != fc6_rows:
+            raise ValueError(
+                f"pretrained fc6 expects {lp['fc6']['kernel'].shape[0]} "
+                f"in-features but the model was built with {fc6_rows} "
+                f"(roi_size {roi_size}) — torchvision vgg16 checkpoints "
+                "require roi_size=7"
+            )
+        params["trunk"] = {**params["trunk"], **tp}
+        head = dict(params.get("head", {}))
+        head["tail"] = {**head.get("tail", {}), **lp}
+        params["head"] = head
+        out = dict(variables)
+        out["params"] = params
+        return out
+
+    (tp, ts), (lp, ls) = load_pretrained_backbone(pth_path)
 
     fpn = "layer4.0" in params.get("trunk", {})
     params["trunk"] = {**params.get("trunk", {}), **tp}

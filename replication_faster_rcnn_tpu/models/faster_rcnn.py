@@ -66,7 +66,14 @@ class FasterRCNN(nn.Module):
                 dtype=dtype,
             )
         else:
-            self.trunk = ResNetTrunk(cfg.model.backbone, dtype)
+            if cfg.model.backbone == "vgg16":
+                from replication_faster_rcnn_tpu.models.vgg import VGG16Trunk
+
+                self.trunk = VGG16Trunk(dtype)
+            else:
+                self.trunk = ResNetTrunk(cfg.model.backbone, dtype)
+            # the head dispatches internally on arch (VGG16 fc6/fc7 tail
+            # vs ResNet layer4 tail)
             self.rpn = RPNHead(
                 num_anchors=cfg.anchors.num_base_anchors,
                 mid_channels=cfg.model.rpn_mid_channels,

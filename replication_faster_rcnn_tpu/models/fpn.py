@@ -28,7 +28,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from replication_faster_rcnn_tpu.models.resnet import _SPECS, _WIDTHS, _conv, _norm, _stage
+from replication_faster_rcnn_tpu.models.resnet import _WIDTHS, _conv, _norm, _spec, _stage
 from replication_faster_rcnn_tpu.ops import roi_ops
 
 Array = jnp.ndarray
@@ -49,16 +49,16 @@ class ResNetFeatures(nn.Module):
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> List[Array]:
-        block, depths = _SPECS[self.arch]
+        depths = _spec(self.arch)[1]
         x = x.astype(self.dtype)
         x = _conv(64, 7, 2, 3, self.dtype, "conv1")(x)
         x = _norm(self.dtype, train, "bn1")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
-        c2 = _stage(block, x, _WIDTHS[0], depths[0], 1, self.dtype, train, "layer1")
-        c3 = _stage(block, c2, _WIDTHS[1], depths[1], 2, self.dtype, train, "layer2")
-        c4 = _stage(block, c3, _WIDTHS[2], depths[2], 2, self.dtype, train, "layer3")
-        c5 = _stage(block, c4, _WIDTHS[3], depths[3], 2, self.dtype, train, "layer4")
+        c2 = _stage(self.arch, x, _WIDTHS[0], depths[0], 1, self.dtype, train, "layer1")
+        c3 = _stage(self.arch, c2, _WIDTHS[1], depths[1], 2, self.dtype, train, "layer2")
+        c4 = _stage(self.arch, c3, _WIDTHS[2], depths[2], 2, self.dtype, train, "layer3")
+        c5 = _stage(self.arch, c4, _WIDTHS[3], depths[3], 2, self.dtype, train, "layer4")
         return [c2, c3, c4, c5]
 
 

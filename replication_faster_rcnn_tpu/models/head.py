@@ -76,8 +76,15 @@ class DetectionHead(nn.Module):
         crops = jax.vmap(extract)(feat, feat_rois)  # [N, R, s, s, C]
         crops = crops.reshape((n * r,) + crops.shape[2:])
 
-        # Backbone tail (reference's `classifier`: layer4 + avgpool)
-        embed = ResNetTail(self.arch, self.dtype, name="tail")(crops, train)
+        # Backbone tail: layer4+avgpool for ResNets (the reference's
+        # `classifier`, `nets/heads.py:51-52`); fc6/fc7 for the
+        # prototxt-documented VGG16 (models/vgg.py).
+        if self.arch == "vgg16":
+            from replication_faster_rcnn_tpu.models.vgg import VGG16Tail
+
+            embed = VGG16Tail(self.dtype, name="tail")(crops, train)
+        else:
+            embed = ResNetTail(self.arch, self.dtype, name="tail")(crops, train)
         embed = embed.astype(jnp.float32)  # [N*R, C_tail]
 
         # Paper-standard inits the reference leaves at torch defaults:

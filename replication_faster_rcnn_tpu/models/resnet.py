@@ -23,7 +23,7 @@ TPU-first design choices (not translations):
 
 from __future__ import annotations
 
-from typing import Any, Callable, Sequence, Tuple
+from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -44,6 +44,64 @@ def _norm(dtype: Any, train: bool, name: str) -> nn.BatchNorm:
     )
 
 
+class GroupedConv(nn.Module):
+    """Grouped KxK conv as patch extraction + per-group batched einsum.
+
+    ResNeXt's grouped 3x3 (reference `nets/resnet_torch.py:10-12,100`,
+    torch ``groups=``) cannot use ``feature_group_count`` here: XLA's TPU
+    grouped-convolution lowering stalls on this backend for any group count
+    > 1. The TPU-native formulation is a grouped GEMM: unroll the KxK taps
+    into shifted slices (9 static slices — no gather), then contract each
+    group's ``[HW, K*K*I/g] x [K*K*I/g, O/g]`` block as one batched einsum,
+    which XLA maps straight onto the MXU. FLOPs are the true grouped count
+    (1/g of dense).
+
+    The parameter keeps nn.Conv's grouped-HWIO kernel shape
+    ``[K, K, I/g, O]`` (torch layout transposed), so `models/convert.py`
+    converts torch grouped weights with the same pure transpose it uses for
+    dense convs, and fan-in (K*K*I/g) matches for initialization.
+    """
+
+    features: int
+    kernel: int
+    stride: int
+    padding: int
+    groups: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        g, k, s, p = self.groups, self.kernel, self.stride, self.padding
+        in_ch = x.shape[-1]
+        assert in_ch % g == 0 and self.features % g == 0
+        w = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (k, k, in_ch // g, self.features),
+            jnp.float32,
+        )
+        x = x.astype(self.dtype)
+        w = w.astype(self.dtype)
+        xp = jnp.pad(x, ((0, 0), (p, p), (p, p), (0, 0)))
+        out_h = (x.shape[1] + 2 * p - k) // s + 1
+        out_w = (x.shape[2] + 2 * p - k) // s + 1
+        # taps: [N, out_h, out_w, k*k, in_ch] from k*k static strided slices
+        taps = jnp.stack(
+            [
+                xp[:, dr : dr + (out_h - 1) * s + 1 : s, dc : dc + (out_w - 1) * s + 1 : s, :]
+                for dr in range(k)
+                for dc in range(k)
+            ],
+            axis=3,
+        )
+        taps = taps.reshape(*taps.shape[:4], g, in_ch // g)
+        # kernel [k,k,I/g,O] -> [k*k, I/g, g, O/g]; output groups are
+        # contiguous blocks of O/g channels (torch grouped-conv semantics)
+        wg = w.reshape(k * k, in_ch // g, g, self.features // g)
+        y = jnp.einsum("nhwpgi,pigo->nhwgo", taps, wg)
+        return y.reshape(y.shape[0], out_h, out_w, self.features)
+
+
 def _conv(
     features: int,
     kernel: int,
@@ -51,8 +109,19 @@ def _conv(
     padding: int,
     dtype: Any,
     name: str,
-) -> nn.Conv:
+    groups: int = 1,
+):
     """Bias-free conv with explicit torch-style symmetric padding."""
+    if groups > 1:
+        return GroupedConv(
+            features=features,
+            kernel=kernel,
+            stride=stride,
+            padding=padding,
+            groups=groups,
+            dtype=dtype,
+            name=name,
+        )
     return nn.Conv(
         features=features,
         kernel_size=(kernel, kernel),
@@ -89,21 +158,28 @@ class BasicBlock(nn.Module):
 
 class Bottleneck(nn.Module):
     """1x1 -> 3x3 -> 1x1(x4) bottleneck (reference `nets/resnet_torch.py:78-123`;
-    torchvision-style stride on the 3x3)."""
+    torchvision-style stride on the 3x3). ``groups``/``base_width`` give the
+    ResNeXt / wide-ResNet variants of the reference's constructor table
+    (`nets/resnet_torch.py:13-23,299-390`): the inner width is
+    ``features * base_width/64 * groups`` and the 3x3 is grouped; the block
+    output stays ``features * 4`` for every variant."""
 
-    features: int  # bottleneck width; output is features * 4
+    features: int  # bottleneck planes; output is features * 4
     stride: int = 1
     downsample: bool = False
     dtype: Any = jnp.bfloat16
+    groups: int = 1
+    base_width: int = 64
     expansion: int = 4
 
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
         identity = x
-        out = _conv(self.features, 1, 1, 0, self.dtype, "conv1")(x)
+        width = int(self.features * (self.base_width / 64.0)) * self.groups
+        out = _conv(width, 1, 1, 0, self.dtype, "conv1")(x)
         out = _norm(self.dtype, train, "bn1")(out)
         out = nn.relu(out)
-        out = _conv(self.features, 3, self.stride, 1, self.dtype, "conv2")(out)
+        out = _conv(width, 3, self.stride, 1, self.dtype, "conv2", self.groups)(out)
         out = _norm(self.dtype, train, "bn2")(out)
         out = nn.relu(out)
         out = _conv(self.features * self.expansion, 1, 1, 0, self.dtype, "conv3")(out)
@@ -116,18 +192,26 @@ class Bottleneck(nn.Module):
         return nn.relu(out + identity)
 
 
-# name -> (block class, blocks per stage, stage base widths)
+# name -> (block class, blocks per stage, groups, width_per_group) — the full
+# constructor table of reference `nets/resnet_torch.py:271-390` (resnet152 at
+# :313, resnext50_32x4d/resnext101_32x8d at :327-350, wide_resnet50_2/101_2
+# at :353-390).
 _SPECS = {
-    "resnet18": (BasicBlock, (2, 2, 2, 2)),
-    "resnet34": (BasicBlock, (3, 4, 6, 3)),
-    "resnet50": (Bottleneck, (3, 4, 6, 3)),
-    "resnet101": (Bottleneck, (3, 4, 23, 3)),
+    "resnet18": (BasicBlock, (2, 2, 2, 2), 1, 64),
+    "resnet34": (BasicBlock, (3, 4, 6, 3), 1, 64),
+    "resnet50": (Bottleneck, (3, 4, 6, 3), 1, 64),
+    "resnet101": (Bottleneck, (3, 4, 23, 3), 1, 64),
+    "resnet152": (Bottleneck, (3, 8, 36, 3), 1, 64),
+    "resnext50_32x4d": (Bottleneck, (3, 4, 6, 3), 32, 4),
+    "resnext101_32x8d": (Bottleneck, (3, 4, 23, 3), 32, 8),
+    "wide_resnet50_2": (Bottleneck, (3, 4, 6, 3), 1, 128),
+    "wide_resnet101_2": (Bottleneck, (3, 4, 23, 3), 1, 128),
 }
 _WIDTHS = (64, 128, 256, 512)
 
 
 def _stage(
-    block: Callable[..., nn.Module],
+    arch: str,
     x: Array,
     features: int,
     n_blocks: int,
@@ -136,20 +220,20 @@ def _stage(
     train: bool,
     name: str,
 ) -> Array:
-    expansion = getattr(block, "expansion", 1) if block is Bottleneck else 1
+    block, _, groups, base_width = _spec(arch)
+    out_ch = features * (4 if block is Bottleneck else 1)
     for i in range(n_blocks):
         s = stride if i == 0 else 1
-        in_ch = x.shape[-1]
-        out_ch = features * (4 if block is Bottleneck else 1)
-        down = s != 1 or in_ch != out_ch
+        down = s != 1 or x.shape[-1] != out_ch
+        kw = {"groups": groups, "base_width": base_width} if block is Bottleneck else {}
         x = block(
             features=features,
             stride=s,
             downsample=down,
             dtype=dtype,
             name=f"{name}.{i}",
+            **kw,
         )(x, train)
-    del expansion
     return x
 
 
@@ -172,7 +256,7 @@ class ResNetTrunk(nn.Module):
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
-        block, depths = _SPECS[self.arch]
+        depths = _spec(self.arch)[1]
         x = x.astype(self.dtype)
         if self.stem == "cifar":
             x = _conv(64, 3, 1, 1, self.dtype, "conv1")(x)
@@ -185,9 +269,9 @@ class ResNetTrunk(nn.Module):
             x = nn.max_pool(
                 x, window_shape=(3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
             )
-        x = _stage(block, x, _WIDTHS[0], depths[0], 1, self.dtype, train, "layer1")
-        x = _stage(block, x, _WIDTHS[1], depths[1], 2, self.dtype, train, "layer2")
-        x = _stage(block, x, _WIDTHS[2], depths[2], 2, self.dtype, train, "layer3")
+        x = _stage(self.arch, x, _WIDTHS[0], depths[0], 1, self.dtype, train, "layer1")
+        x = _stage(self.arch, x, _WIDTHS[1], depths[1], 2, self.dtype, train, "layer2")
+        x = _stage(self.arch, x, _WIDTHS[2], depths[2], 2, self.dtype, train, "layer3")
         return x
 
 
@@ -205,9 +289,9 @@ class ResNetTail(nn.Module):
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
-        block, depths = _SPECS[self.arch]
+        depths = _spec(self.arch)[1]
         x = x.astype(self.dtype)
-        x = _stage(block, x, _WIDTHS[3], depths[3], 2, self.dtype, train, "layer4")
+        x = _stage(self.arch, x, _WIDTHS[3], depths[3], 2, self.dtype, train, "layer4")
         return jnp.mean(x, axis=(1, 2))  # global avg pool == AdaptiveAvgPool2d(1)
 
 
@@ -235,11 +319,16 @@ class ResNetClassifier(nn.Module):
         )
 
 
+def _spec(arch: str):
+    try:
+        return _SPECS[arch]
+    except KeyError:
+        raise ValueError(f"unknown resnet arch {arch!r}; choices: {sorted(_SPECS)}") from None
+
+
 def trunk_channels(arch: str) -> int:
-    block, _ = _SPECS[arch]
-    return 256 * (4 if block is Bottleneck else 1)
+    return 256 * (4 if _spec(arch)[0] is Bottleneck else 1)
 
 
 def tail_channels(arch: str) -> int:
-    block, _ = _SPECS[arch]
-    return 512 * (4 if block is Bottleneck else 1)
+    return 512 * (4 if _spec(arch)[0] is Bottleneck else 1)

@@ -87,7 +87,7 @@ def compute_losses(
     variables = {"params": params, "batch_stats": batch_stats}
     sigma = config.train.smooth_l1_sigma
 
-    rng_at, rng_pt = jax.random.split(rng)
+    rng_at, rng_pt, rng_do = jax.random.split(rng, 3)
 
     # trunk + RPN (train mode: BN batch stats update)
     feat, mut = model.apply(
@@ -110,7 +110,8 @@ def compute_losses(
         rng_pt, rois, roi_valid, gt_boxes, gt_labels, gt_mask, config.roi_targets
     )
 
-    # head on the sampled rois (BN in the tail also updates)
+    # head on the sampled rois (BN in the tail also updates; the VGG16
+    # tail's dropout draws from the 'dropout' rng in train mode)
     (cls_out, reg_out), mut2 = model.apply(
         {"params": params, "batch_stats": mut["batch_stats"]},
         feat,
@@ -120,6 +121,7 @@ def compute_losses(
         train,
         method="head_forward",
         mutable=["batch_stats"],
+        rngs={"dropout": rng_do} if train else None,
     )
     reg_sel = select_class_deltas(reg_out, lab_t2)
     head_reg_loss = losses.loc_loss(reg_sel, reg_t2, lab_t2, sigma)

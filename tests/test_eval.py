@@ -209,8 +209,58 @@ class TestCOCOMap:
         assert res["AP75"] == 0.0
         assert 0.0 < res["mAP"] < 1.0
 
+    def test_greedy_rematch_prefers_unmatched_gt(self):
+        # pycocotools semantics: det2's argmax-IoU gt (A) is taken by det1,
+        # so det2 must match the still-unmatched B (TP), not be scored FP
+        # against A. The VOC devkit's frozen argmax would call det2 an FP.
+        from replication_faster_rcnn_tpu.eval import coco_map
+
+        gts = [
+            {
+                "boxes": np.asarray(
+                    [[0, 0, 10, 10], [0, 5, 10, 15]], np.float32  # A, B
+                ),
+                "labels": np.asarray([1, 1]),
+            }
+        ]
+        dets = [
+            {
+                # det1 == A exactly; det2 overlaps A (IoU .67) more than B
+                # (IoU .54) but clears the 0.5 threshold on both
+                "boxes": np.asarray(
+                    [[0, 0, 10, 10], [0, 2, 10, 12]], np.float32
+                ),
+                "scores": np.asarray([0.9, 0.8], np.float32),
+                "classes": np.asarray([1, 1]),
+            }
+        ]
+        res = coco_map(dets, gts, num_classes=2, iou_thresholds=[0.5])
+        assert res["AP50"] == 1.0  # both gts recalled: det2 re-matched to B
+
+    def test_ignored_gt_absorbs_without_fp(self):
+        from replication_faster_rcnn_tpu.eval import coco_map
+
+        gts = [
+            {
+                "boxes": np.asarray([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32),
+                "labels": np.asarray([1, 1]),
+                "ignore": np.asarray([False, True]),
+            }
+        ]
+        dets = [
+            {
+                "boxes": np.asarray(
+                    [[0, 0, 10, 10], [20, 20, 30, 30], [21, 21, 31, 31]], np.float32
+                ),
+                "scores": np.asarray([0.9, 0.8, 0.7], np.float32),
+                "classes": np.asarray([1, 1, 1]),
+            }
+        ]
+        # dets 2 and 3 both land on the ignored gt: absorbed, not FPs
+        res = coco_map(dets, gts, num_classes=2, iou_thresholds=[0.5])
+        assert res["AP50"] == 1.0
+
     def test_evaluator_dispatches_coco_metric(self):
-        import dataclasses
         from replication_faster_rcnn_tpu.data import SyntheticDataset
         from replication_faster_rcnn_tpu.eval import Evaluator
         from replication_faster_rcnn_tpu.models import faster_rcnn

@@ -53,6 +53,58 @@ class TestResNet:
         y = tail.apply(vars_, x, train=False)
         assert y.shape == (4, tail_channels(arch))
 
+    @pytest.mark.parametrize("arch", ["resnet152", "resnext50_32x4d", "wide_resnet50_2"])
+    def test_variant_trunk_channels(self, arch):
+        # the full constructor table of reference nets/resnet_torch.py:271-390
+        trunk = ResNetTrunk(arch, jnp.float32)
+        x = jnp.zeros((1, 32, 32, 3))
+        vars_ = trunk.init(jax.random.PRNGKey(0), x, train=False)
+        y = trunk.apply(vars_, x, train=False)
+        assert y.shape == (1, 2, 2, trunk_channels(arch))
+
+    def test_resnext_grouped_conv_shapes(self):
+        # torchvision width formula: planes * base_width/64 * groups; the 3x3
+        # is grouped, so its kernel holds in_channels/groups input channels.
+        trunk = ResNetTrunk("resnext50_32x4d", jnp.float32)
+        vars_ = trunk.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
+        k = vars_["params"]["layer1.0"]["conv2"]["kernel"]
+        assert k.shape == (3, 3, 128 // 32, 128)  # width=64*(4/64)*32=128, groups=32
+        k_wide = ResNetTrunk("wide_resnet50_2", jnp.float32).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False
+        )["params"]["layer1.0"]["conv2"]["kernel"]
+        assert k_wide.shape == (3, 3, 128, 128)  # width=64*(128/64)=128
+
+    @pytest.mark.parametrize("stride", [1, 2])
+    def test_grouped_conv_matches_xla_grouped(self, stride):
+        # the einsum formulation (TPU path) vs XLA's native grouped conv,
+        # which works on CPU and serves as the oracle
+        from replication_faster_rcnn_tpu.models.resnet import GroupedConv
+
+        g, in_ch, out_ch = 4, 16, 24
+        mod = GroupedConv(
+            features=out_ch, kernel=3, stride=stride, padding=1, groups=g,
+            dtype=jnp.float32,
+        )
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 9, 11, in_ch))
+        vars_ = mod.init(jax.random.PRNGKey(1), x)
+        y = mod.apply(vars_, x)
+        ref = jax.lax.conv_general_dilated(
+            x,
+            vars_["params"]["kernel"],
+            window_strides=(stride, stride),
+            padding=((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=g,
+        )
+        assert y.shape == ref.shape
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_unknown_arch_fails_fast(self):
+        with pytest.raises(ValueError, match="unknown resnet arch"):
+            trunk_channels("resnext50_32x8d")  # typo'd mix of two valid names
+        with pytest.raises(ValueError, match="unknown resnet arch"):
+            ModelConfig(backbone="resnet19").backbone_channels
+
     def test_batchnorm_stats_update_in_train(self):
         trunk = ResNetTrunk("resnet18", jnp.float32)
         x = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 64, 3))
@@ -63,6 +115,78 @@ class TestResNet:
         before = vars_["batch_stats"]["bn1"]["mean"]
         after = updates["batch_stats"]["bn1"]["mean"]
         assert not np.allclose(np.asarray(before), np.asarray(after))
+
+
+class TestVGG16:
+    """The py-faster-rcnn VGG16 net the reference documents via its
+    checked-in prototxt (`reference/train_frcnn.prototxt`)."""
+
+    def test_trunk_stride16_and_channels(self):
+        from replication_faster_rcnn_tpu.models.vgg import VGG16Trunk
+
+        trunk = VGG16Trunk(jnp.float32)
+        x = jnp.zeros((1, 112, 150, 3))
+        vars_ = trunk.init(jax.random.PRNGKey(0), x, train=False)
+        y = trunk.apply(vars_, x, train=False)
+        # ceil pooling: 150 -> 75 -> 38 -> 19 -> 10 (Caffe rounding)
+        assert y.shape == (1, 7, 10, 512)
+
+    def test_tail_embeds_and_dropout_gates(self):
+        from replication_faster_rcnn_tpu.models.vgg import VGG16Tail
+
+        tail = VGG16Tail(jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(0), (3, 7, 7, 512))
+        vars_ = tail.init(jax.random.PRNGKey(1), x, train=False)
+        y = tail.apply(vars_, x, train=False)
+        assert y.shape == (3, 4096)
+        # train mode: dropout active, needs rng, output differs from eval
+        y_tr = tail.apply(vars_, x, train=True, rngs={"dropout": jax.random.PRNGKey(2)})
+        assert not np.allclose(np.asarray(y), np.asarray(y_tr))
+
+    def test_assembly_forward(self):
+        cfg = _small_cfg(backbone="vgg16", roi_op="pool")
+        model, variables = faster_rcnn.init_variables(cfg, jax.random.PRNGKey(0))
+        out = model.apply(variables, jnp.zeros((1, 96, 96, 3)), train=False)
+        logits, deltas, rois, valid, cls, reg, anchors = out
+        assert cls.shape == (1, cfg.proposals.post_nms_test, cfg.model.num_classes)
+
+    def test_fc6_kernel_layout_matches_torch_flatten(self):
+        import torch
+        import torch.nn.functional as F
+
+        c, h, w_, o = 5, 2, 3, 4
+        wt = torch.randn(o, c * h * w_)
+        x = torch.randn(2, c, h, w_)
+        ref = F.linear(x.flatten(1), wt).numpy()
+
+        kernel = convert._fc_kernel_from_chw(wt, c, h, w_)
+        x_hwc = jnp.asarray(x.numpy()).transpose(0, 2, 3, 1).reshape(2, -1)
+        y = x_hwc @ jnp.asarray(kernel)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-5)
+
+    def test_convert_vgg16_tree_matches_flax_init(self):
+        import torch
+        from replication_faster_rcnn_tpu.models.vgg import VGG16Trunk
+
+        trunk = VGG16Trunk(jnp.float32)
+        vars_ = trunk.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
+
+        # synthesize a torchvision-shaped state_dict from the flax shapes
+        state = {}
+        for idx, name in convert._VGG16_FEATURE_IDX.items():
+            kh, kw, i, o = vars_["params"][name]["kernel"].shape
+            state[f"features.{idx}.weight"] = torch.randn(o, i, kh, kw)
+            state[f"features.{idx}.bias"] = torch.randn(o)
+        state["classifier.0.weight"] = torch.randn(8, 512 * 2 * 2)
+        state["classifier.0.bias"] = torch.randn(8)
+        state["classifier.3.weight"] = torch.randn(8, 8)
+        state["classifier.3.bias"] = torch.randn(8)
+
+        tp, _ = convert.convert_vgg16(state, roi_size=2)
+        same = jax.tree_util.tree_map(
+            lambda a, b: tuple(a.shape) == tuple(np.shape(b)), vars_["params"], tp
+        )
+        assert all(jax.tree_util.tree_leaves(same))
 
 
 class TestFasterRCNNAssembly:
@@ -139,6 +263,28 @@ class TestTorchConversion:
             window_strides=(2, 2),
             padding=((1, 1), (1, 1)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+
+    def test_grouped_conv_kernel_layout(self):
+        # resnext's grouped 3x3: the OIHW->HWIO transpose is group-agnostic,
+        # but verify end-to-end against torch's groups= semantics.
+        import torch
+        import torch.nn.functional as F
+
+        groups = 4
+        w = torch.randn(16, 8 // groups * 2, 3, 3)  # out=16, in/groups=4
+        x = torch.randn(1, 16, 10, 10)
+        ref = F.conv2d(x, w, padding=1, groups=groups).permute(0, 2, 3, 1).numpy()
+
+        kernel = convert._conv_kernel(w)
+        y = jax.lax.conv_general_dilated(
+            jnp.asarray(x.numpy()).transpose(0, 2, 3, 1),
+            jnp.asarray(kernel),
+            window_strides=(1, 1),
+            padding=((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=groups,
         )
         np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
 

@@ -100,6 +100,25 @@ def step_setup():
 
 
 class TestTrainStep:
+    def test_vgg16_step_with_dropout_rng(self):
+        # the VGG16 tail's dropout draws a 'dropout' rng inside the jitted
+        # step; trimmed budgets keep the fc6 matmul small on CPU
+        from replication_faster_rcnn_tpu.config import ProposalConfig, ROITargetConfig
+
+        cfg = _tiny_cfg().replace(
+            model=ModelConfig(backbone="vgg16", roi_op="pool", compute_dtype="float32"),
+            proposals=ProposalConfig(pre_nms_train=128, post_nms_train=32),
+            roi_targets=ROITargetConfig(n_sample=8),
+        )
+        tx, _ = make_optimizer(cfg, steps_per_epoch=10)
+        model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
+        step = jax.jit(make_train_step(model, cfg, tx))
+        ds = SyntheticDataset(cfg.data, length=2)
+        batch = {k: jnp.asarray(v) for k, v in collate([ds[0], ds[1]]).items()}
+        new_state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        assert int(new_state.step) == 1
+
     def test_metrics_finite_and_params_update(self, step_setup):
         cfg, model, state, step, batch = step_setup
         new_state, metrics = step(state, batch)
