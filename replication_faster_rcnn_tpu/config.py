@@ -135,6 +135,11 @@ class ModelConfig:
     fpn_channels: int = 256  # P-level width (FPN paper)
     # compute dtype for conv stacks; params/losses stay float32
     compute_dtype: str = "bfloat16"
+    # mesh axis name for cross-replica (sync) BatchNorm — set ONLY when the
+    # model runs inside shard_map (`parallel/spmd.py`); under jit
+    # auto-partitioning the global-batch BN reduction happens automatically
+    # and a named axis here would be unbound.
+    bn_axis: Optional[str] = None
 
     def __post_init__(self):
         if self.roi_op not in ("align", "pool"):

@@ -201,14 +201,17 @@ def graft_into_variables(variables: Dict[str, Any], pth_path: str) -> Dict[str, 
         roi_size = int(round((fc6_rows // 512) ** 0.5))
         if roi_size * roi_size * 512 != fc6_rows:
             raise ValueError(f"unexpected fc6 in-features {fc6_rows}")
-        tp, lp = convert_vgg16(_load_state_dict(pth_path), roi_size=roi_size)
-        if lp["fc6"]["kernel"].shape[0] != fc6_rows:
+        state = _load_state_dict(pth_path)
+        # validate the CHECKPOINT side before reshaping: a mismatched
+        # roi_size would otherwise fold silently into the output dim
+        ckpt_in = state["classifier.0.weight"].shape[1]
+        if ckpt_in != fc6_rows:
             raise ValueError(
-                f"pretrained fc6 expects {lp['fc6']['kernel'].shape[0]} "
-                f"in-features but the model was built with {fc6_rows} "
-                f"(roi_size {roi_size}) — torchvision vgg16 checkpoints "
-                "require roi_size=7"
+                f"pretrained fc6 consumes {ckpt_in} in-features but the "
+                f"model was built with {fc6_rows} (roi_size {roi_size}) — "
+                "torchvision vgg16 checkpoints require roi_size=7"
             )
+        tp, lp = convert_vgg16(state, roi_size=roi_size)
         params["trunk"] = {**params["trunk"], **tp}
         head = dict(params.get("head", {}))
         head["tail"] = {**head.get("tail", {}), **lp}

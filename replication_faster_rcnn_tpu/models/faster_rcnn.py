@@ -52,7 +52,9 @@ class FasterRCNN(nn.Module):
             from replication_faster_rcnn_tpu.models.fpn import FPNNeck, ResNetFeatures
             from replication_faster_rcnn_tpu.models.head import FPNDetectionHead
 
-            self.trunk = ResNetFeatures(cfg.model.backbone, dtype)
+            self.trunk = ResNetFeatures(
+                cfg.model.backbone, dtype, bn_axis=cfg.model.bn_axis
+            )
             self.neck = FPNNeck(cfg.model.fpn_channels, dtype)
             self.rpn = RPNHead(
                 num_anchors=cfg.anchors.num_base_anchors,
@@ -71,7 +73,9 @@ class FasterRCNN(nn.Module):
 
                 self.trunk = VGG16Trunk(dtype)
             else:
-                self.trunk = ResNetTrunk(cfg.model.backbone, dtype)
+                self.trunk = ResNetTrunk(
+                    cfg.model.backbone, dtype, bn_axis=cfg.model.bn_axis
+                )
             # the head dispatches internally on arch (VGG16 fc6/fc7 tail
             # vs ResNet layer4 tail)
             self.rpn = RPNHead(
@@ -86,6 +90,7 @@ class FasterRCNN(nn.Module):
                 roi_op=cfg.model.roi_op,
                 sampling_ratio=cfg.model.roi_sampling_ratio,
                 dtype=dtype,
+                bn_axis=cfg.model.bn_axis,
             )
 
     # --- stage methods (used individually by the trainer) ---

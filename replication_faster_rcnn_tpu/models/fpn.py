@@ -46,19 +46,21 @@ class ResNetFeatures(nn.Module):
 
     arch: str = "resnet50"
     dtype: Any = jnp.bfloat16
+    bn_axis: Any = None
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> List[Array]:
         depths = _spec(self.arch)[1]
+        ax = self.bn_axis
         x = x.astype(self.dtype)
         x = _conv(64, 7, 2, 3, self.dtype, "conv1")(x)
-        x = _norm(self.dtype, train, "bn1")(x)
+        x = _norm(self.dtype, train, "bn1", ax)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
-        c2 = _stage(self.arch, x, _WIDTHS[0], depths[0], 1, self.dtype, train, "layer1")
-        c3 = _stage(self.arch, c2, _WIDTHS[1], depths[1], 2, self.dtype, train, "layer2")
-        c4 = _stage(self.arch, c3, _WIDTHS[2], depths[2], 2, self.dtype, train, "layer3")
-        c5 = _stage(self.arch, c4, _WIDTHS[3], depths[3], 2, self.dtype, train, "layer4")
+        c2 = _stage(self.arch, x, _WIDTHS[0], depths[0], 1, self.dtype, train, "layer1", ax)
+        c3 = _stage(self.arch, c2, _WIDTHS[1], depths[1], 2, self.dtype, train, "layer2", ax)
+        c4 = _stage(self.arch, c3, _WIDTHS[2], depths[2], 2, self.dtype, train, "layer3", ax)
+        c5 = _stage(self.arch, c4, _WIDTHS[3], depths[3], 2, self.dtype, train, "layer4", ax)
         return [c2, c3, c4, c5]
 
 
@@ -120,6 +122,12 @@ def multilevel_roi_align(
     feats: 4 arrays [N, Hl, Wl, C]; rois: [N, R, 4] image coords.
     Returns [N, R, out, out, C]. Every roi is aligned on every level and the
     results blended with a one-hot mask — static shapes, no partitioning.
+
+    Uses the gather roi_align method: the einsum (MXU) formulation's dense
+    [R, P, H] weight matmul is a win on the stride-16 single-scale map but
+    scales with H*W, which at P2 (stride 4, e.g. 150x150 for 600 input)
+    costs ~10x the whole backbone — random gathers are the right tool on
+    the fine levels.
     """
     levels = roi_levels(rois)  # [N, R]
     out = None
@@ -130,7 +138,11 @@ def multilevel_roi_align(
 
         def align_one(f: Array, rb: Array) -> Array:
             return roi_ops.roi_align(
-                f, rb * scale, out_size=out_size, sampling_ratio=sampling_ratio
+                f,
+                rb * scale,
+                out_size=out_size,
+                sampling_ratio=sampling_ratio,
+                method="gather",
             )
 
         crops = jax.vmap(align_one)(feat, rois)  # [N, R, s, s, C]

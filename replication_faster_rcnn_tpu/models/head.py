@@ -45,6 +45,7 @@ class DetectionHead(nn.Module):
     roi_op: str = "align"  # "align" | "pool"
     sampling_ratio: int = 2
     dtype: Any = jnp.bfloat16
+    bn_axis: Any = None  # sync-BN axis for the ResNet tail under shard_map
 
     @nn.compact
     def __call__(
@@ -84,7 +85,9 @@ class DetectionHead(nn.Module):
 
             embed = VGG16Tail(self.dtype, name="tail")(crops, train)
         else:
-            embed = ResNetTail(self.arch, self.dtype, name="tail")(crops, train)
+            embed = ResNetTail(
+                self.arch, self.dtype, bn_axis=self.bn_axis, name="tail"
+            )(crops, train)
         embed = embed.astype(jnp.float32)  # [N*R, C_tail]
 
         # Paper-standard inits the reference leaves at torch defaults:

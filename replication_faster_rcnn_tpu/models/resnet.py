@@ -31,15 +31,20 @@ import jax.numpy as jnp
 Array = jnp.ndarray
 
 
-def _norm(dtype: Any, train: bool, name: str) -> nn.BatchNorm:
+def _norm(dtype: Any, train: bool, name: str, axis_name: Any = None) -> nn.BatchNorm:
     """BatchNorm matching torch defaults (eps 1e-5, momentum 0.1 — i.e.
-    running = 0.9 * running + 0.1 * batch). Stats/scale kept in float32."""
+    running = 0.9 * running + 0.1 * batch). Stats/scale kept in float32.
+
+    ``axis_name`` enables cross-replica (sync) BN under the explicit
+    shard_map backend: batch statistics pmean over that mesh axis, matching
+    what jit auto-partitioning computes on a globally-sharded batch."""
     return nn.BatchNorm(
         use_running_average=not train,
         momentum=0.9,
         epsilon=1e-5,
         dtype=dtype,
         param_dtype=jnp.float32,
+        axis_name=axis_name,
         name=name,
     )
 
@@ -141,18 +146,19 @@ class BasicBlock(nn.Module):
     stride: int = 1
     downsample: bool = False
     dtype: Any = jnp.bfloat16
+    bn_axis: Any = None
 
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
         identity = x
         out = _conv(self.features, 3, self.stride, 1, self.dtype, "conv1")(x)
-        out = _norm(self.dtype, train, "bn1")(out)
+        out = _norm(self.dtype, train, "bn1", self.bn_axis)(out)
         out = nn.relu(out)
         out = _conv(self.features, 3, 1, 1, self.dtype, "conv2")(out)
-        out = _norm(self.dtype, train, "bn2")(out)
+        out = _norm(self.dtype, train, "bn2", self.bn_axis)(out)
         if self.downsample:
             identity = _conv(self.features, 1, self.stride, 0, self.dtype, "downsample_conv")(x)
-            identity = _norm(self.dtype, train, "downsample_bn")(identity)
+            identity = _norm(self.dtype, train, "downsample_bn", self.bn_axis)(identity)
         return nn.relu(out + identity)
 
 
@@ -170,6 +176,7 @@ class Bottleneck(nn.Module):
     dtype: Any = jnp.bfloat16
     groups: int = 1
     base_width: int = 64
+    bn_axis: Any = None
     expansion: int = 4
 
     @nn.compact
@@ -177,18 +184,18 @@ class Bottleneck(nn.Module):
         identity = x
         width = int(self.features * (self.base_width / 64.0)) * self.groups
         out = _conv(width, 1, 1, 0, self.dtype, "conv1")(x)
-        out = _norm(self.dtype, train, "bn1")(out)
+        out = _norm(self.dtype, train, "bn1", self.bn_axis)(out)
         out = nn.relu(out)
         out = _conv(width, 3, self.stride, 1, self.dtype, "conv2", self.groups)(out)
-        out = _norm(self.dtype, train, "bn2")(out)
+        out = _norm(self.dtype, train, "bn2", self.bn_axis)(out)
         out = nn.relu(out)
         out = _conv(self.features * self.expansion, 1, 1, 0, self.dtype, "conv3")(out)
-        out = _norm(self.dtype, train, "bn3")(out)
+        out = _norm(self.dtype, train, "bn3", self.bn_axis)(out)
         if self.downsample:
             identity = _conv(
                 self.features * self.expansion, 1, self.stride, 0, self.dtype, "downsample_conv"
             )(x)
-            identity = _norm(self.dtype, train, "downsample_bn")(identity)
+            identity = _norm(self.dtype, train, "downsample_bn", self.bn_axis)(identity)
         return nn.relu(out + identity)
 
 
@@ -219,6 +226,7 @@ def _stage(
     dtype: Any,
     train: bool,
     name: str,
+    bn_axis: Any = None,
 ) -> Array:
     block, _, groups, base_width = _spec(arch)
     out_ch = features * (4 if block is Bottleneck else 1)
@@ -232,6 +240,7 @@ def _stage(
             downsample=down,
             dtype=dtype,
             name=f"{name}.{i}",
+            bn_axis=bn_axis,
             **kw,
         )(x, train)
     return x
@@ -253,6 +262,7 @@ class ResNetTrunk(nn.Module):
     arch: str = "resnet18"
     dtype: Any = jnp.bfloat16
     stem: str = "imagenet"  # "imagenet" | "cifar"
+    bn_axis: Any = None  # mesh axis for sync-BN under shard_map
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
@@ -260,18 +270,19 @@ class ResNetTrunk(nn.Module):
         x = x.astype(self.dtype)
         if self.stem == "cifar":
             x = _conv(64, 3, 1, 1, self.dtype, "conv1")(x)
-            x = _norm(self.dtype, train, "bn1")(x)
+            x = _norm(self.dtype, train, "bn1", self.bn_axis)(x)
             x = nn.relu(x)
         else:
             x = _conv(64, 7, 2, 3, self.dtype, "conv1")(x)
-            x = _norm(self.dtype, train, "bn1")(x)
+            x = _norm(self.dtype, train, "bn1", self.bn_axis)(x)
             x = nn.relu(x)
             x = nn.max_pool(
                 x, window_shape=(3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
             )
-        x = _stage(self.arch, x, _WIDTHS[0], depths[0], 1, self.dtype, train, "layer1")
-        x = _stage(self.arch, x, _WIDTHS[1], depths[1], 2, self.dtype, train, "layer2")
-        x = _stage(self.arch, x, _WIDTHS[2], depths[2], 2, self.dtype, train, "layer3")
+        ax = self.bn_axis
+        x = _stage(self.arch, x, _WIDTHS[0], depths[0], 1, self.dtype, train, "layer1", ax)
+        x = _stage(self.arch, x, _WIDTHS[1], depths[1], 2, self.dtype, train, "layer2", ax)
+        x = _stage(self.arch, x, _WIDTHS[2], depths[2], 2, self.dtype, train, "layer3", ax)
         return x
 
 
@@ -286,12 +297,16 @@ class ResNetTail(nn.Module):
 
     arch: str = "resnet18"
     dtype: Any = jnp.bfloat16
+    bn_axis: Any = None
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
         depths = _spec(self.arch)[1]
         x = x.astype(self.dtype)
-        x = _stage(self.arch, x, _WIDTHS[3], depths[3], 2, self.dtype, train, "layer4")
+        x = _stage(
+            self.arch, x, _WIDTHS[3], depths[3], 2, self.dtype, train, "layer4",
+            self.bn_axis,
+        )
         return jnp.mean(x, axis=(1, 2))  # global avg pool == AdaptiveAvgPool2d(1)
 
 

@@ -8,7 +8,14 @@ torchvision's C++ kernels in the reference.
 
 * :func:`roi_align` — bilinear sampling on a fixed ``sampling_ratio^2`` grid
   per output bin, averaged (torchvision ROIAlign, aligned=False semantics).
-  Pure gathers + weighted sums: the preferred op on TPU.
+  Two implementations with identical numerics:
+    - ``method="einsum"`` (default): bilinear interpolation is separable,
+      so sampling IS a pair of batched matmuls — per-roi tent-weight
+      matrices ``WR [R, P, H]`` / ``WC [R, Q, W]`` contract the feature map
+      on the MXU. No gathers touch HBM: the TPU-native formulation.
+    - ``method="gather"``: 4-corner gathers + weighted sum (the direct
+      translation of the sampling definition); kept as the oracle and for
+      very large feature maps where the dense weight matrices would not pay.
 * :func:`roi_pool` — legacy quantized max pooling (round coords, +1 extents,
   floor/ceil bin edges, empty bins -> 0), matching the Caffe/torchvision
   ROIPool the reference uses. Implemented as masked maxes over the feature
@@ -59,38 +66,77 @@ def _bilinear_gather(feat: Array, r: Array, c: Array) -> Array:
     return gathered * in_range[..., None]
 
 
-@partial(jax.jit, static_argnames=("out_size", "sampling_ratio"))
-def roi_align(
-    feat: Array,
-    rois: Array,
-    out_size: int = 7,
-    sampling_ratio: int = 2,
-    spatial_scale: float = 1.0,
-) -> Array:
-    """ROIAlign: feat [H, W, C], rois [R, 4] -> [R, out, out, C].
-
-    Rois are in feature-map coordinates after multiplying by
-    ``spatial_scale`` (the reference pre-scales rois itself and passes
-    spatial_scale=1, `nets/heads.py:42-48`).
-    """
-    rois = rois * spatial_scale
-    s = sampling_ratio
+def _sample_grid(rois: Array, out_size: int, s: int, dtype) -> tuple:
+    """Continuous sample coordinates per roi: (rr [R, out*s], cc [R, out*s])."""
     r1, c1, r2, c2 = rois[:, 0], rois[:, 1], rois[:, 2], rois[:, 3]
     # aligned=False semantics: roi extent clamps to a 1px minimum.
     roi_h = jnp.maximum(r2 - r1, 1.0)
     roi_w = jnp.maximum(c2 - c1, 1.0)
     bin_h = roi_h / out_size  # [R]
     bin_w = roi_w / out_size
-
     # Sample offsets within a roi, in bin units: (p + (i + .5)/s) for output
     # bin p and sample i — shape [out*s].
-    pts = (jnp.arange(out_size * s, dtype=feat.dtype) + 0.5) / s  # in bin units
+    pts = (jnp.arange(out_size * s, dtype=dtype) + 0.5) / s
     rr = r1[:, None] + pts[None, :] * bin_h[:, None]  # [R, out*s]
     cc = c1[:, None] + pts[None, :] * bin_w[:, None]
-    rg = rr[:, :, None] * jnp.ones_like(cc)[:, None, :]  # [R, out*s, out*s]
-    cg = cc[:, None, :] * jnp.ones_like(rr)[:, :, None]
+    return rr, cc
 
-    sampled = _bilinear_gather(feat, rg, cg)  # [R, out*s, out*s, C]
+
+def _tent_weights(coords: Array, extent: int) -> Array:
+    """Per-point bilinear weight rows: coords [R, P] -> [R, P, extent].
+
+    Row p holds the two-tap interpolation weights of sample p against the
+    integer grid 0..extent-1 (a tent max(0, 1-|x-i|) after the gather
+    path's clamping), zeroed for points outside [-1, extent] (torchvision
+    border rule). Matches `_bilinear_gather` exactly: clamping to
+    [0, extent-1] collapses the tent to weight 1 at the border tap.
+    """
+    in_range = (coords >= -1.0) & (coords <= extent)
+    x = jnp.clip(coords, 0.0, extent - 1.0)
+    grid = jnp.arange(extent, dtype=coords.dtype)
+    w = jnp.maximum(0.0, 1.0 - jnp.abs(x[..., None] - grid))  # [R, P, extent]
+    return w * in_range[..., None]
+
+
+@partial(jax.jit, static_argnames=("out_size", "sampling_ratio", "method"))
+def roi_align(
+    feat: Array,
+    rois: Array,
+    out_size: int = 7,
+    sampling_ratio: int = 2,
+    spatial_scale: float = 1.0,
+    method: str = "einsum",
+) -> Array:
+    """ROIAlign: feat [H, W, C], rois [R, 4] -> [R, out, out, C].
+
+    Rois are in feature-map coordinates after multiplying by
+    ``spatial_scale`` (the reference pre-scales rois itself and passes
+    spatial_scale=1, `nets/heads.py:42-48`).
+
+    ``method="einsum"``: bilinear sampling is separable, so the whole op is
+    sampled[r,p,q,:] = WR[r,p,:] @ feat @ WC[r,q,:]^T — two batched
+    matmuls on the MXU, no gathers (each weight row has <= 2 nonzeros, but
+    dense-matmul beats random HBM access on TPU for detection-sized maps).
+    ``method="gather"``: the direct 4-corner gather implementation.
+    """
+    rois = rois * spatial_scale
+    s = sampling_ratio
+    rr, cc = _sample_grid(rois, out_size, s, feat.dtype)
+
+    if method == "einsum":
+        h, w = feat.shape[0], feat.shape[1]
+        wr = _tent_weights(rr, h)  # [R, P, H]
+        wc = _tent_weights(cc, w)  # [R, Q, W]
+        # [R, P, H] x [H, W, C] -> [R, P, W, C]; then contract W with WC.
+        rows = jnp.einsum("rph,hwc->rpwc", wr, feat)
+        sampled = jnp.einsum("rpwc,rqw->rpqc", rows, wc)
+    elif method == "gather":
+        rg = rr[:, :, None] * jnp.ones_like(cc)[:, None, :]  # [R, out*s, out*s]
+        cg = cc[:, None, :] * jnp.ones_like(rr)[:, :, None]
+        sampled = _bilinear_gather(feat, rg, cg)  # [R, out*s, out*s, C]
+    else:
+        raise ValueError(f"unknown roi_align method {method!r}")
+
     r_, c_ = sampled.shape[0], sampled.shape[-1]
     sampled = sampled.reshape(r_, out_size, s, out_size, s, c_)
     return sampled.mean(axis=(2, 4))

@@ -6,3 +6,6 @@ from replication_faster_rcnn_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     shard_batch,
 )
+from replication_faster_rcnn_tpu.parallel.spmd import (  # noqa: F401
+    make_shard_map_train_step,
+)

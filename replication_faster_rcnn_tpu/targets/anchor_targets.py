@@ -95,10 +95,21 @@ def batched_anchor_targets(
     gt_mask: Array,
     anchors: Array,
     cfg: RPNTargetConfig,
+    positions: Array = None,
 ) -> Tuple[Array, Array]:
     """vmap over the batch: gt_boxes [N, G, 4], gt_mask [N, G] ->
-    (reg [N, A, 4], labels [N, A])."""
-    keys = jax.random.split(rng, gt_boxes.shape[0])
+    (reg [N, A, 4], labels [N, A]).
+
+    ``positions`` (global batch positions, [N] int) makes the per-image
+    keys sharding-invariant — fold_in(rng, position) gives each image the
+    same key whether the batch is whole (jit auto-partitioning) or a
+    shard_map slice (`parallel/spmd.py`). Without it, keys are split by
+    local batch size (fine when every caller sees the full batch).
+    """
+    if positions is None:
+        keys = jax.random.split(rng, gt_boxes.shape[0])
+    else:
+        keys = jax.vmap(lambda p: jax.random.fold_in(rng, p))(positions)
     return jax.vmap(lambda k, b, m: anchor_targets(k, b, m, anchors, cfg))(
         keys, gt_boxes, gt_mask
     )

@@ -111,10 +111,18 @@ def batched_proposal_targets(
     gt_labels: Array,
     gt_mask: Array,
     cfg: ROITargetConfig,
+    positions: Array = None,
 ) -> Tuple[Array, Array, Array]:
     """vmap over the batch: rois [N, R, 4] -> (sample_rois [N, S, 4],
-    reg [N, S, 4], labels [N, S])."""
-    keys = jax.random.split(rng, rois.shape[0])
+    reg [N, S, 4], labels [N, S]).
+
+    ``positions`` makes per-image keys sharding-invariant (global
+    fold_in instead of local split — see batched_anchor_targets).
+    """
+    if positions is None:
+        keys = jax.random.split(rng, rois.shape[0])
+    else:
+        keys = jax.vmap(lambda p: jax.random.fold_in(rng, p))(positions)
     return jax.vmap(
         lambda k, r, v, b, l, m: proposal_targets(k, r, v, b, l, m, cfg)
     )(keys, rois, roi_valid, gt_boxes, gt_labels, gt_mask)

@@ -13,14 +13,27 @@ Under `jax.jit` auto-partitioning these global reductions become XLA
 cross-replica collectives on a sharded batch, so data-parallel training is
 bit-for-bit the same objective as single-device — the psum'd allreduce of
 the BASELINE north star falls out of the sharding, not hand-written comms.
+
+Under the explicit `shard_map` backend (`parallel/spmd.py`) each shard sees
+only its local batch slice, so the batch-global normalizers must be summed
+across shards by hand: pass ``axis_name`` and the positive/valid counts are
+`lax.psum`'d over that mesh axis before dividing, keeping the objective
+identical to the auto-partitioned path.
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
+import jax
 import jax.numpy as jnp
 import optax
 
 Array = jnp.ndarray
+
+
+def _global_sum(x: Array, axis_name: Optional[str]) -> Array:
+    return jax.lax.psum(x, axis_name) if axis_name else x
 
 
 def smooth_l1(pred: Array, target: Array, sigma: float = 1.0) -> Array:
@@ -31,27 +44,36 @@ def smooth_l1(pred: Array, target: Array, sigma: float = 1.0) -> Array:
 
 
 def loc_loss(
-    pred: Array, target: Array, labels: Array, sigma: float = 1.0
+    pred: Array,
+    target: Array,
+    labels: Array,
+    sigma: float = 1.0,
+    axis_name: Optional[str] = None,
 ) -> Array:
     """Localization loss on positive samples only (labels > 0), summed and
     normalized by max(#pos, 1) over the whole batch (`train.py:40-57`).
 
-    pred/target: [..., 4]; labels: [...] with >0 = positive.
+    pred/target: [..., 4]; labels: [...] with >0 = positive. With
+    ``axis_name``, #pos is the global count across that mesh axis (the
+    local sum/global count quotient psums to the global quotient).
     """
     pos = (labels > 0).astype(pred.dtype)
     per = smooth_l1(pred, target, sigma).sum(-1)  # [...]
-    n_pos = jnp.maximum(pos.sum(), 1.0)
+    n_pos = jnp.maximum(_global_sum(pos.sum(), axis_name), 1.0)
     return (per * pos).sum() / n_pos
 
 
-def ignore_cross_entropy(logits: Array, labels: Array) -> Array:
+def ignore_cross_entropy(
+    logits: Array, labels: Array, axis_name: Optional[str] = None
+) -> Array:
     """Softmax CE averaged over entries with label >= 0 (torch
     ``ignore_index=-1`` semantics, `train.py:83,121`).
 
-    logits: [..., C]; labels: [...] int with -1 = ignore.
+    logits: [..., C]; labels: [...] int with -1 = ignore. With
+    ``axis_name``, the mean is over the global valid count.
     """
     valid = labels >= 0
     safe = jnp.where(valid, labels, 0).astype(jnp.int32)
     ce = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
-    n = jnp.maximum(valid.sum(), 1)
+    n = jnp.maximum(_global_sum(valid.sum(), axis_name), 1)
     return jnp.where(valid, ce, 0.0).sum() / n

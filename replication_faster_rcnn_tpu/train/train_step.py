@@ -77,8 +77,16 @@ def compute_losses(
     batch: Dict[str, Array],
     rng: Array,
     train: bool = True,
+    axis_name: str = None,
+    positions: Array = None,
 ) -> Tuple[Array, Tuple[Dict[str, Array], Any]]:
-    """Forward + 4 losses. Returns (total, (metrics, new_batch_stats))."""
+    """Forward + 4 losses. Returns (total, (metrics, new_batch_stats)).
+
+    ``axis_name``/``positions`` support the explicit shard_map backend
+    (`parallel/spmd.py`): loss normalizers psum over the axis, per-image
+    sampling keys fold in the global batch position so the objective and
+    randomness match the jit auto-partitioned path exactly.
+    """
     images = batch["image"]
     gt_boxes = batch["boxes"]
     gt_labels = batch["labels"]
@@ -86,8 +94,15 @@ def compute_losses(
     img_h, img_w = float(images.shape[1]), float(images.shape[2])
     variables = {"params": params, "batch_stats": batch_stats}
     sigma = config.train.smooth_l1_sigma
+    if positions is None:
+        positions = jnp.arange(images.shape[0], dtype=jnp.int32)
 
     rng_at, rng_pt, rng_do = jax.random.split(rng, 3)
+    if axis_name is not None:
+        # decorrelate dropout across shards (rng is replicated; without this
+        # every shard would draw the same mask). Sampling rngs stay
+        # shard-invariant — their per-image keys fold in global positions.
+        rng_do = jax.random.fold_in(rng_do, jax.lax.axis_index(axis_name))
 
     # trunk + RPN (train mode: BN batch stats update)
     feat, mut = model.apply(
@@ -97,17 +112,18 @@ def compute_losses(
 
     # first-stage targets, on device
     reg_t, lab_t = batched_anchor_targets(
-        rng_at, gt_boxes, gt_mask, anchors, config.rpn_targets
+        rng_at, gt_boxes, gt_mask, anchors, config.rpn_targets, positions
     )
-    rpn_reg_loss = losses.loc_loss(deltas, reg_t, lab_t, sigma)
-    rpn_cls_loss = losses.ignore_cross_entropy(logits, lab_t)
+    rpn_reg_loss = losses.loc_loss(deltas, reg_t, lab_t, sigma, axis_name)
+    rpn_cls_loss = losses.ignore_cross_entropy(logits, lab_t, axis_name)
 
     # proposals (stop-grad, reference detach semantics) + second-stage targets
     rois, roi_valid = model.apply(
         variables, logits, deltas, anchors, img_h, img_w, train, method="propose"
     )
     sample_rois, reg_t2, lab_t2 = batched_proposal_targets(
-        rng_pt, rois, roi_valid, gt_boxes, gt_labels, gt_mask, config.roi_targets
+        rng_pt, rois, roi_valid, gt_boxes, gt_labels, gt_mask, config.roi_targets,
+        positions,
     )
 
     # head on the sampled rois (BN in the tail also updates; the VGG16
@@ -124,8 +140,8 @@ def compute_losses(
         rngs={"dropout": rng_do} if train else None,
     )
     reg_sel = select_class_deltas(reg_out, lab_t2)
-    head_reg_loss = losses.loc_loss(reg_sel, reg_t2, lab_t2, sigma)
-    head_cls_loss = losses.ignore_cross_entropy(cls_out, lab_t2)
+    head_reg_loss = losses.loc_loss(reg_sel, reg_t2, lab_t2, sigma, axis_name)
+    head_cls_loss = losses.ignore_cross_entropy(cls_out, lab_t2, axis_name)
 
     w1, w2, w3, w4 = config.train.loss_weights
     total = (

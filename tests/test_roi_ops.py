@@ -57,6 +57,44 @@ def test_roi_align_border_rois():
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
+def test_roi_align_einsum_matches_gather():
+    """The MXU formulation (separable tent-weight matmuls) must reproduce
+    the direct 4-corner-gather implementation exactly, including rois
+    crossing the border and degenerate (sub-pixel) rois."""
+    rng = np.random.default_rng(3)
+    feat, rois = _rand_feat_rois(rng, h=11, w=9, c=4, n=8)
+    rois = np.concatenate(
+        [
+            rois,
+            np.array(
+                [[-0.9, -0.9, 3.0, 3.0], [8.0, 6.0, 12.0, 10.0], [2.2, 2.2, 2.3, 2.3]],
+                np.float32,
+            ),
+        ]
+    )
+    a = np.asarray(
+        roi_ops.roi_align(jnp.array(feat), jnp.array(rois), 7, 2, method="einsum")
+    )
+    b = np.asarray(
+        roi_ops.roi_align(jnp.array(feat), jnp.array(rois), 7, 2, method="gather")
+    )
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_roi_align_einsum_grads_match_gather():
+    rng = np.random.default_rng(4)
+    feat, rois = _rand_feat_rois(rng, h=10, w=10, c=3, n=5)
+
+    def loss(f, method):
+        return (
+            roi_ops.roi_align(f, jnp.array(rois), 5, 2, method=method) ** 2
+        ).sum()
+
+    ga = jax.grad(lambda f: loss(f, "einsum"))(jnp.array(feat))
+    gb = jax.grad(lambda f: loss(f, "gather"))(jnp.array(feat))
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(gb), rtol=1e-4, atol=1e-5)
+
+
 def test_roi_ops_vmap_over_batch():
     rng = np.random.default_rng(3)
     feats = np.stack([_rand_feat_rois(rng)[0] for _ in range(3)])
