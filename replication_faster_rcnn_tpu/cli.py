@@ -51,6 +51,8 @@ def _build_config(args):
         train_kw["n_epoch"] = args.epochs
     if args.seed is not None:
         train_kw["seed"] = args.seed
+    if getattr(args, "backend", None):
+        train_kw["backend"] = args.backend
     if train_kw:
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **train_kw))
     if args.backbone or args.roi_op:
@@ -72,12 +74,17 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--data-root", default=None)
     p.add_argument("--image-size", type=int, default=None)
     p.add_argument("--backbone", default=None,
-                   choices=[None, "resnet18", "resnet34", "resnet50", "resnet101"])
+                   choices=[None, "resnet18", "resnet34", "resnet50", "resnet101",
+                            "resnet152", "resnext50_32x4d", "resnext101_32x8d",
+                            "wide_resnet50_2", "wide_resnet101_2", "vgg16"])
     p.add_argument("--roi-op", default=None, choices=[None, "align", "pool"])
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--backend", default=None, choices=[None, "auto", "spmd"],
+                   help="SPMD backend: jit auto-partitioning or explicit "
+                        "shard_map collectives (parallel/spmd.py)")
 
 
 def cmd_train(args) -> int:

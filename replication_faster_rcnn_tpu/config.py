@@ -200,6 +200,14 @@ class TrainConfig:
     seed: int = 0
     # loss weights: the reference sums the 4 losses unweighted (train.py:123)
     loss_weights: Tuple[float, float, float, float] = (1.0, 1.0, 1.0, 1.0)
+    # SPMD backend: "auto" = jit auto-partitioning (XLA places collectives),
+    # "spmd" = explicit shard_map step with hand-placed psums + sync-BN
+    # (`parallel/spmd.py`); both compute the same update (tested).
+    backend: str = "auto"
+
+    def __post_init__(self):
+        if self.backend not in ("auto", "spmd"):
+            raise ValueError(f"backend must be 'auto' or 'spmd', got {self.backend!r}")
 
 
 @dataclasses.dataclass(frozen=True)

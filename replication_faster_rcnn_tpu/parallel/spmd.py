@@ -90,7 +90,7 @@ def make_shard_map_train_step(
         grads = jax.lax.psum(grads, axis)
         # loss/count metrics are local-contribution / global-normalizer (or
         # plain local counts), so psum yields the batch-global values.
-        metrics = {k: jax.lax.psum(v, axis) for k, v in metrics.items()}
+        metrics = jax.lax.psum(metrics, axis)
         metrics["grad_norm"] = optax.global_norm(grads)
 
         updates, new_opt = tx.update(grads, state.opt_state, state.params)

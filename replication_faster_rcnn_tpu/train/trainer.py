@@ -93,8 +93,17 @@ class Trainer:
         )
         self.state: TrainState = replicate_tree(state, self.mesh)
 
-        step_fn = make_train_step(self.model, config, self.tx)
-        self.jitted_step = jax.jit(step_fn, donate_argnums=(0,))
+        if config.train.backend == "spmd":
+            from replication_faster_rcnn_tpu.parallel import make_shard_map_train_step
+
+            # explicit-collective step (psum allreduce + sync-BN); the
+            # parameter tree is identical, so eval/checkpoints are unchanged
+            self.jitted_step, _ = make_shard_map_train_step(
+                config, self.tx, self.mesh
+            )
+        else:
+            step_fn = make_train_step(self.model, config, self.tx)
+            self.jitted_step = jax.jit(step_fn, donate_argnums=(0,))
         self._ckpt_mgr = None
 
     # ---------------------------------------------------------- checkpoints

@@ -89,6 +89,22 @@ def test_dp8_matches_single_device():
     np.testing.assert_allclose(p1, p8, rtol=1e-4, atol=1e-6)
 
 
+def test_trainer_spmd_backend(tmp_path):
+    """Trainer with train.backend='spmd' runs the explicit-collective step."""
+    import dataclasses
+
+    from replication_faster_rcnn_tpu.train import Trainer
+
+    cfg = _cfg(8)
+    cfg = cfg.replace(
+        train=dataclasses.replace(cfg.train, backend="spmd", n_epoch=1)
+    )
+    trainer = Trainer(cfg, workdir=str(tmp_path))
+    batch = collate([trainer.dataset[i] for i in range(8)])
+    metrics = trainer.train_one_batch(batch)
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
 def test_shard_map_step_matches_jit_auto():
     """The explicit-collective shard_map backend (hand-placed psums,
     sync-BN, global-position sampling keys) must compute the same update
