@@ -53,6 +53,8 @@ def _build_config(args):
         train_kw["seed"] = args.seed
     if getattr(args, "backend", None):
         train_kw["backend"] = args.backend
+    if getattr(args, "eval_every", None) is not None:
+        train_kw["eval_every_epochs"] = args.eval_every
     if train_kw:
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **train_kw))
     if args.backbone or args.roi_op:
@@ -187,6 +189,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_train.add_argument("--resume", action="store_true")
     p_train.add_argument("--pretrained-backbone", default=None,
                          help="torch resnet .pth to graft (reference readme.md:10-12)")
+    p_train.add_argument("--eval-every", type=int, default=None,
+                         help="run val mAP every N epochs (0 = never)")
     p_train.set_defaults(fn=cmd_train)
 
     p_eval = sub.add_parser("eval", help="evaluate mAP")

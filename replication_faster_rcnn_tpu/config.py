@@ -204,6 +204,8 @@ class TrainConfig:
     # "spmd" = explicit shard_map step with hand-placed psums + sync-BN
     # (`parallel/spmd.py`); both compute the same update (tested).
     backend: str = "auto"
+    # run the mAP evaluator on the val split every N epochs (0 = off)
+    eval_every_epochs: int = 0
 
     def __post_init__(self):
         if self.backend not in ("auto", "spmd"):

@@ -1,5 +1,6 @@
 from replication_faster_rcnn_tpu.parallel.mesh import (  # noqa: F401
     batch_sharding,
+    fit_data_parallelism,
     initialize_distributed,
     make_mesh,
     replicate_tree,

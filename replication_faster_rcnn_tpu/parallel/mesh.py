@@ -46,6 +46,20 @@ def initialize_distributed(
         )
 
 
+def fit_data_parallelism(batch_size: int, n_devices: int) -> int:
+    """Largest data-parallel degree <= n_devices that divides batch_size.
+
+    A batch that does not divide over the mesh fails inside jit with an
+    opaque sharding error (the reference's default batch of 2 on an 8-chip
+    host, for instance); shrinking the data axis to the largest usable
+    divisor keeps small-batch runs working, at reduced parallelism.
+    """
+    for d in range(min(batch_size, n_devices), 0, -1):
+        if batch_size % d == 0:
+            return d
+    return 1
+
+
 def make_mesh(cfg: MeshConfig, devices: Optional[Sequence[Any]] = None) -> Mesh:
     """Build the (data, model) mesh. num_data == -1 uses every device."""
     devices = list(devices if devices is not None else jax.devices())

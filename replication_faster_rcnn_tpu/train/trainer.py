@@ -14,6 +14,7 @@
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import time
 from typing import Dict, Iterable, Optional
@@ -25,6 +26,7 @@ from replication_faster_rcnn_tpu.config import FasterRCNNConfig
 from replication_faster_rcnn_tpu.data import DataLoader, make_dataset
 from replication_faster_rcnn_tpu.parallel import (
     batch_sharding,
+    fit_data_parallelism,
     make_mesh,
     replicate_tree,
     shard_batch,
@@ -74,6 +76,20 @@ class Trainer:
     ) -> None:
         self.config = config
         self.workdir = workdir
+        if config.mesh.num_data <= 0:
+            # fit the data axis to the batch (a non-dividing batch fails in
+            # jit with an opaque sharding error — e.g. the reference's
+            # default batch 2 on an 8-chip host), leaving room for any
+            # model-parallel axis
+            n_dev = len(devices) if devices is not None else len(jax.devices())
+            n_dev //= max(1, config.mesh.num_model)
+            config = config.replace(
+                mesh=dataclasses.replace(
+                    config.mesh,
+                    num_data=fit_data_parallelism(config.train.batch_size, n_dev),
+                )
+            )
+            self.config = config
         self.mesh = make_mesh(config.mesh, devices)
         self.logger = MetricLogger()
 
@@ -177,6 +193,26 @@ class Trainer:
         self.state, metrics = self.jitted_step(self.state, device_batch)
         return metrics
 
+    def evaluate(self, max_images: Optional[int] = None) -> Dict[str, float]:
+        """mAP on the val split with the CURRENT training parameters
+        (reference: impossible — its eval was never written, SURVEY §2.1 #15).
+
+        The val dataset and the Evaluator (whose inference fn is jitted)
+        are built once and cached, so per-epoch eval pays no recompile."""
+        if getattr(self, "_evaluator", None) is None:
+            from replication_faster_rcnn_tpu.eval import Evaluator
+
+            self._val_dataset = make_dataset(self.config.data, "val")
+            self._evaluator = Evaluator(self.config, self.model)
+        variables = {
+            "params": self.state.params,
+            "batch_stats": self.state.batch_stats,
+        }
+        return self._evaluator.evaluate(
+            variables, self._val_dataset, batch_size=self.config.train.batch_size,
+            max_images=max_images,
+        )
+
     def train(self, log_every: int = 10, resume: bool = False) -> Dict[str, float]:
         """Run cfg.train.n_epoch epochs. The epoch count lives in the config
         (not a parameter) because the cosine schedule was built from it —
@@ -189,6 +225,7 @@ class Trainer:
         step = start_step  # host-side mirror: no device sync to read it
 
         last: Dict[str, float] = {}
+        eval_result: Dict[str, float] = {}
         for epoch in range(start_epoch, cfg.n_epoch):
             self.loader.set_epoch(epoch)
             t_epoch = time.time()
@@ -205,8 +242,13 @@ class Trainer:
             jax.device_get(jax.tree_util.tree_leaves(self.state.params)[0])
             dt = time.time() - t_epoch
             self.logger.log_epoch(epoch, n_images / dt if dt > 0 else 0.0)
+            if cfg.eval_every_epochs and (epoch + 1) % cfg.eval_every_epochs == 0:
+                eval_result = {"mAP": float(self.evaluate()["mAP"])}
+                self.logger.log(step, eval_result)
             if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
                 self.save()
         if last:
             last = {k: float(v) for k, v in last.items()}
+        # merged last so step-metric logging cannot wipe the eval result
+        last.update(eval_result)
         return last

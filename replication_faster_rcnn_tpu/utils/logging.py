@@ -14,10 +14,18 @@ from typing import Dict, Optional, TextIO
 
 
 class MetricLogger:
-    def __init__(self, stream: TextIO = sys.stdout, jsonl_path: Optional[str] = None):
-        self.stream = stream
+    def __init__(
+        self, stream: Optional[TextIO] = None, jsonl_path: Optional[str] = None
+    ):
+        # None = resolve sys.stdout at write time: a default bound at import
+        # time pins whatever stdout was then (stale under redirection)
+        self._stream = stream
         self.jsonl_path = jsonl_path
         self._t0 = time.time()
+
+    @property
+    def stream(self) -> TextIO:
+        return self._stream if self._stream is not None else sys.stdout
 
     def _write_jsonl(self, record: Dict) -> None:
         if self.jsonl_path:

@@ -89,6 +89,32 @@ def test_dp8_matches_single_device():
     np.testing.assert_allclose(p1, p8, rtol=1e-4, atol=1e-6)
 
 
+def test_fit_data_parallelism():
+    from replication_faster_rcnn_tpu.parallel import fit_data_parallelism
+
+    assert fit_data_parallelism(2, 8) == 2  # reference's default batch
+    assert fit_data_parallelism(8, 8) == 8
+    assert fit_data_parallelism(12, 8) == 6
+    assert fit_data_parallelism(7, 8) == 7
+    assert fit_data_parallelism(1, 8) == 1
+
+
+def test_trainer_fits_mesh_to_small_batch(tmp_path):
+    """batch 2 on an 8-device host must train (data axis shrinks to 2)
+    instead of failing with a sharding error."""
+    import dataclasses
+
+    from replication_faster_rcnn_tpu.train import Trainer
+
+    cfg = _cfg(-1)
+    cfg = cfg.replace(train=dataclasses.replace(cfg.train, batch_size=2))
+    trainer = Trainer(cfg, workdir=str(tmp_path))
+    assert trainer.mesh.shape["data"] == 2
+    batch = collate([trainer.dataset[i] for i in range(2)])
+    metrics = trainer.train_one_batch(batch)
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
 def test_trainer_spmd_backend(tmp_path):
     """Trainer with train.backend='spmd' runs the explicit-collective step."""
     import dataclasses

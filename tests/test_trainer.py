@@ -42,6 +42,21 @@ def trained(tmp_path_factory):
 
 
 class TestTrainer:
+    def test_eval_during_training(self, tmp_path):
+        import dataclasses
+
+        cfg = _cfg(n_epoch=1)
+        cfg = cfg.replace(
+            train=dataclasses.replace(cfg.train, eval_every_epochs=1),
+            eval=dataclasses.replace(
+                cfg.eval, max_detections=10
+            ),
+        )
+        ds = SyntheticDataset(cfg.data, length=8)
+        tr = Trainer(cfg, workdir=str(tmp_path), dataset=ds)
+        metrics = tr.train(log_every=1)
+        assert "mAP" in metrics and np.isfinite(metrics["mAP"])
+
     def test_epoch_runs_and_loss_finite(self, trained):
         cfg, workdir, tr, metrics = trained
         assert metrics and np.isfinite(metrics["loss"])
