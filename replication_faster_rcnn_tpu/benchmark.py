@@ -17,15 +17,58 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 
 import jax
 import numpy as np
 
 
+def _arm_watchdog() -> threading.Timer:
+    """Print a diagnostic JSON line and exit if the measurement wedges.
+
+    The remote-TPU tunnel in this image can hang indefinitely inside a
+    compile (no Python-level interrupt possible); without this the driver
+    would record nothing at all. BENCH_WATCHDOG_S overrides the budget.
+    Returns the timer; cancel it once the measurement completes.
+    """
+    budget = float(os.environ.get("BENCH_WATCHDOG_S", "1500"))
+
+    def fire():
+        print(
+            json.dumps(
+                {
+                    "metric": "train_images_per_sec_600x600",
+                    "value": 0.0,
+                    "unit": "images/sec",
+                    "vs_baseline": None,
+                    "error": f"watchdog: device wedged >{budget:.0f}s "
+                    "(remote compile tunnel hang)",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(2)
+
+    t = threading.Timer(budget, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main(config=None) -> None:
     """Measure the jitted train step of ``config`` (default: the flagship
     voc_resnet18 at 600x600, batch 8/device) on all available devices."""
+    watchdog = _arm_watchdog()
+    try:
+        _measure(config)
+    finally:
+        # a raised exception must not leave the timer alive to later print a
+        # bogus zero-metric line and os._exit a host process
+        watchdog.cancel()
+
+
+def _measure(config) -> None:
     import dataclasses
 
     from replication_faster_rcnn_tpu.config import (
@@ -90,6 +133,8 @@ def main(config=None) -> None:
     jax.device_get(metrics)  # forces the whole dependency chain
     dt = time.time() - t0
     images_per_sec = n_steps * batch_size / dt
+
+    watchdog.cancel()
 
     baseline_path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),

@@ -137,6 +137,20 @@ def cmd_eval(args) -> int:
         )
     else:
         print(f"mAP@{cfg.eval.iou_thresh}: {result['mAP']:.4f}")
+    if args.per_class and "ap_per_class" in result:
+        import numpy as np
+
+        from replication_faster_rcnn_tpu.config import COCO_CLASSES, VOC_CLASSES
+
+        names = {len(VOC_CLASSES): VOC_CLASSES, len(COCO_CLASSES): COCO_CLASSES}.get(
+            cfg.model.num_classes,
+            [str(i) for i in range(cfg.model.num_classes)],
+        )
+        aps = result["ap_per_class"]
+        for c in range(1, cfg.model.num_classes):
+            ap = aps[c]
+            shown = "   n/a" if not np.isfinite(ap) else f"{ap:6.4f}"
+            print(f"  {names[c]:>16s}  AP {shown}")
     return 0
 
 
@@ -199,6 +213,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_eval.add_argument("--split", default="val")
     p_eval.add_argument("--checkpoint-step", type=int, default=None)
     p_eval.add_argument("--max-images", type=int, default=None)
+    p_eval.add_argument("--per-class", action="store_true",
+                        help="print the per-class AP table")
     p_eval.set_defaults(fn=cmd_eval)
 
     p_bench = sub.add_parser("bench", help="train-step throughput")

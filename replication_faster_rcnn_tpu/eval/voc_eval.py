@@ -180,6 +180,7 @@ def coco_map(
         for cls in range(1, num_classes)
     }
     per_thresh = []
+    per_thresh_cls = []
     for t in iou_thresholds:
         aps = np.asarray(
             [
@@ -187,9 +188,19 @@ def coco_map(
                 for cls in range(1, num_classes)
             ]
         )
+        per_thresh_cls.append(aps)
         valid = ~np.isnan(aps)
         per_thresh.append(float(aps[valid].mean()) if valid.any() else 0.0)
     out = {"mAP": float(np.mean(per_thresh))}
+    # per-class AP averaged over the threshold sweep (nan where no gt —
+    # computed by hand to avoid nanmean's empty-slice warning)
+    stacked = np.stack(per_thresh_cls)  # [T, num_classes-1]
+    finite = np.isfinite(stacked)
+    counts = finite.sum(axis=0)
+    sums = np.where(finite, stacked, 0.0).sum(axis=0)
+    ap_per_class = np.full(num_classes, np.nan)
+    ap_per_class[1:] = np.where(counts > 0, sums / np.maximum(counts, 1), np.nan)
+    out["ap_per_class"] = ap_per_class
     for t, v in zip(iou_thresholds, per_thresh):
         if abs(t - 0.5) < 1e-9:
             out["AP50"] = v

@@ -50,6 +50,54 @@ class TestConfigPlumbing:
             cli._build_config(_args(["--config", "nope"]))
 
 
+class TestEvalSmoke:
+    def test_eval_per_class_table(self, tmp_path, capsys):
+        rc = cli.main(
+            [
+                "eval",
+                "--dataset", "synthetic",
+                "--image-size", "64",
+                "--batch-size", "2",
+                "--max-images", "2",
+                "--per-class",
+                "--workdir", str(tmp_path),  # no checkpoint: fresh init
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "mAP@0.5" in out
+        assert "aeroplane" in out  # per-class table rendered with VOC names
+
+
+class TestBenchWatchdog:
+    def test_watchdog_fires_on_wedge(self):
+        """If the device wedges, bench must emit a diagnostic JSON line and
+        exit instead of hanging the driver."""
+        import json
+        import subprocess
+        import sys as _sys
+
+        proc = subprocess.run(
+            [
+                _sys.executable,
+                "-c",
+                "import os, time;"
+                "os.environ['BENCH_WATCHDOG_S']='0.3';"
+                "from replication_faster_rcnn_tpu.benchmark import _arm_watchdog;"
+                "_arm_watchdog(); time.sleep(30)",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=60,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu",
+                 "PALLAS_AXON_POOL_IPS": ""},
+        )
+        assert proc.returncode == 2
+        line = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert line["value"] == 0.0
+        assert "watchdog" in line["error"]
+
+
 class TestTrainSmoke:
     def test_bounded_steps(self, tmp_path, capsys):
         rc = cli.main(
