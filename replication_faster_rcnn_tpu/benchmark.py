@@ -134,8 +134,6 @@ def _measure(config) -> None:
     dt = time.time() - t0
     images_per_sec = n_steps * batch_size / dt
 
-    watchdog.cancel()
-
     baseline_path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "benchmarks",

@@ -192,14 +192,11 @@ def coco_map(
         valid = ~np.isnan(aps)
         per_thresh.append(float(aps[valid].mean()) if valid.any() else 0.0)
     out = {"mAP": float(np.mean(per_thresh))}
-    # per-class AP averaged over the threshold sweep (nan where no gt —
-    # computed by hand to avoid nanmean's empty-slice warning)
-    stacked = np.stack(per_thresh_cls)  # [T, num_classes-1]
-    finite = np.isfinite(stacked)
-    counts = finite.sum(axis=0)
-    sums = np.where(finite, stacked, 0.0).sum(axis=0)
+    # per-class AP averaged over the threshold sweep. A class's AP is NaN
+    # iff it has no gt, which is threshold-independent, so plain mean is
+    # exact: columns are either all-NaN (propagates) or all-finite.
     ap_per_class = np.full(num_classes, np.nan)
-    ap_per_class[1:] = np.where(counts > 0, sums / np.maximum(counts, 1), np.nan)
+    ap_per_class[1:] = np.stack(per_thresh_cls).mean(axis=0)
     out["ap_per_class"] = ap_per_class
     for t, v in zip(iou_thresholds, per_thresh):
         if abs(t - 0.5) < 1e-9:

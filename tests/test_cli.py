@@ -69,6 +69,20 @@ class TestEvalSmoke:
         assert "aeroplane" in out  # per-class table rendered with VOC names
 
 
+class TestBenchSuccess:
+    def test_bench_prints_metric_line(self, capsys):
+        """The success path must emit the one-line JSON contract (guards
+        against watchdog/refactor regressions that only break completion)."""
+        import json
+
+        rc = cli.main(["bench", "--image-size", "64", "--batch-size", "8"])
+        assert rc == 0
+        line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert line["metric"] == "train_images_per_sec_600x600"
+        assert line["value"] > 0
+        assert "error" not in line
+
+
 class TestBenchWatchdog:
     def test_watchdog_fires_on_wedge(self):
         """If the device wedges, bench must emit a diagnostic JSON line and

@@ -42,7 +42,7 @@ def test_two_process_allreduce():
 
     procs = [
         subprocess.Popen(
-            [sys.executable, script, coordinator, str(pid), "2"],
+            [sys.executable, script, coordinator, str(pid), "2", "trainstep"],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -65,3 +65,5 @@ def test_two_process_allreduce():
         assert p.returncode == 0, f"worker failed:\n{out}"
     assert "global devices=8" in outs[0]
     assert "OK" in outs[0] and "OK" in outs[1]
+    # the full sharded train step ran across the process boundary
+    assert "trainstep loss=" in outs[0] and "trainstep loss=" in outs[1]
