@@ -79,7 +79,12 @@ def _measure(config) -> None:
     )
     from replication_faster_rcnn_tpu.data import SyntheticDataset
     from replication_faster_rcnn_tpu.data.loader import collate
-    from replication_faster_rcnn_tpu.parallel import make_mesh, replicate_tree, shard_batch
+    from replication_faster_rcnn_tpu.parallel import (
+        make_mesh,
+        replicate_tree,
+        shard_batch,
+        validate_spatial,
+    )
     from replication_faster_rcnn_tpu.train import (
         create_train_state,
         make_optimizer,
@@ -95,18 +100,22 @@ def _measure(config) -> None:
             mesh=MeshConfig(num_data=n_dev),
         )
     else:
-        # honor the caller's model/image/batch choices; force synthetic data
-        # (dataset-independent measurement) and a mesh over every device
+        # honor the caller's model/image/batch/mesh choices (incl. a model
+        # axis and spatial partitioning); force synthetic data
+        # (dataset-independent measurement) and fill every device
+        n_model = max(1, config.mesh.num_model)
+        n_data = max(1, n_dev // n_model)
         cfg = config.replace(
             data=dataclasses.replace(config.data, dataset="synthetic"),
-            mesh=MeshConfig(num_data=n_dev),
+            mesh=dataclasses.replace(config.mesh, num_data=n_data),
         )
         batch_size = cfg.train.batch_size
-        if batch_size % n_dev != 0:
-            batch_size = max(1, batch_size // n_dev) * n_dev
+        if batch_size % n_data != 0:
+            batch_size = max(1, batch_size // n_data) * n_data
             cfg = cfg.replace(
                 train=dataclasses.replace(cfg.train, batch_size=batch_size)
             )
+    validate_spatial(cfg)
     mesh = make_mesh(cfg.mesh)
     tx, _ = make_optimizer(cfg, steps_per_epoch=100)
     model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
@@ -116,7 +125,13 @@ def _measure(config) -> None:
     batch = collate([ds[i] for i in range(batch_size)])
     device_batch = shard_batch(batch, mesh, cfg.mesh)
 
-    step = jax.jit(make_train_step(model, cfg, tx), donate_argnums=(0,))
+    if cfg.train.backend == "spmd":
+        # measure the explicit shard_map backend (already jitted + donated)
+        from replication_faster_rcnn_tpu.parallel import make_shard_map_train_step
+
+        step, _ = make_shard_map_train_step(cfg, tx, mesh)
+    else:
+        step = jax.jit(make_train_step(model, cfg, tx), donate_argnums=(0,))
 
     # warmup (compile) + 2 steps to stabilize. NOTE: sync via device_get of
     # the scalar metrics, not block_until_ready — the remote-TPU plugin in

@@ -64,6 +64,13 @@ def _build_config(args):
         if args.roi_op:
             model_kw["roi_op"] = args.roi_op
         cfg = cfg.replace(model=dataclasses.replace(cfg.model, **model_kw))
+    mesh_kw = {}
+    if getattr(args, "num_model", None) is not None:
+        mesh_kw["num_model"] = args.num_model
+    if getattr(args, "spatial", False):
+        mesh_kw["spatial"] = True
+    if mesh_kw:
+        cfg = cfg.replace(mesh=dataclasses.replace(cfg.mesh, **mesh_kw))
     return cfg
 
 
@@ -87,6 +94,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--backend", default=None, choices=[None, "auto", "spmd"],
                    help="SPMD backend: jit auto-partitioning or explicit "
                         "shard_map collectives (parallel/spmd.py)")
+    p.add_argument("--num-model", type=int, default=None,
+                   help="size of the mesh's model axis")
+    p.add_argument("--spatial", action="store_true",
+                   help="shard image rows over the model axis (spatial "
+                        "partitioning; GSPMD conv halo exchange)")
 
 
 def cmd_train(args) -> int:
@@ -164,8 +176,9 @@ def cmd_bench(args) -> int:
         for v in (
             args.dataset, args.data_root, args.image_size, args.backbone,
             args.roi_op, args.batch_size, args.lr, args.epochs, args.seed,
+            args.num_model, args.backend,
         )
-    ) or args.config != "voc_resnet18"
+    ) or args.spatial or args.config != "voc_resnet18"
     bench_main(_build_config(args) if flagged else None)
     return 0
 

@@ -233,12 +233,21 @@ class EvalConfig:
 class MeshConfig:
     """Device mesh for SPMD parallelism (SURVEY.md §2.4). The workload is
     data-parallel; the `model` axis exists so tensor-parallel shardings can
-    be introduced without changing the mesh plumbing."""
+    be introduced without changing the mesh plumbing.
+
+    ``spatial`` turns on spatial partitioning over the ``model`` axis: each
+    image's row (H) dimension is sharded across it, the vision analogue of
+    sequence/context parallelism (there is no sequence axis in a detector —
+    SURVEY.md §5 — the long axis is image extent). GSPMD inserts the halo
+    exchanges every conv needs at shard boundaries; one image then spans
+    ``num_model`` chips, so images larger than a single chip's HBM budget
+    still train. Requires the default jit auto-partitioning backend."""
 
     data_axis: str = "data"
     model_axis: str = "model"
     num_data: int = -1  # -1: all available devices
     num_model: int = 1
+    spatial: bool = False  # shard image rows over the model axis
 
 
 @dataclasses.dataclass(frozen=True)

@@ -60,6 +60,49 @@ def fit_data_parallelism(batch_size: int, n_devices: int) -> int:
     return 1
 
 
+def validate_spatial(config) -> None:
+    """Reject configs where spatial partitioning would silently do nothing
+    or cannot work (shared by the Trainer and the benchmark so every
+    entry point fails the same way).
+
+    Args: config — a full FasterRCNNConfig.
+    """
+    if not config.mesh.spatial:
+        if config.mesh.num_model > 1:
+            # nothing shards over the model axis without spatial
+            # partitioning (or a future tensor-parallel layout): every
+            # model-axis peer would replicate identical work
+            import warnings
+
+            warnings.warn(
+                f"mesh.num_model={config.mesh.num_model} with "
+                "spatial=False: the model axis carries no sharding, so "
+                f"{config.mesh.num_model - 1} of every "
+                f"{config.mesh.num_model} chips duplicate work; pass "
+                "--spatial or drop --num-model",
+                stacklevel=2,
+            )
+        return
+    if config.train.backend == "spmd":
+        raise ValueError(
+            "spatial partitioning requires the jit auto-partitioning "
+            "backend (GSPMD places the conv halo exchanges); the "
+            "explicit shard_map backend shards batch dims only"
+        )
+    if config.mesh.num_model < 2:
+        raise ValueError(
+            "spatial partitioning shards image rows over the model "
+            "axis; set mesh.num_model >= 2 (--num-model), got "
+            f"{config.mesh.num_model}"
+        )
+    if config.data.image_size[0] % config.mesh.num_model:
+        raise ValueError(
+            f"spatial partitioning needs image rows "
+            f"({config.data.image_size[0]}) divisible by the model "
+            f"axis ({config.mesh.num_model})"
+        )
+
+
 def make_mesh(cfg: MeshConfig, devices: Optional[Sequence[Any]] = None) -> Mesh:
     """Build the (data, model) mesh. num_data == -1 uses every device."""
     devices = list(devices if devices is not None else jax.devices())
@@ -78,6 +121,18 @@ def batch_sharding(mesh: Mesh, cfg: MeshConfig) -> NamedSharding:
     return NamedSharding(mesh, P(cfg.data_axis))
 
 
+def image_sharding(mesh: Mesh, cfg: MeshConfig) -> NamedSharding:
+    """Sharding for NHWC image tensors. With ``cfg.spatial`` the row (H)
+    dimension is additionally sharded over the ``model`` axis — spatial
+    partitioning, the detector's analogue of sequence parallelism (see
+    MeshConfig). GSPMD then partitions every conv in the trunk spatially,
+    inserting halo exchanges (ICI collective-permutes of the boundary rows)
+    where a kernel window crosses shards."""
+    if cfg.spatial and mesh.shape[cfg.model_axis] > 1:
+        return NamedSharding(mesh, P(cfg.data_axis, cfg.model_axis))
+    return batch_sharding(mesh, cfg)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
@@ -87,13 +142,15 @@ def shard_batch(
 ) -> Dict[str, jax.Array]:
     """Host batch -> device arrays with the batch dim laid out over the data
     axis (each chip receives only its shard; XLA's equivalent of DDP's
-    per-rank loader)."""
+    per-rank loader). Image tensors additionally shard rows over the model
+    axis when spatial partitioning is on (`image_sharding`)."""
     sharding = batch_sharding(mesh, cfg)
+    img_sharding = image_sharding(mesh, cfg)
 
-    def put(x: np.ndarray) -> jax.Array:
-        return jax.device_put(x, sharding)
+    def put(k: str, x: np.ndarray) -> jax.Array:
+        return jax.device_put(x, img_sharding if k == "image" else sharding)
 
-    return {k: put(v) for k, v in batch.items()}
+    return {k: put(k, v) for k, v in batch.items()}
 
 
 def replicate_tree(tree: Any, mesh: Mesh) -> Any:

@@ -30,6 +30,7 @@ from replication_faster_rcnn_tpu.parallel import (
     make_mesh,
     replicate_tree,
     shard_batch,
+    validate_spatial,
 )
 from replication_faster_rcnn_tpu.train.train_step import (
     TrainState,
@@ -76,6 +77,7 @@ class Trainer:
     ) -> None:
         self.config = config
         self.workdir = workdir
+        validate_spatial(config)
         if config.mesh.num_data <= 0:
             # fit the data axis to the batch (a non-dividing batch fails in
             # jit with an opaque sharding error — e.g. the reference's
