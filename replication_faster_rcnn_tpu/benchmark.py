@@ -24,6 +24,22 @@ import jax
 import numpy as np
 
 
+def _wedge_exit(reason: str):
+    print(
+        json.dumps(
+            {
+                "metric": "train_images_per_sec_600x600",
+                "value": 0.0,
+                "unit": "images/sec",
+                "vs_baseline": None,
+                "error": reason,
+            }
+        ),
+        flush=True,
+    )
+    os._exit(2)
+
+
 def _arm_watchdog() -> threading.Timer:
     """Print a diagnostic JSON line and exit if the measurement wedges.
 
@@ -35,20 +51,9 @@ def _arm_watchdog() -> threading.Timer:
     budget = float(os.environ.get("BENCH_WATCHDOG_S", "1500"))
 
     def fire():
-        print(
-            json.dumps(
-                {
-                    "metric": "train_images_per_sec_600x600",
-                    "value": 0.0,
-                    "unit": "images/sec",
-                    "vs_baseline": None,
-                    "error": f"watchdog: device wedged >{budget:.0f}s "
-                    "(remote compile tunnel hang)",
-                }
-            ),
-            flush=True,
+        _wedge_exit(
+            f"watchdog: device wedged >{budget:.0f}s (remote compile tunnel hang)"
         )
-        os._exit(2)
 
     t = threading.Timer(budget, fire)
     t.daemon = True
@@ -56,11 +61,38 @@ def _arm_watchdog() -> threading.Timer:
     return t
 
 
+def _probe_device() -> None:
+    """Fail fast if the device tunnel is already wedged.
+
+    A wedged remote-TPU service blocks even a trivial op forever, and a
+    blocked device call cannot be interrupted from Python — so a short
+    side watchdog reports the wedge in minutes instead of burning the
+    full measurement budget before saying anything.
+    """
+    import jax.numpy as jnp
+
+    budget = float(os.environ.get("BENCH_PROBE_S", "180"))
+    t = threading.Timer(
+        budget,
+        lambda: _wedge_exit(
+            f"probe: device unresponsive >{budget:.0f}s before compile "
+            "(tunnel wedged at start)"
+        ),
+    )
+    t.daemon = True
+    t.start()
+    try:
+        jax.device_get(jnp.ones((8, 128)).sum())
+    finally:
+        t.cancel()
+
+
 def main(config=None) -> None:
     """Measure the jitted train step of ``config`` (default: the flagship
     voc_resnet18 at 600x600, batch 8/device) on all available devices."""
     watchdog = _arm_watchdog()
     try:
+        _probe_device()
         _measure(config)
     finally:
         # a raised exception must not leave the timer alive to later print a

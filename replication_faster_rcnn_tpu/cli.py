@@ -57,12 +57,14 @@ def _build_config(args):
         train_kw["eval_every_epochs"] = args.eval_every
     if train_kw:
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **train_kw))
-    if args.backbone or args.roi_op:
+    if args.backbone or args.roi_op or getattr(args, "remat", False):
         model_kw = {}
         if args.backbone:
             model_kw["backbone"] = args.backbone
         if args.roi_op:
             model_kw["roi_op"] = args.roi_op
+        if getattr(args, "remat", False):
+            model_kw["remat"] = True
         cfg = cfg.replace(model=dataclasses.replace(cfg.model, **model_kw))
     mesh_kw = {}
     if getattr(args, "num_model", None) is not None:
@@ -94,6 +96,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--backend", default=None, choices=[None, "auto", "spmd"],
                    help="SPMD backend: jit auto-partitioning or explicit "
                         "shard_map collectives (parallel/spmd.py)")
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint each trunk block (recompute "
+                        "activations in backward; saves HBM)")
     p.add_argument("--num-model", type=int, default=None,
                    help="size of the mesh's model axis")
     p.add_argument("--spatial", action="store_true",
@@ -178,7 +183,7 @@ def cmd_bench(args) -> int:
             args.roi_op, args.batch_size, args.lr, args.epochs, args.seed,
             args.num_model, args.backend,
         )
-    ) or args.spatial or args.config != "voc_resnet18"
+    ) or args.spatial or args.remat or args.config != "voc_resnet18"
     bench_main(_build_config(args) if flagged else None)
     return 0
 

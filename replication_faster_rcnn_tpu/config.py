@@ -135,6 +135,11 @@ class ModelConfig:
     fpn_channels: int = 256  # P-level width (FPN paper)
     # compute dtype for conv stacks; params/losses stay float32
     compute_dtype: str = "bfloat16"
+    # jax.checkpoint each residual block in the trunk: the backward pass
+    # recomputes block activations instead of holding them in HBM — ~1/3
+    # more FLOPs for large activation-memory savings (bigger batches /
+    # deeper backbones at 600x600). Parameter trees are unchanged.
+    remat: bool = False
     # mesh axis name for cross-replica (sync) BatchNorm — set ONLY when the
     # model runs inside shard_map (`parallel/spmd.py`); under jit
     # auto-partitioning the global-batch BN reduction happens automatically

@@ -53,7 +53,8 @@ class FasterRCNN(nn.Module):
             from replication_faster_rcnn_tpu.models.head import FPNDetectionHead
 
             self.trunk = ResNetFeatures(
-                cfg.model.backbone, dtype, bn_axis=cfg.model.bn_axis
+                cfg.model.backbone, dtype, bn_axis=cfg.model.bn_axis,
+                remat=cfg.model.remat,
             )
             self.neck = FPNNeck(cfg.model.fpn_channels, dtype)
             self.rpn = RPNHead(
@@ -71,10 +72,11 @@ class FasterRCNN(nn.Module):
             if cfg.model.backbone == "vgg16":
                 from replication_faster_rcnn_tpu.models.vgg import VGG16Trunk
 
-                self.trunk = VGG16Trunk(dtype)
+                self.trunk = VGG16Trunk(dtype, remat=cfg.model.remat)
             else:
                 self.trunk = ResNetTrunk(
-                    cfg.model.backbone, dtype, bn_axis=cfg.model.bn_axis
+                    cfg.model.backbone, dtype, bn_axis=cfg.model.bn_axis,
+                    remat=cfg.model.remat,
                 )
             # the head dispatches internally on arch (VGG16 fc6/fc7 tail
             # vs ResNet layer4 tail)

@@ -45,26 +45,42 @@ def _ceil_max_pool(x: Array) -> Array:
 
 
 class VGG16Trunk(nn.Module):
-    """conv1_1..conv5_3 -> [N, ceil(H/16), ceil(W/16), 512]."""
+    """conv1_1..conv5_3 -> [N, ceil(H/16), ceil(W/16), 512].
+
+    ``remat`` applies jax.checkpoint per conv block (conv{b}_1..conv{b}_n):
+    backward recomputes the block's activations instead of keeping them in
+    HBM. Wrapping the bound method keeps the parameter names (conv1_1, ...)
+    at trunk scope, so checkpoints/conversion are unaffected.
+    """
 
     dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    def _block(self, x: Array, block: int, n_convs: int, ch: int) -> Array:
+        for i in range(1, n_convs + 1):
+            x = nn.Conv(
+                ch,
+                (3, 3),
+                padding=((1, 1), (1, 1)),
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                name=f"conv{block}_{i}",
+            )(x)
+            x = nn.relu(x)
+        return x
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
+        run = (
+            nn.remat(VGG16Trunk._block, static_argnums=(2, 3, 4))
+            if self.remat
+            else VGG16Trunk._block
+        )
         x = x.astype(self.dtype)
         for block, n_convs, ch in VGG16_BLOCKS:
             if block > 1:
                 x = _ceil_max_pool(x)
-            for i in range(1, n_convs + 1):
-                x = nn.Conv(
-                    ch,
-                    (3, 3),
-                    padding=((1, 1), (1, 1)),
-                    dtype=self.dtype,
-                    param_dtype=jnp.float32,
-                    name=f"conv{block}_{i}",
-                )(x)
-                x = nn.relu(x)
+            x = run(self, x, block, n_convs, ch)
         return x
 
 

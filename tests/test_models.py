@@ -131,6 +131,29 @@ class TestVGG16:
         # ceil pooling: 150 -> 75 -> 38 -> 19 -> 10 (Caffe rounding)
         assert y.shape == (1, 7, 10, 512)
 
+    def test_trunk_remat_preserves_params_and_grads(self):
+        """remat=True must keep the flat conv1_1.. parameter names (the
+        converter contract) and compute identical outputs/gradients."""
+        from replication_faster_rcnn_tpu.models.vgg import VGG16Trunk
+
+        x = jnp.ones((1, 48, 48, 3))
+        m0 = VGG16Trunk(jnp.float32)
+        m1 = VGG16Trunk(jnp.float32, remat=True)
+        v0 = m0.init(jax.random.PRNGKey(0), x)
+        v1 = m1.init(jax.random.PRNGKey(0), x)
+        assert jax.tree_util.tree_structure(v0) == jax.tree_util.tree_structure(v1)
+        np.testing.assert_allclose(
+            np.asarray(m0.apply(v0, x)), np.asarray(m1.apply(v0, x)), rtol=1e-6
+        )
+        g0 = jax.grad(lambda v: m0.apply(v, x).sum())(v0)
+        g1 = jax.grad(lambda v: m1.apply(v, x).sum())(v0)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )
+
     def test_tail_embeds_and_dropout_gates(self):
         from replication_faster_rcnn_tpu.models.vgg import VGG16Tail
 

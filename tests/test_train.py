@@ -144,6 +144,34 @@ class TestTrainStep:
         _, m2 = step(state, batch)
         assert float(m1["loss"]) == float(m2["loss"])
 
+    def test_remat_preserves_step_semantics(self, step_setup):
+        """model.remat=True (per-block jax.checkpoint) must leave the
+        parameter tree and the computed update unchanged — it only trades
+        backward-pass FLOPs for activation memory."""
+        import dataclasses
+
+        cfg, model, state, step, batch = step_setup
+        rcfg = cfg.replace(model=dataclasses.replace(cfg.model, remat=True))
+        tx, _ = make_optimizer(rcfg, steps_per_epoch=10)
+        rmodel, rstate = create_train_state(rcfg, jax.random.PRNGKey(0), tx)
+        assert (
+            jax.tree_util.tree_structure(rstate.params)
+            == jax.tree_util.tree_structure(state.params)
+        )
+        rstep = jax.jit(make_train_step(rmodel, rcfg, tx))
+        new_state, metrics = step(state, batch)
+        rnew_state, rmetrics = rstep(rstate, batch)
+        np.testing.assert_allclose(
+            float(metrics["loss"]), float(rmetrics["loss"]), rtol=1e-6
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(new_state.params),
+            jax.tree_util.tree_leaves(rnew_state.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            )
+
     def test_overfit_two_images(self, step_setup):
         """Loss must drop substantially when repeating one tiny batch
         (SURVEY.md §4f overfit integration check, shortened for CI)."""
