@@ -113,9 +113,8 @@ def _measure(config) -> None:
     from replication_faster_rcnn_tpu.data.loader import collate
     from replication_faster_rcnn_tpu.parallel import (
         make_mesh,
-        replicate_tree,
         shard_batch,
-        validate_spatial,
+        validate_parallel,
     )
     from replication_faster_rcnn_tpu.train import (
         create_train_state,
@@ -147,11 +146,20 @@ def _measure(config) -> None:
             cfg = cfg.replace(
                 train=dataclasses.replace(cfg.train, batch_size=batch_size)
             )
-    validate_spatial(cfg)
+    validate_parallel(cfg)
     mesh = make_mesh(cfg.mesh)
     tx, _ = make_optimizer(cfg, steps_per_epoch=100)
     model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
-    state = replicate_tree(state, mesh)
+
+    from replication_faster_rcnn_tpu.parallel.zero import (
+        place_train_state,
+        train_state_shardings,
+    )
+
+    shardings = train_state_shardings(
+        state, mesh, cfg.mesh, cfg.train.shard_opt_state
+    )
+    state = place_train_state(state, shardings)
 
     ds = SyntheticDataset(cfg.data, length=batch_size)
     batch = collate([ds[i] for i in range(batch_size)])
@@ -163,7 +171,11 @@ def _measure(config) -> None:
 
         step, _ = make_shard_map_train_step(cfg, tx, mesh)
     else:
-        step = jax.jit(make_train_step(model, cfg, tx), donate_argnums=(0,))
+        step = jax.jit(
+            make_train_step(model, cfg, tx),
+            donate_argnums=(0,),
+            out_shardings=(shardings, None),
+        )
 
     # warmup (compile) + 2 steps to stabilize. NOTE: sync via device_get of
     # the scalar metrics, not block_until_ready — the remote-TPU plugin in

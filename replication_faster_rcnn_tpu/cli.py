@@ -53,6 +53,8 @@ def _build_config(args):
         train_kw["seed"] = args.seed
     if getattr(args, "backend", None):
         train_kw["backend"] = args.backend
+    if getattr(args, "shard_opt", False):
+        train_kw["shard_opt_state"] = True
     if getattr(args, "eval_every", None) is not None:
         train_kw["eval_every_epochs"] = args.eval_every
     if train_kw:
@@ -96,6 +98,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--backend", default=None, choices=[None, "auto", "spmd"],
                    help="SPMD backend: jit auto-partitioning or explicit "
                         "shard_map collectives (parallel/spmd.py)")
+    p.add_argument("--shard-opt", action="store_true",
+                   help="ZeRO-1 weight-update sharding: Adam moments shard "
+                        "over the data axis (arXiv:2004.13336)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each trunk block (recompute "
                         "activations in backward; saves HBM)")
@@ -183,7 +188,7 @@ def cmd_bench(args) -> int:
             args.roi_op, args.batch_size, args.lr, args.epochs, args.seed,
             args.num_model, args.backend,
         )
-    ) or args.spatial or args.remat or args.config != "voc_resnet18"
+    ) or args.spatial or args.remat or args.shard_opt or args.config != "voc_resnet18"
     bench_main(_build_config(args) if flagged else None)
     return 0
 

@@ -209,6 +209,11 @@ class TrainConfig:
     # "spmd" = explicit shard_map step with hand-placed psums + sync-BN
     # (`parallel/spmd.py`); both compute the same update (tested).
     backend: str = "auto"
+    # ZeRO-1 / cross-replica weight-update sharding (arXiv:2004.13336,
+    # `parallel/zero.py`): shard Adam moments over the data axis; each chip
+    # updates 1/N of the weights (reduce-scatter + all-gather via GSPMD).
+    # Auto-partitioning backend only.
+    shard_opt_state: bool = False
     # run the mAP evaluator on the val split every N epochs (0 = off)
     eval_every_epochs: int = 0
 

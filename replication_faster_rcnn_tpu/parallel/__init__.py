@@ -7,6 +7,7 @@ from replication_faster_rcnn_tpu.parallel.mesh import (  # noqa: F401
     replicate_tree,
     replicated,
     shard_batch,
+    validate_parallel,
     validate_spatial,
 )
 from replication_faster_rcnn_tpu.parallel.spmd import (  # noqa: F401

@@ -103,6 +103,18 @@ def validate_spatial(config) -> None:
         )
 
 
+def validate_parallel(config) -> None:
+    """All parallelism config checks shared by every entry point (Trainer,
+    benchmark): spatial partitioning constraints plus backend conflicts."""
+    validate_spatial(config)
+    if config.train.shard_opt_state and config.train.backend == "spmd":
+        raise ValueError(
+            "shard_opt_state (ZeRO-1 weight-update sharding) requires "
+            "the jit auto-partitioning backend; the shard_map backend "
+            "replicates state by construction"
+        )
+
+
 def make_mesh(cfg: MeshConfig, devices: Optional[Sequence[Any]] = None) -> Mesh:
     """Build the (data, model) mesh. num_data == -1 uses every device."""
     devices = list(devices if devices is not None else jax.devices())

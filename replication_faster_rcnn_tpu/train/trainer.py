@@ -30,7 +30,7 @@ from replication_faster_rcnn_tpu.parallel import (
     make_mesh,
     replicate_tree,
     shard_batch,
-    validate_spatial,
+    validate_parallel,
 )
 from replication_faster_rcnn_tpu.train.train_step import (
     TrainState,
@@ -77,7 +77,7 @@ class Trainer:
     ) -> None:
         self.config = config
         self.workdir = workdir
-        validate_spatial(config)
+        validate_parallel(config)
         if config.mesh.num_data <= 0:
             # fit the data axis to the batch (a non-dividing batch fails in
             # jit with an opaque sharding error — e.g. the reference's
@@ -109,7 +109,17 @@ class Trainer:
         self.model, state = create_train_state(
             config, jax.random.PRNGKey(config.train.seed), self.tx
         )
-        self.state: TrainState = replicate_tree(state, self.mesh)
+        from replication_faster_rcnn_tpu.parallel.zero import (
+            place_train_state,
+            train_state_shardings,
+        )
+
+        # params/BN replicated; Adam moments sharded over the data axis
+        # when ZeRO-1 weight-update sharding is on (`parallel/zero.py`)
+        self._state_shardings = train_state_shardings(
+            state, self.mesh, config.mesh, config.train.shard_opt_state
+        )
+        self.state: TrainState = place_train_state(state, self._state_shardings)
 
         if config.train.backend == "spmd":
             from replication_faster_rcnn_tpu.parallel import make_shard_map_train_step
@@ -121,7 +131,13 @@ class Trainer:
             )
         else:
             step_fn = make_train_step(self.model, config, self.tx)
-            self.jitted_step = jax.jit(step_fn, donate_argnums=(0,))
+            # pinning out_shardings keeps the state layout stable across
+            # steps (donation reuses the buffers in place)
+            self.jitted_step = jax.jit(
+                step_fn,
+                donate_argnums=(0,),
+                out_shardings=(self._state_shardings, None),
+            )
         self._ckpt_mgr = None
 
     # ---------------------------------------------------------- checkpoints
@@ -137,6 +153,16 @@ class Trainer:
             )
         return self._ckpt_mgr
 
+    def _host_state(self):
+        """Full state on host. Sharded optimizer state (ZeRO-1) is
+        re-placed fully-replicated first — a device-side all-gather —
+        because device_get cannot fetch arrays whose shards live on other
+        processes' chips (multi-host)."""
+        state = self.state
+        if self.config.train.shard_opt_state:
+            state = replicate_tree(state, self.mesh)
+        return jax.device_get(state)
+
     def save(self, step: Optional[int] = None) -> None:
         import orbax.checkpoint as ocp
 
@@ -144,7 +170,7 @@ class Trainer:
         if self.checkpoint_manager.latest_step() == step:
             return  # already checkpointed (orbax raises on duplicate steps)
         self.checkpoint_manager.save(
-            step, args=ocp.args.StandardSave(jax.device_get(self.state))
+            step, args=ocp.args.StandardSave(self._host_state())
         )
         self.checkpoint_manager.wait_until_finished()
 
@@ -166,12 +192,14 @@ class Trainer:
             step = mgr.latest_step() if step is None else step
             if step is None:
                 return 0
-            template = jax.device_get(self.state)
+            template = self._host_state()
             restored = mgr.restore(step, args=ocp.args.StandardRestore(template))
         finally:
             if ephemeral:
                 mgr.close()
-        self.state = replicate_tree(restored, self.mesh)
+        from replication_faster_rcnn_tpu.parallel.zero import place_train_state
+
+        self.state = place_train_state(restored, self._state_shardings)
         return int(self.state.step)
 
     def load_pretrained_backbone(self, pth_path: str) -> None:
