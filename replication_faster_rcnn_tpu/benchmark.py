@@ -87,20 +87,21 @@ def _probe_device() -> None:
         t.cancel()
 
 
-def main(config=None) -> None:
+def main(config=None, profile_dir=None) -> None:
     """Measure the jitted train step of ``config`` (default: the flagship
-    voc_resnet18 at 600x600, batch 8/device) on all available devices."""
+    voc_resnet18 at 600x600, batch 8/device) on all available devices.
+    ``profile_dir`` wraps the timed loop in a jax.profiler trace."""
     watchdog = _arm_watchdog()
     try:
         _probe_device()
-        _measure(config)
+        _measure(config, profile_dir)
     finally:
         # a raised exception must not leave the timer alive to later print a
         # bogus zero-metric line and os._exit a host process
         watchdog.cancel()
 
 
-def _measure(config) -> None:
+def _measure(config, profile_dir=None) -> None:
     import dataclasses
 
     from replication_faster_rcnn_tpu.config import (
@@ -185,11 +186,14 @@ def _measure(config) -> None:
         state, metrics = step(state, device_batch)
     jax.device_get(metrics)
 
+    from replication_faster_rcnn_tpu.utils.profiling import trace
+
     n_steps = 10
     t0 = time.time()
-    for _ in range(n_steps):
-        state, metrics = step(state, device_batch)
-    jax.device_get(metrics)  # forces the whole dependency chain
+    with trace(profile_dir):
+        for _ in range(n_steps):
+            state, metrics = step(state, device_batch)
+        jax.device_get(metrics)  # forces the whole dependency chain
     dt = time.time() - t0
     images_per_sec = n_steps * batch_size / dt
 

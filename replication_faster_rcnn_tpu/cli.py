@@ -119,20 +119,24 @@ def cmd_train(args) -> int:
     trainer = Trainer(cfg, workdir=args.workdir)
     if args.pretrained_backbone:
         trainer.load_pretrained_backbone(args.pretrained_backbone)
+    from replication_faster_rcnn_tpu.utils.profiling import trace
+
     if args.steps:
         # bounded-step mode (smoke/CI): iterate the loader cyclically
         import itertools
 
         it = itertools.cycle(iter(trainer.loader))
-        for i in range(args.steps):
-            metrics = trainer.train_one_batch(next(it))
-            if i % max(1, args.log_every) == 0:
-                import jax
+        with trace(args.profile):
+            for i in range(args.steps):
+                metrics = trainer.train_one_batch(next(it))
+                if i % max(1, args.log_every) == 0:
+                    import jax
 
-                vals = {k: float(v) for k, v in jax.device_get(metrics).items()}
-                trainer.logger.log(i, vals)
+                    vals = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                    trainer.logger.log(i, vals)
         return 0
-    trainer.train(resume=args.resume, log_every=args.log_every)
+    with trace(args.profile):
+        trainer.train(resume=args.resume, log_every=args.log_every)
     trainer.save()
     return 0
 
@@ -189,7 +193,7 @@ def cmd_bench(args) -> int:
             args.num_model, args.backend,
         )
     ) or args.spatial or args.remat or args.shard_opt or args.config != "voc_resnet18"
-    bench_main(_build_config(args) if flagged else None)
+    bench_main(_build_config(args) if flagged else None, profile_dir=args.profile)
     return 0
 
 
@@ -228,6 +232,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                          help="torch resnet .pth to graft (reference readme.md:10-12)")
     p_train.add_argument("--eval-every", type=int, default=None,
                          help="run val mAP every N epochs (0 = never)")
+    p_train.add_argument("--profile", default=None, metavar="DIR",
+                         help="jax.profiler trace of the training loop")
     p_train.set_defaults(fn=cmd_train)
 
     p_eval = sub.add_parser("eval", help="evaluate mAP")
@@ -242,6 +248,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     p_bench = sub.add_parser("bench", help="train-step throughput")
     _add_common(p_bench)
+    p_bench.add_argument("--profile", default=None, metavar="DIR",
+                         help="write a jax.profiler trace of the timed "
+                              "loop (TensorBoard/Perfetto)")
     p_bench.set_defaults(fn=cmd_bench)
 
     p_pred = sub.add_parser("predict", help="detect objects in one image")

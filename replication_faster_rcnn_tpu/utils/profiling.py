@@ -29,8 +29,14 @@ def sync(tree: Any) -> None:
 
 
 @contextlib.contextmanager
-def trace(logdir: str) -> Iterator[None]:
-    """Capture a device trace viewable in TensorBoard / Perfetto."""
+def trace(logdir: Optional[str]) -> Iterator[None]:
+    """Capture a device trace viewable in TensorBoard / Perfetto.
+
+    ``logdir=None`` is a no-op, so callers with an optional --profile flag
+    can unconditionally write ``with trace(flag):``."""
+    if logdir is None:
+        yield
+        return
     jax.profiler.start_trace(logdir)
     try:
         yield
