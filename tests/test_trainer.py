@@ -119,6 +119,39 @@ class TestCLI:
         assert "mAP@0.5" in capsys.readouterr().out
 
 
+def test_crash_resume_is_exact(tmp_path):
+    """Failure recovery (SURVEY.md §5): a run killed after epoch 1 and
+    resumed in a NEW process-equivalent Trainer must end bitwise-identical
+    to an uninterrupted 2-epoch run — exact state checkpointing (params,
+    BN stats, Adam moments, step) plus deterministic per-epoch shuffle and
+    step-keyed rng together make the trajectory reproducible."""
+    ds = SyntheticDataset(_cfg().data, length=16)
+
+    straight = Trainer(_cfg(n_epoch=2), workdir=str(tmp_path / "a"), dataset=ds)
+    straight.train(log_every=100)
+
+    interrupted = Trainer(_cfg(n_epoch=2), workdir=str(tmp_path / "b"), dataset=ds)
+    # run epoch 0 only, checkpoint, and drop the trainer (the "crash")
+    cfg1 = _cfg(n_epoch=1)
+    one_epoch = Trainer(cfg1, workdir=str(tmp_path / "b"), dataset=ds)
+    one_epoch.train(log_every=100)  # saves at epoch end (ckpt_every=1)
+    del one_epoch
+    resumed = interrupted  # fresh Trainer over the same workdir
+    resumed.train(resume=True, log_every=100)
+
+    assert int(straight.state.step) == int(resumed.state.step)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(straight.state.params),
+        jax.tree_util.tree_leaves(resumed.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(straight.state.opt_state),
+        jax.tree_util.tree_leaves(resumed.state.opt_state),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_pretrained_graft_changes_trunk(tmp_path):
     torch = pytest.importorskip("torch")
     # fabricate a torch resnet18-style state_dict from the flax shapes
