@@ -109,8 +109,8 @@ def select_proposals(
     top_scores, top_idx = jax.lax.top_k(scores, pre_nms)
     top_boxes = props[top_idx]
 
-    # XLA fori_loop NMS by default; the ~3x Pallas kernel is opt-in via
-    # FRCNN_PALLAS_NMS=1 on TPU (see nms_fixed_auto for why)
+    # XLA fori_loop NMS by default; FRCNN_NMS=tiled (exact tiled algorithm)
+    # or =pallas (TPU kernel) opt in — see nms_fixed_auto for trade-offs
     from replication_faster_rcnn_tpu.ops.nms_pallas import nms_fixed_auto
 
     idx, valid = nms_fixed_auto(

@@ -147,23 +147,41 @@ def nms_fixed_auto(
     max_out: int,
     mask: Array | None = None,
 ) -> tuple[Array, Array]:
-    """Backend dispatch: the Pallas kernel on TPU (opt-in), the XLA loop
-    elsewhere (Pallas TPU kernels don't compile on the CPU backend).
+    """Backend dispatch for the proposal path. Default: the XLA selection
+    loop (`ops/nms.py`). Opt-ins via FRCNN_NMS:
 
-    Opt-in via FRCNN_PALLAS_NMS=1: standalone the kernel measures 3.2x the
-    XLA loop (9.4ms vs 30.2ms for a batch-8 12k->600 NMS on v5e), but this
-    image's remote-compile TPU service has been observed to wedge when the
-    kernel is compiled INSIDE the full train-step module, taking the whole
-    chip tunnel down with it. Until that's root-caused, the default train
-    path stays on the XLA loop.
+      * ``FRCNN_NMS=tiled`` — the tiled exact algorithm (`ops/nms_tiled.py`;
+        ~25-75 sequential matrix steps instead of 600 scalar-ish ones,
+        bit-identical selections). Any backend.
+      * ``FRCNN_NMS=pallas`` (or legacy FRCNN_PALLAS_NMS=1) — the in-VMEM
+        Pallas kernel, TPU only. Standalone it measures 3.2x the XLA loop
+        (9.4ms vs 30.2ms for a batch-8 12k->600 NMS on v5e), but this
+        image's remote-compile TPU service has been observed to wedge when
+        the kernel is compiled INSIDE the full train-step module, taking
+        the whole chip tunnel down with it — hence opt-in.
     """
     import os
 
     from replication_faster_rcnn_tpu.ops import nms as nms_xla
 
-    if (
-        jax.default_backend() == "tpu"
-        and os.environ.get("FRCNN_PALLAS_NMS") == "1"
-    ):
-        return nms_fixed_pallas(boxes, scores, iou_thresh, max_out, mask=mask)
+    choice = os.environ.get("FRCNN_NMS", "")
+    if choice == "tiled":
+        from replication_faster_rcnn_tpu.ops.nms_tiled import nms_fixed_tiled
+
+        return nms_fixed_tiled(boxes, scores, iou_thresh, max_out, mask=mask)
+    if choice == "pallas" or os.environ.get("FRCNN_PALLAS_NMS") == "1":
+        if jax.default_backend() == "tpu":
+            return nms_fixed_pallas(boxes, scores, iou_thresh, max_out, mask=mask)
+        import warnings
+
+        warnings.warn(
+            "FRCNN_NMS=pallas needs a TPU backend; falling back to the XLA loop"
+        )
+    elif choice not in ("", "loop"):
+        import warnings
+
+        warnings.warn(
+            f"unknown FRCNN_NMS={choice!r} (choices: loop, tiled, pallas); "
+            "using the XLA loop"
+        )
     return nms_xla.nms_fixed(boxes, scores, iou_thresh, max_out, mask=mask)
