@@ -1,0 +1,95 @@
+"""Micro-benchmark: the three NMS backends at the training budget.
+
+Run on a healthy TPU (check the relay first — see
+.claude/skills/verify/SKILL.md "TPU tunnel fragility"):
+
+    python benchmarks/nms_backends.py [--batch 8] [--n 12000] [--out 600]
+
+Prints ms/call for the XLA selection loop (`ops/nms.py`), the tiled exact
+algorithm (`ops/nms_tiled.py`), and — on TPU only, opt-in via
+--pallas because its in-train-step compile has wedged this image's remote
+compile service before — the Pallas kernel, plus a selection-parity check.
+CPU reference numbers (1 core, 12k->600, batch 1): loop 88.6ms,
+tiled 8.2ms (identical selections).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _rand(batch: int, n: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    ctr = rng.uniform(0, 600, (batch, n, 2))
+    wh = rng.uniform(16, 120, (batch, n, 2))
+    boxes = np.concatenate([ctr - wh / 2, ctr + wh / 2], -1).astype(np.float32)
+    scores = rng.uniform(0, 1, (batch, n)).astype(np.float32)
+    return jnp.asarray(boxes), jnp.asarray(scores)
+
+
+def _time(fn, boxes, scores, reps: int = 10):
+    idx, valid = fn(boxes, scores)
+    jax.device_get(idx)  # sync (block_until_ready lies on the remote plugin)
+    t0 = time.time()
+    for _ in range(reps):
+        idx, valid = fn(boxes, scores)
+    jax.device_get(idx)
+    return (time.time() - t0) / reps * 1000, idx, valid
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--n", type=int, default=12000)
+    ap.add_argument("--out", type=int, default=600)
+    ap.add_argument("--thresh", type=float, default=0.7)
+    ap.add_argument("--pallas", action="store_true",
+                    help="also time the Pallas kernel (TPU only; see module docstring)")
+    args = ap.parse_args(argv)
+
+    from replication_faster_rcnn_tpu.ops.nms import nms_fixed
+    from replication_faster_rcnn_tpu.ops.nms_tiled import nms_fixed_tiled
+
+    boxes, scores = _rand(args.batch, args.n)
+    backends = {
+        "loop": jax.jit(jax.vmap(lambda b, s: nms_fixed(b, s, args.thresh, args.out))),
+        "tiled": jax.jit(
+            jax.vmap(lambda b, s: nms_fixed_tiled(b, s, args.thresh, args.out))
+        ),
+    }
+    if args.pallas:
+        from replication_faster_rcnn_tpu.ops.nms_pallas import nms_fixed_pallas
+
+        backends["pallas"] = jax.jit(
+            jax.vmap(lambda b, s: nms_fixed_pallas(b, s, args.thresh, args.out))
+        )
+
+    results = {}
+    for name, fn in backends.items():
+        ms, idx, valid = _time(fn, boxes, scores)
+        results[name] = (ms, np.asarray(idx), np.asarray(valid))
+        print(f"{name:>7}: {ms:8.2f} ms/call  "
+              f"(batch {args.batch}, {args.n}->{args.out})")
+
+    ref_idx, ref_val = results["loop"][1], results["loop"][2]
+    for name, (_, idx, valid) in results.items():
+        if name == "loop":
+            continue
+        ok = bool((idx == ref_idx).all() and (valid == ref_val).all())
+        print(f"{name:>7}: selections {'IDENTICAL to' if ok else 'DIFFER from'} loop")
+        if not ok:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
