@@ -92,9 +92,12 @@ def batched_nms_fixed(
 
     Boxes of different classes never suppress each other: each class's boxes
     are shifted into a disjoint coordinate region (the standard trick), then
-    a single fixed-shape NMS runs over all of them.
+    a single fixed-shape NMS runs over all of them (backend chosen by
+    `nms_pallas.nms_fixed_auto` — same dispatch as the proposal path).
     """
+    from replication_faster_rcnn_tpu.ops.nms_pallas import nms_fixed_auto
+
     extent = jnp.max(boxes) + 1.0
     offsets = class_ids.astype(boxes.dtype)[:, None] * extent
     shifted = boxes + offsets
-    return nms_fixed(shifted, scores, iou_thresh, max_out, mask=mask)
+    return nms_fixed_auto(shifted, scores, iou_thresh, max_out, mask=mask)

@@ -147,12 +147,19 @@ def nms_fixed_auto(
     max_out: int,
     mask: Array | None = None,
 ) -> tuple[Array, Array]:
-    """Backend dispatch for the proposal path. Default: the XLA selection
-    loop (`ops/nms.py`). Opt-ins via FRCNN_NMS:
+    """Backend dispatch for the proposal path.
 
-      * ``FRCNN_NMS=tiled`` — the tiled exact algorithm (`ops/nms_tiled.py`;
-        ~25-75 sequential matrix steps instead of 600 scalar-ish ones,
-        bit-identical selections). Any backend.
+    Defaults: the tiled exact algorithm (`ops/nms_tiled.py`; ~25-75
+    sequential matrix steps instead of one per selection, bit-identical to
+    the loop — parity-tested) everywhere EXCEPT the TPU backend, which
+    stays on the proven XLA selection loop until the tiled path is
+    validated on real hardware (this image's TPU tunnel died before that
+    could happen; see benchmarks/nms_backends.py for the validation run).
+
+    Overrides via FRCNN_NMS:
+
+      * ``FRCNN_NMS=loop`` — the `ops/nms.py` selection loop, any backend.
+      * ``FRCNN_NMS=tiled`` — the tiled algorithm, any backend (incl. TPU).
       * ``FRCNN_NMS=pallas`` (or legacy FRCNN_PALLAS_NMS=1) — the in-VMEM
         Pallas kernel, TPU only. Standalone it measures 3.2x the XLA loop
         (9.4ms vs 30.2ms for a batch-8 12k->600 NMS on v5e), but this
@@ -165,10 +172,6 @@ def nms_fixed_auto(
     from replication_faster_rcnn_tpu.ops import nms as nms_xla
 
     choice = os.environ.get("FRCNN_NMS", "")
-    if choice == "tiled":
-        from replication_faster_rcnn_tpu.ops.nms_tiled import nms_fixed_tiled
-
-        return nms_fixed_tiled(boxes, scores, iou_thresh, max_out, mask=mask)
     if choice == "pallas" or os.environ.get("FRCNN_PALLAS_NMS") == "1":
         if jax.default_backend() == "tpu":
             return nms_fixed_pallas(boxes, scores, iou_thresh, max_out, mask=mask)
@@ -177,11 +180,19 @@ def nms_fixed_auto(
         warnings.warn(
             "FRCNN_NMS=pallas needs a TPU backend; falling back to the XLA loop"
         )
-    elif choice not in ("", "loop"):
+        choice = "loop"
+    elif choice not in ("", "loop", "tiled"):
         import warnings
 
         warnings.warn(
             f"unknown FRCNN_NMS={choice!r} (choices: loop, tiled, pallas); "
-            "using the XLA loop"
+            "using the backend default"
         )
+        choice = ""
+    if not choice:
+        choice = "loop" if jax.default_backend() == "tpu" else "tiled"
+    if choice == "tiled":
+        from replication_faster_rcnn_tpu.ops.nms_tiled import nms_fixed_tiled
+
+        return nms_fixed_tiled(boxes, scores, iou_thresh, max_out, mask=mask)
     return nms_xla.nms_fixed(boxes, scores, iou_thresh, max_out, mask=mask)
