@@ -113,6 +113,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
 
 def cmd_train(args) -> int:
     _apply_device(args.device)
+    if args.debug_nans:
+        from replication_faster_rcnn_tpu.utils.debug import enable_nan_checks
+
+        enable_nan_checks()
     from replication_faster_rcnn_tpu.train import Trainer
 
     cfg = _build_config(args)
@@ -132,8 +136,9 @@ def cmd_train(args) -> int:
                 if i % max(1, args.log_every) == 0:
                     import jax
 
-                    vals = {k: float(v) for k, v in jax.device_get(metrics).items()}
-                    trainer.logger.log(i, vals)
+                    from replication_faster_rcnn_tpu.utils.debug import finite_or_raise
+
+                    trainer.logger.log(i, finite_or_raise(jax.device_get(metrics), i))
         return 0
     with trace(args.profile):
         trainer.train(resume=args.resume, log_every=args.log_every)
@@ -234,6 +239,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                          help="run val mAP every N epochs (0 = never)")
     p_train.add_argument("--profile", default=None, metavar="DIR",
                          help="jax.profiler trace of the training loop")
+    p_train.add_argument("--debug-nans", action="store_true",
+                         help="enable jax_debug_nans (every jit output "
+                              "checked; errors pinpoint the emitting op)")
     p_train.set_defaults(fn=cmd_train)
 
     p_eval = sub.add_parser("eval", help="evaluate mAP")

@@ -38,6 +38,7 @@ from replication_faster_rcnn_tpu.train.train_step import (
     make_optimizer,
     make_train_step,
 )
+from replication_faster_rcnn_tpu.utils.debug import finite_or_raise
 from replication_faster_rcnn_tpu.utils.logging import MetricLogger
 
 
@@ -265,7 +266,9 @@ class Trainer:
                 n_images += batch["image"].shape[0]
                 step += 1
                 if step % log_every == 0:
-                    last = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                    # fail fast on NaN/inf instead of training on garbage
+                    # (SURVEY.md §5 sanitizers; utils/debug.py)
+                    last = finite_or_raise(jax.device_get(metrics), step)
                     last["lr"] = float(self.schedule(step))
                     self.logger.log(step, last)
             # epoch-boundary sync for an honest throughput number
