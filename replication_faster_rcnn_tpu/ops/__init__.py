@@ -1,1 +1,7 @@
-from replication_faster_rcnn_tpu.ops import anchors, boxes, nms, roi_ops  # noqa: F401
+from replication_faster_rcnn_tpu.ops import (  # noqa: F401
+    anchors,
+    boxes,
+    nms,
+    nms_tiled,
+    roi_ops,
+)
