@@ -126,6 +126,25 @@ def _train_step_across_processes(process_id: int, n_global: int) -> None:
     assert int(jax.device_get(new_state.step)) == 1
     print(f"proc {process_id}: trainstep loss={loss:.4f} OK")
 
+    # ZeRO-1 across the process boundary: Adam moments shard over a data
+    # axis that spans both processes; the update must still match the
+    # replicated step (each process holds only its moment shards)
+    from replication_faster_rcnn_tpu.parallel.zero import (
+        place_train_state,
+        train_state_shardings,
+    )
+
+    _, zstate0 = create_train_state(cfg, jax.random.PRNGKey(0), tx)
+    shardings = train_state_shardings(zstate0, mesh, cfg.mesh, shard_opt=True)
+    zstate = place_train_state(zstate0, shardings)
+    zstep = jax.jit(
+        make_train_step(model, cfg, tx), out_shardings=(shardings, None)
+    )
+    _, zmetrics = zstep(zstate, device_batch)
+    zloss = float(jax.device_get(zmetrics["loss"]))
+    assert abs(zloss - loss) < 1e-5, (zloss, loss)
+    print(f"proc {process_id}: zero1 loss={zloss:.4f} OK")
+
 
 if __name__ == "__main__":
     sys.exit(main())

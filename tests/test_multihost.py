@@ -67,3 +67,4 @@ def test_two_process_allreduce():
     assert "OK" in outs[0] and "OK" in outs[1]
     # the full sharded train step ran across the process boundary
     assert "trainstep loss=" in outs[0] and "trainstep loss=" in outs[1]
+    assert "zero1 loss=" in outs[0] and "zero1 loss=" in outs[1]
