@@ -75,6 +75,15 @@ def _build_config(args):
         mesh_kw["spatial"] = True
     if mesh_kw:
         cfg = cfg.replace(mesh=dataclasses.replace(cfg.mesh, **mesh_kw))
+    eval_kw = {}
+    if getattr(args, "iou_thresh", None) is not None:
+        eval_kw["iou_thresh"] = args.iou_thresh
+    if getattr(args, "use_07_metric", False):
+        eval_kw["use_07_metric"] = True
+    if getattr(args, "metric", None):
+        eval_kw["metric"] = args.metric
+    if eval_kw:
+        cfg = cfg.replace(eval=dataclasses.replace(cfg.eval, **eval_kw))
     return cfg
 
 
@@ -252,6 +261,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_eval.add_argument("--max-images", type=int, default=None)
     p_eval.add_argument("--per-class", action="store_true",
                         help="print the per-class AP table")
+    p_eval.add_argument("--iou-thresh", type=float, default=None,
+                        help="matching IoU for VOC mAP (default 0.5)")
+    p_eval.add_argument("--use-07-metric", action="store_true",
+                        help="VOC2007 11-point AP instead of area-under-PR")
+    p_eval.add_argument("--metric", default=None, choices=[None, "voc", "coco"],
+                        help="voc: mAP@iou-thresh; coco: mAP@[.50:.95]")
     p_eval.set_defaults(fn=cmd_eval)
 
     p_bench = sub.add_parser("bench", help="train-step throughput")
