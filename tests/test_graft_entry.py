@@ -1,0 +1,65 @@
+"""The driver gate (`__graft_entry__.dryrun_multichip`) must be immune to
+the caller's environment: round 1's MULTICHIP gate timed out because the
+driver process had the axon TPU plugin registered against a wedged tunnel,
+and backend init blocked forever. The gate now re-execs its body in a
+subprocess with a scrubbed CPU-only env; these tests pin that contract
+cheaply (the real 8-device run is exercised by the driver itself and takes
+~80s on this 1-core host, too slow for the suite).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def _load_graft_entry():
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo_root)
+    try:
+        import __graft_entry__  # noqa: F401
+
+        return __graft_entry__
+    finally:
+        sys.path.pop(0)
+
+
+class TestDryrunIsolation:
+    def test_parent_spawns_child_with_scrubbed_env(self, monkeypatch):
+        g = _load_graft_entry()
+        captured = {}
+
+        def fake_run(cmd, **kwargs):
+            captured["cmd"] = cmd
+            captured.update(kwargs)
+            return subprocess.CompletedProcess(cmd, 0)
+
+        monkeypatch.setattr(subprocess, "run", fake_run)
+        # simulate the poisoned driver env that killed round 1
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+
+        g.dryrun_multichip(8)
+
+        env = captured["env"]
+        assert env["PALLAS_AXON_POOL_IPS"] == ""  # sitecustomize skips axon
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+        # child must run from the repo dir so `import __graft_entry__` works
+        assert captured["cwd"] == os.path.dirname(
+            os.path.abspath(g.__file__)
+        )
+        assert captured["cmd"][0] == sys.executable
+        assert "-u" in captured["cmd"]
+        assert "_dryrun_body(8)" in captured["cmd"][-1]
+
+    def test_child_failure_raises(self, monkeypatch):
+        g = _load_graft_entry()
+        monkeypatch.setattr(
+            subprocess,
+            "run",
+            lambda cmd, **kw: subprocess.CompletedProcess(cmd, 17),
+        )
+        with pytest.raises(RuntimeError, match="rc=17"):
+            g.dryrun_multichip(8)
