@@ -109,8 +109,8 @@ def select_proposals(
     top_scores, top_idx = jax.lax.top_k(scores, pre_nms)
     top_boxes = props[top_idx]
 
-    # XLA fori_loop NMS by default; FRCNN_NMS=tiled (exact tiled algorithm)
-    # or =pallas (TPU kernel) opt in — see nms_fixed_auto for trade-offs
+    # tiled exact NMS by default on every backend; FRCNN_NMS=loop (serial
+    # selection loop) or =pallas (TPU kernel) opt in — see nms_fixed_auto
     from replication_faster_rcnn_tpu.ops.nms_pallas import nms_fixed_auto
 
     idx, valid = nms_fixed_auto(

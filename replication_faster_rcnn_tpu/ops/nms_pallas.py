@@ -149,30 +149,37 @@ def nms_fixed_auto(
 ) -> tuple[Array, Array]:
     """Backend dispatch for the proposal path.
 
-    Defaults: the tiled exact algorithm (`ops/nms_tiled.py`; ~25-75
-    sequential matrix steps instead of one per selection, bit-identical to
-    the loop — parity-tested) everywhere EXCEPT the TPU backend, which
-    stays on the proven XLA selection loop until the tiled path is
-    validated on real hardware (this image's TPU tunnel died before that
-    could happen; see benchmarks/nms_backends.py for the validation run).
+    Default on every backend (TPU included): the tiled exact algorithm
+    (`ops/nms_tiled.py`; ~25-75 sequential matrix steps instead of one per
+    selection). It is bit-identical to the selection loop (parity-tested in
+    tests/test_nms_tiled.py), 10.8x the loop on CPU at the 12k->600 training
+    budget (benchmarks/nms_backends.py), and — unlike the Pallas kernel —
+    plain XLA ops, so it carries none of the remote-compile risk that keeps
+    Pallas opt-in. The loop's ~600 serial dispatches were measured at ~35%
+    of the whole train step on v5e in round 1, which is why the loop is no
+    longer any backend's default; in-step TPU timing of the tiled default
+    is pending hardware access (the tunnel died before it could run).
 
-    Overrides via FRCNN_NMS:
+    Overrides via FRCNN_NMS (explicit choice always wins; the legacy
+    FRCNN_PALLAS_NMS=1 is honored only when FRCNN_NMS is unset):
 
       * ``FRCNN_NMS=loop`` — the `ops/nms.py` selection loop, any backend.
-      * ``FRCNN_NMS=tiled`` — the tiled algorithm, any backend (incl. TPU).
-      * ``FRCNN_NMS=pallas`` (or legacy FRCNN_PALLAS_NMS=1) — the in-VMEM
-        Pallas kernel, TPU only. Standalone it measures 3.2x the XLA loop
-        (9.4ms vs 30.2ms for a batch-8 12k->600 NMS on v5e), but this
-        image's remote-compile TPU service has been observed to wedge when
-        the kernel is compiled INSIDE the full train-step module, taking
-        the whole chip tunnel down with it — hence opt-in.
+      * ``FRCNN_NMS=tiled`` — the tiled algorithm, any backend.
+      * ``FRCNN_NMS=pallas`` — the in-VMEM Pallas kernel, TPU only.
+        Standalone it measures 3.2x the XLA loop (9.4ms vs 30.2ms for a
+        batch-8 12k->600 NMS on v5e), but this image's remote-compile TPU
+        service has been observed to wedge when the kernel is compiled
+        INSIDE the full train-step module, taking the whole chip tunnel
+        down with it — hence opt-in.
     """
     import os
 
     from replication_faster_rcnn_tpu.ops import nms as nms_xla
 
-    choice = os.environ.get("FRCNN_NMS", "")
-    if choice == "pallas" or os.environ.get("FRCNN_PALLAS_NMS") == "1":
+    choice = os.environ.get("FRCNN_NMS", "") or (
+        "pallas" if os.environ.get("FRCNN_PALLAS_NMS") == "1" else ""
+    )
+    if choice == "pallas":
         if jax.default_backend() == "tpu":
             return nms_fixed_pallas(boxes, scores, iou_thresh, max_out, mask=mask)
         import warnings
@@ -190,7 +197,7 @@ def nms_fixed_auto(
         )
         choice = ""
     if not choice:
-        choice = "loop" if jax.default_backend() == "tpu" else "tiled"
+        choice = "tiled"
     if choice == "tiled":
         from replication_faster_rcnn_tpu.ops.nms_tiled import nms_fixed_tiled
 

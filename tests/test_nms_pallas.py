@@ -78,10 +78,61 @@ class TestPallasNMSParity:
         assert (np.diff(s) <= 0).all()
 
 
-def test_auto_dispatch_uses_xla_on_cpu():
-    # suite runs on CPU: nms_fixed_auto must route to the XLA loop and agree
-    boxes, scores = _case(100, seed=5)
-    ia, va = nms_fixed_auto(boxes, scores, 0.5, 20)
-    ix, vx = nms_fixed(boxes, scores, 0.5, 20)
-    np.testing.assert_array_equal(np.asarray(ia), np.asarray(ix))
-    np.testing.assert_array_equal(np.asarray(va), np.asarray(vx))
+class TestAutoDispatch:
+    """nms_fixed_auto routing: tiled is the default on every backend; an
+    explicit FRCNN_NMS always beats the legacy FRCNN_PALLAS_NMS=1."""
+
+    def _spies(self, monkeypatch):
+        from replication_faster_rcnn_tpu.ops import nms as nms_mod
+        from replication_faster_rcnn_tpu.ops import nms_tiled as tiled_mod
+
+        calls = []
+        real_loop, real_tiled = nms_mod.nms_fixed, tiled_mod.nms_fixed_tiled
+        monkeypatch.setattr(
+            nms_mod,
+            "nms_fixed",
+            lambda *a, **k: calls.append("loop") or real_loop(*a, **k),
+        )
+        monkeypatch.setattr(
+            tiled_mod,
+            "nms_fixed_tiled",
+            lambda *a, **k: calls.append("tiled") or real_tiled(*a, **k),
+        )
+        return calls
+
+    def test_default_is_tiled_and_agrees_with_loop(self, monkeypatch):
+        monkeypatch.delenv("FRCNN_NMS", raising=False)
+        monkeypatch.delenv("FRCNN_PALLAS_NMS", raising=False)
+        calls = self._spies(monkeypatch)
+        boxes, scores = _case(100, seed=5)
+        ia, va = nms_fixed_auto(boxes, scores, 0.5, 20)
+        assert calls == ["tiled"]
+        ix, vx = nms_fixed(boxes, scores, 0.5, 20)
+        np.testing.assert_array_equal(np.asarray(ia), np.asarray(ix))
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vx))
+
+    def test_explicit_choice_beats_legacy_pallas_var(self, monkeypatch):
+        monkeypatch.setenv("FRCNN_NMS", "loop")
+        monkeypatch.setenv("FRCNN_PALLAS_NMS", "1")
+        calls = self._spies(monkeypatch)
+        boxes, scores = _case(64, seed=6)
+        nms_fixed_auto(boxes, scores, 0.5, 10)
+        assert calls == ["loop"]
+
+    def test_legacy_pallas_var_alone_falls_back_off_tpu(self, monkeypatch):
+        monkeypatch.delenv("FRCNN_NMS", raising=False)
+        monkeypatch.setenv("FRCNN_PALLAS_NMS", "1")
+        calls = self._spies(monkeypatch)
+        boxes, scores = _case(64, seed=7)
+        with pytest.warns(UserWarning, match="needs a TPU backend"):
+            nms_fixed_auto(boxes, scores, 0.5, 10)
+        assert calls == ["loop"]
+
+    def test_unknown_choice_warns_and_uses_default(self, monkeypatch):
+        monkeypatch.setenv("FRCNN_NMS", "bogus")
+        monkeypatch.delenv("FRCNN_PALLAS_NMS", raising=False)
+        calls = self._spies(monkeypatch)
+        boxes, scores = _case(64, seed=8)
+        with pytest.warns(UserWarning, match="unknown FRCNN_NMS"):
+            nms_fixed_auto(boxes, scores, 0.5, 10)
+        assert calls == ["tiled"]
