@@ -185,9 +185,9 @@ def nms_fixed_auto(
         import warnings
 
         warnings.warn(
-            "FRCNN_NMS=pallas needs a TPU backend; falling back to the XLA loop"
+            "the Pallas NMS kernel needs a TPU backend; using the tiled default"
         )
-        choice = "loop"
+        choice = "tiled"
     elif choice not in ("", "loop", "tiled"):
         import warnings
 

@@ -126,7 +126,8 @@ class TestAutoDispatch:
         boxes, scores = _case(64, seed=7)
         with pytest.warns(UserWarning, match="needs a TPU backend"):
             nms_fixed_auto(boxes, scores, 0.5, 10)
-        assert calls == ["loop"]
+        # falls back to the DEFAULT (tiled), not the slowest backend
+        assert calls == ["tiled"]
 
     def test_unknown_choice_warns_and_uses_default(self, monkeypatch):
         monkeypatch.setenv("FRCNN_NMS", "bogus")
