@@ -3,7 +3,9 @@
 (Importable package module; the repo-root ``bench.py`` is a thin shim so
 the driver can run it from the checkout root.)
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", plus
+"flops_per_step"/"mfu" and — unless BENCH_BREAKDOWN=0 — a per-stage
+"breakdown"}.
 
 Metric: VOC-shaped (600x600, synthetic tensors — dataset-independent)
 training images/sec on the available device(s). ``vs_baseline`` is the
@@ -11,6 +13,20 @@ ratio against the measured single-host PyTorch-CPU reference throughput
 (BASELINE.md: the reference publishes no numbers, so the baseline is
 measured by benchmarks/reference_baseline.py and cached in
 benchmarks/baseline_measured.json; target is >= 6x).
+
+MFU: ``achieved_flops / (time x peak_bf16_flops)``. The step's FLOP count
+comes from XLA's own HloCostAnalysis on the *lowered* (pre-compile) module
+— a host-side analysis that never touches the device, so it is safe even
+through the fragile remote-TPU tunnel; it undercounts post-fusion FLOPs by
+a few percent, which makes the reported MFU slightly conservative. Peak is
+per-chip bf16 (v5e: 197 TFLOP/s) x mesh size.
+
+Stage breakdown (SURVEY.md §5 tracing plan): wall-time of jitted prefixes
+of the step — trunk, +RPN heads, +proposal NMS, full forward+loss — whose
+successive differences attribute time to trunk / rpn_heads / proposal_nms
+/ targets_head_loss / backward_update. Differences of separately-jitted
+programs (XLA fuses differently per program), so treat small negative
+deltas as noise floors, not measurement bugs.
 """
 
 from __future__ import annotations
@@ -136,7 +152,8 @@ def _measure(config, profile_dir=None) -> None:
         # axis and spatial partitioning); force synthetic data
         # (dataset-independent measurement) and fill every device
         n_model = max(1, config.mesh.num_model)
-        n_data = max(1, n_dev // n_model)
+        validate_parallel(config, n_dev)  # descriptive num_model/mesh-fit errors
+        n_data = n_dev // n_model
         cfg = config.replace(
             data=dataclasses.replace(config.data, dataset="synthetic"),
             mesh=dataclasses.replace(config.mesh, num_data=n_data),
@@ -147,7 +164,7 @@ def _measure(config, profile_dir=None) -> None:
             cfg = cfg.replace(
                 train=dataclasses.replace(cfg.train, batch_size=batch_size)
             )
-    validate_parallel(cfg)
+    validate_parallel(cfg, n_dev)
     mesh = make_mesh(cfg.mesh)
     tx, _ = make_optimizer(cfg, steps_per_epoch=100)
     model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
@@ -210,16 +227,176 @@ def _measure(config, profile_dir=None) -> None:
         if ref:
             vs_baseline = images_per_sec / ref
 
-    print(
-        json.dumps(
-            {
-                "metric": "train_images_per_sec_600x600",
-                "value": round(images_per_sec, 3),
-                "unit": "images/sec",
-                "vs_baseline": round(vs_baseline, 3) if np.isfinite(vs_baseline) else None,
-            }
+    flops_per_step = _step_flops(step, state, device_batch)
+    mfu = None
+    if flops_per_step:
+        peak = _peak_flops_per_sec(n_dev)
+        if peak:
+            mfu = (flops_per_step * images_per_sec / batch_size) / peak
+
+    out = {
+        "metric": "train_images_per_sec_600x600",
+        "value": round(images_per_sec, 3),
+        "unit": "images/sec",
+        "vs_baseline": round(vs_baseline, 3) if np.isfinite(vs_baseline) else None,
+        "flops_per_step": flops_per_step,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+    }
+    if os.environ.get("BENCH_BREAKDOWN", "1") != "0":
+        step_ms = dt / n_steps * 1e3
+        # The breakdown is strictly optional decoration on an already-won
+        # measurement: if one of its 4 extra stage compiles wedges the
+        # remote tunnel (unkillable from Python), a side timer prints the
+        # primary metric and exits instead of letting the main watchdog
+        # report value=0; a plain exception just annotates the JSON.
+        budget = float(os.environ.get("BENCH_BREAKDOWN_S", "600"))
+        guard = threading.Timer(
+            budget,
+            lambda: (
+                print(
+                    json.dumps(
+                        {
+                            **out,
+                            "breakdown": {
+                                "error": f"wedged >{budget:.0f}s; skipped"
+                            },
+                        }
+                    ),
+                    flush=True,
+                ),
+                os._exit(0),
+            ),
         )
-    )
+        guard.daemon = True
+        guard.start()
+        try:
+            out["breakdown"] = _stage_breakdown(
+                model, cfg, state, device_batch, step_ms
+            )
+        except Exception as e:  # never lose the primary metric
+            out["breakdown"] = {"error": repr(e)}
+        finally:
+            guard.cancel()
+    print(json.dumps(out))
+
+
+def _step_flops(step, state, device_batch):
+    """One train step's FLOPs per XLA's HloCostAnalysis of the lowered
+    (pre-compile) module. Host-side only — never touches the device (the
+    remote-TPU tunnel in this image must not be asked to compile twice).
+    Returns None when the analysis is unavailable on the backend."""
+    try:
+        ca = step.lower(state, device_batch).cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        flops = float(ca.get("flops", 0.0)) if ca else 0.0
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def _peak_flops_per_sec(n_dev: int):
+    """Aggregate peak bf16 FLOP/s of the mesh, or None off-TPU (an MFU
+    against a CPU's peak would be meaningless for a TPU framework) or on an
+    unrecognized TPU generation (a silently-wrong peak would distort MFU).
+
+    The chip generation comes from the device's own ``device_kind``; the
+    PALLAS_AXON_TPU_GEN env var is only a fallback for plugin backends
+    whose device_kind string is opaque."""
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        return None
+    kind = getattr(dev, "device_kind", "").lower()
+    if not any(g in kind for g in ("v4", "v5", "v6")):
+        kind = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    if "v5 lite" in kind or "v5e" in kind or "v5lite" in kind:
+        peak = 197e12
+    elif "v5p" in kind or "v5" in kind:
+        peak = 459e12
+    elif "v6 lite" in kind or "v6e" in kind:
+        peak = 918e12
+    elif "v4" in kind:
+        peak = 275e12
+    else:
+        return None
+    return peak * n_dev
+
+
+def _stage_breakdown(model, cfg, state, device_batch, step_ms: float):
+    """Wall-time attribution across the step's pipeline stages.
+
+    Times four jitted prefixes of the step (each returning a scalar so the
+    host sync transfers nothing but still waits on the full computation):
+    trunk -> +rpn heads -> +proposal NMS -> full forward+loss; successive
+    differences plus the already-measured full-step time attribute
+    backward+update as the remainder. BENCH_BREAKDOWN=0 disables (4 extra
+    stage compiles).
+    """
+    import jax.numpy as jnp
+
+    from replication_faster_rcnn_tpu.train.train_step import compute_losses
+
+    h, w = cfg.data.image_size
+    images = device_batch["image"]
+
+    def _scalar(feat):
+        # FPN's extract_features returns a list of levels
+        feats = feat if isinstance(feat, (list, tuple)) else [feat]
+        return sum(f.astype(jnp.float32).sum() for f in feats)
+
+    @jax.jit
+    def trunk_fn(state, images):
+        v = {"params": state.params, "batch_stats": state.batch_stats}
+        feat = model.apply(v, images, False, method="extract_features")
+        return _scalar(feat)
+
+    @jax.jit
+    def rpn_fn(state, images):
+        v = {"params": state.params, "batch_stats": state.batch_stats}
+        feat = model.apply(v, images, False, method="extract_features")
+        logits, deltas, _ = model.apply(v, feat, method="rpn_forward")
+        return logits.astype(jnp.float32).sum() + deltas.astype(jnp.float32).sum()
+
+    @jax.jit
+    def propose_fn(state, images):
+        v = {"params": state.params, "batch_stats": state.batch_stats}
+        feat = model.apply(v, images, False, method="extract_features")
+        logits, deltas, anchors = model.apply(v, feat, method="rpn_forward")
+        rois, valid = model.apply(
+            v, logits, deltas, anchors, float(h), float(w), True, method="propose"
+        )
+        return rois.sum() + valid.sum()
+
+    @jax.jit
+    def forward_fn(state, batch):
+        rng = jax.random.fold_in(state.rng, state.step)
+        total, _ = compute_losses(
+            model, cfg, state.params, state.batch_stats, batch, rng, True
+        )
+        return total
+
+    def timed(fn, *args):
+        for _ in range(2):  # compile + 1 stabilizing run
+            out = fn(*args)
+        jax.device_get(out)
+        n, t0 = 5, time.time()
+        for _ in range(n):
+            out = fn(*args)
+        jax.device_get(out)
+        return (time.time() - t0) / n * 1e3
+
+    t_trunk = timed(trunk_fn, state, images)
+    t_rpn = timed(rpn_fn, state, images)
+    t_prop = timed(propose_fn, state, images)
+    t_fwd = timed(forward_fn, state, device_batch)
+    return {
+        "trunk_ms": round(t_trunk, 2),
+        "rpn_heads_ms": round(t_rpn - t_trunk, 2),
+        "proposal_nms_ms": round(t_prop - t_rpn, 2),
+        "targets_head_loss_ms": round(t_fwd - t_prop, 2),
+        "backward_update_ms": round(step_ms - t_fwd, 2),
+        "step_ms": round(step_ms, 2),
+    }
 
 
 if __name__ == "__main__":

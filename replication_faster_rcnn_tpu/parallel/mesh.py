@@ -103,15 +103,29 @@ def validate_spatial(config) -> None:
         )
 
 
-def validate_parallel(config) -> None:
+def validate_parallel(config, n_devices: Optional[int] = None) -> None:
     """All parallelism config checks shared by every entry point (Trainer,
-    benchmark): spatial partitioning constraints plus backend conflicts."""
+    benchmark): spatial partitioning constraints, backend conflicts, and
+    mesh-vs-device-count fit. ``n_devices`` defaults to every visible
+    device; pass the size of an explicit device subset if using one."""
     validate_spatial(config)
     if config.train.shard_opt_state and config.train.backend == "spmd":
         raise ValueError(
             "shard_opt_state (ZeRO-1 weight-update sharding) requires "
             "the jit auto-partitioning backend; the shard_map backend "
             "replicates state by construction"
+        )
+    n = n_devices if n_devices is not None else len(jax.devices())
+    n_model = max(1, config.mesh.num_model)
+    if n_model > n:
+        raise ValueError(
+            f"num_model={n_model} exceeds the {n} available device(s); "
+            "the model axis cannot be wider than the mesh"
+        )
+    if n % n_model != 0:
+        raise ValueError(
+            f"{n} device(s) cannot be split evenly into model groups of "
+            f"{n_model}; pick num_model dividing {n}"
         )
 
 

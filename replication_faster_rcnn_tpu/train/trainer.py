@@ -78,7 +78,9 @@ class Trainer:
     ) -> None:
         self.config = config
         self.workdir = workdir
-        validate_parallel(config)
+        validate_parallel(
+            config, len(devices) if devices is not None else None
+        )
         if config.mesh.num_data <= 0:
             # fit the data axis to the batch (a non-dividing batch fails in
             # jit with an opaque sharding error — e.g. the reference's

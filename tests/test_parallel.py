@@ -43,6 +43,28 @@ def test_mesh_shapes():
     assert make_mesh(cfg2.mesh).shape["data"] == 8
 
 
+def test_validate_parallel_mesh_fit():
+    """ADVICE r1 #3: a num_model that exceeds or does not divide the device
+    count must fail fast with a descriptive error at EVERY entry point
+    (shared validate_parallel), not silently drop devices in make_mesh."""
+    import dataclasses
+
+    import pytest
+
+    from replication_faster_rcnn_tpu.parallel import validate_parallel
+
+    cfg = _cfg(8)
+    validate_parallel(cfg, 8)  # ok: 1 divides 8
+    too_wide = cfg.replace(mesh=dataclasses.replace(cfg.mesh, num_model=16))
+    with pytest.raises(ValueError, match="exceeds the 8 available"):
+        validate_parallel(too_wide, 8)
+    uneven = cfg.replace(
+        mesh=dataclasses.replace(cfg.mesh, num_data=-1, num_model=3)
+    )
+    with pytest.raises(ValueError, match="split evenly"):
+        validate_parallel(uneven, 8)
+
+
 def test_shard_batch_placement():
     cfg = _cfg(8)
     mesh = make_mesh(cfg.mesh)
