@@ -1,0 +1,155 @@
+"""Host input-pipeline throughput vs 8-chip demand (SURVEY.md §7 hard
+part #4: the ≥6x target assumes the chips are never input-bound).
+
+Builds a synthetic VOC devkit (typical-VOC-sized JPEGs + XML annotations)
+in /tmp, then measures the real ingest path — PIL JPEG decode -> native
+C++ fused resize+normalize (`native/frcnn_native.cpp`, numpy fallback) ->
+XML parse -> pad-to-max_boxes -> collate — three ways:
+
+  * one-sample __getitem__ rate (the per-core ceiling),
+  * DataLoader end-to-end (prefetch thread + worker pool),
+  * the resize+normalize kernel alone, native vs numpy fallback.
+
+Demand model: measured per-chip train images/sec x 8 chips (the v5e-8
+north-star topology). The verdict records how many CPU cores/hosts at the
+measured per-core rate would be needed — this 1-core container cannot
+feed 8 chips, and the number quantifies exactly what can.
+
+Writes benchmarks/loader_throughput.json; prints it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# measured on the real chip (b8 600x600, 2026-07-30, see README/SKILL.md);
+# overridable once a newer BENCH number exists
+PER_CHIP_IMG_S = float(os.environ.get("LOADER_DEMAND_PER_CHIP", "124"))
+N_CHIPS = 8
+
+
+def _build_devkit(root: str, n_images: int) -> None:
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    os.makedirs(os.path.join(root, "ImageSets", "Main"), exist_ok=True)
+    os.makedirs(os.path.join(root, "JPEGImages"), exist_ok=True)
+    os.makedirs(os.path.join(root, "Annotations"), exist_ok=True)
+    ids = [f"{i:06d}" for i in range(n_images)]
+    with open(os.path.join(root, "ImageSets", "Main", "train.txt"), "w") as f:
+        f.write("\n".join(ids) + "\n")
+    for i, img_id in enumerate(ids):
+        w, h = 500, 375  # typical VOC photo size
+        arr = rng.randint(0, 255, (h, w, 3), np.uint8)
+        Image.fromarray(arr).save(
+            os.path.join(root, "JPEGImages", img_id + ".jpg"), quality=85
+        )
+        objs = []
+        for _ in range(rng.randint(1, 5)):
+            x1, y1 = rng.randint(0, w - 60), rng.randint(0, h - 60)
+            bw, bh = rng.randint(30, 60), rng.randint(30, 60)
+            objs.append(
+                f"<object><name>car</name><difficult>0</difficult>"
+                f"<bndbox><xmin>{x1}</xmin><ymin>{y1}</ymin>"
+                f"<xmax>{x1+bw}</xmax><ymax>{y1+bh}</ymax></bndbox></object>"
+            )
+        with open(os.path.join(root, "Annotations", img_id + ".xml"), "w") as f:
+            f.write(
+                f"<annotation><size><width>{w}</width><height>{h}</height>"
+                f"</size>{''.join(objs)}</annotation>"
+            )
+
+
+def main() -> None:
+    from replication_faster_rcnn_tpu.config import DataConfig
+    from replication_faster_rcnn_tpu.data import native_ops
+    from replication_faster_rcnn_tpu.data.loader import DataLoader
+    from replication_faster_rcnn_tpu.data.voc import VOCDataset
+
+    n_images = int(os.environ.get("LOADER_BENCH_IMAGES", "64"))
+    root = "/tmp/loader_bench_voc"
+    if os.path.exists(root):
+        shutil.rmtree(root)
+    _build_devkit(root, n_images)
+
+    cfg = DataConfig(root_dir=root, dataset="voc", image_size=(600, 600))
+    ds = VOCDataset(cfg, "train")
+
+    # per-sample rate (single-threaded ceiling); warm one sample first
+    ds[0]
+    t0 = time.time()
+    for i in range(n_images):
+        ds[i]
+    per_sample_s = (time.time() - t0) / n_images
+    single_rate = 1.0 / per_sample_s
+
+    # DataLoader end-to-end, 3 epochs at batch 8
+    loader = DataLoader(ds, batch_size=8, shuffle=True, prefetch=2, num_workers=4)
+    n = 0
+    t0 = time.time()
+    for epoch in range(3):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            n += batch["image"].shape[0]
+    loader_rate = n / (time.time() - t0)
+
+    # the fused resize+normalize kernel alone: native C++ vs numpy fallback
+    arr = np.random.RandomState(1).randint(0, 255, (375, 500, 3), np.uint8)
+    mean = np.asarray(cfg.pixel_mean, np.float32)
+    std = np.asarray(cfg.pixel_std, np.float32)
+    reps = 20
+
+    def _rate(fn):
+        fn()  # warm
+        t0 = time.time()
+        for _ in range(reps):
+            fn()
+        return reps / (time.time() - t0)
+
+    kernel = {
+        "native": (
+            _rate(lambda: native_ops.resize_normalize(arr, (600, 600), mean, std))
+            if native_ops.native_available()
+            else None
+        ),
+        "numpy": _rate(
+            lambda: native_ops._resize_normalize_numpy(arr, (600, 600), mean, std)
+        ),
+    }
+
+    demand = PER_CHIP_IMG_S * N_CHIPS
+    out = {
+        "single_thread_images_per_sec": round(single_rate, 2),
+        "loader_images_per_sec": round(loader_rate, 2),
+        "resize_normalize_native_per_sec": (
+            round(kernel["native"], 2) if kernel.get("native") else None
+        ),
+        "resize_normalize_numpy_per_sec": round(kernel["numpy"], 2),
+        "demand_v5e8_images_per_sec": demand,
+        "per_chip_images_per_sec": PER_CHIP_IMG_S,
+        "cores_needed_at_measured_rate": round(demand / max(single_rate, 1e-9), 1),
+        "host_cpu_count": os.cpu_count(),
+        "n_images": n_images,
+        "keeps_up": loader_rate >= demand,
+        "notes": "1-core container; DataLoader threads cannot exceed the "
+        "single-core decode rate here — the cores_needed figure is the "
+        "per-host worker budget a real v5e-8 host needs",
+    }
+    path = os.path.join(REPO, "benchmarks", "loader_throughput.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,133 @@
+"""mAP evidence run: full-Trainer mini-training to mAP@0.5 >= 0.9.
+
+No VOC/COCO exists in this image (zero egress), so the strongest available
+evidence for the BASELINE "mAP@0.5 parity" north star is end-to-end: the
+full Trainer (ONE jitted SPMD train step, orbax checkpointing, per-epoch
+in-training eval through the real eval path `eval/detect` ->
+`eval/voc_eval`) trained on planted-rectangle synthetic data
+(`data/synthetic.py` — class-colored rectangles a detector can genuinely
+learn) until the evaluator reports high mAP. The reference cannot run this
+check at all: its eval was never written (`/root/reference/test_eval.py`
+is empty, SURVEY.md §2.1 #15).
+
+What this proves: the whole train->checkpoint->restore->decode->mAP chain
+is correct and can drive a detector to high mAP on data it has learned.
+What remains for the full parity claim (PARITY.md §"mAP parity status"):
+pointing `--dataset voc --data-root <VOC2007>` at a real devkit and
+training the voc_resnet18 preset to compare mAP@0.5 against a reference
+run — blocked only on dataset availability, not on framework capability.
+
+Writes:
+  benchmarks/map_overfit_curve.jsonl  — per-step losses + per-epoch val mAP
+  benchmarks/map_overfit_result.json  — summary incl. restored-checkpoint
+                                        consistency check and train-set mAP
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python benchmarks/map_overfit.py` from anywhere
+    sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=60)
+    ap.add_argument("--images", type=int, default=48)
+    ap.add_argument("--image-size", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--eval-every", type=int, default=2)
+    ap.add_argument("--num-data", type=int, default=1,
+                    help="data-parallel mesh width (1 = single device)")
+    ap.add_argument("--dtype", default="float32",
+                    help="compute dtype: float32 on CPU, bfloat16 on TPU")
+    ap.add_argument("--workdir", default="/tmp/map_overfit_ckpts")
+    args = ap.parse_args()
+
+    from replication_faster_rcnn_tpu.config import (
+        DataConfig,
+        MeshConfig,
+        ModelConfig,
+        TrainConfig,
+        get_config,
+    )
+    from replication_faster_rcnn_tpu.data import SyntheticDataset
+    from replication_faster_rcnn_tpu.eval import Evaluator
+    from replication_faster_rcnn_tpu.train.trainer import Trainer
+
+    size = (args.image_size, args.image_size)
+    cfg = get_config("voc_resnet18").replace(
+        model=ModelConfig(
+            backbone="resnet18", roi_op="align", compute_dtype=args.dtype
+        ),
+        data=DataConfig(dataset="synthetic", image_size=size, max_boxes=8),
+        train=TrainConfig(
+            batch_size=args.batch,
+            n_epoch=args.epochs,
+            lr=args.lr,
+            eval_every_epochs=args.eval_every,
+            checkpoint_every_epochs=max(args.epochs // 4, 1),
+            seed=0,
+        ),
+        mesh=MeshConfig(num_data=args.num_data),
+    )
+
+    train_ds = SyntheticDataset(cfg.data, "train", length=args.images)
+    trainer = Trainer(cfg, workdir=args.workdir, dataset=train_ds)
+    curve_path = os.path.join(REPO, "benchmarks", "map_overfit_curve.jsonl")
+    if os.path.exists(curve_path):
+        os.remove(curve_path)
+    trainer.logger.jsonl_path = curve_path
+
+    t0 = time.time()
+    last = trainer.train(log_every=5)
+    train_s = time.time() - t0
+    trainer.save()  # final state, whatever the epoch cadence saved last
+
+    # the in-training eval used the val split (disjoint synthetic stream):
+    # generalization mAP. Also measure memorization mAP on the train set.
+    variables = {
+        "params": trainer.state.params,
+        "batch_stats": trainer.state.batch_stats,
+    }
+    evaluator = Evaluator(cfg, trainer.model)
+    train_map = float(
+        evaluator.evaluate(variables, train_ds, batch_size=args.batch)["mAP"]
+    )
+
+    # checkpoint/resume leg: a FRESH trainer restoring the final checkpoint
+    # must reproduce the same val mAP (exercises orbax save->restore on the
+    # exact state the curve ends on).
+    trainer2 = Trainer(cfg, workdir=args.workdir, dataset=train_ds)
+    restored_step = trainer2.restore()
+    restored_map = float(trainer2.evaluate()["mAP"])
+
+    result = {
+        "final_val_mAP": last.get("mAP"),
+        "train_set_mAP": train_map,
+        "restored_step": restored_step,
+        "restored_val_mAP": restored_map,
+        "epochs": args.epochs,
+        "images": args.images,
+        "image_size": args.image_size,
+        "batch": args.batch,
+        "lr": args.lr,
+        "dtype": args.dtype,
+        "train_seconds": round(train_s, 1),
+        "backend": __import__("jax").default_backend(),
+    }
+    out_path = os.path.join(REPO, "benchmarks", "map_overfit_result.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

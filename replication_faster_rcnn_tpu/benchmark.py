@@ -110,14 +110,14 @@ def main(config=None, profile_dir=None) -> None:
     watchdog = _arm_watchdog()
     try:
         _probe_device()
-        _measure(config, profile_dir)
+        _measure(config, profile_dir, watchdog=watchdog)
     finally:
         # a raised exception must not leave the timer alive to later print a
         # bogus zero-metric line and os._exit a host process
         watchdog.cancel()
 
 
-def _measure(config, profile_dir=None) -> None:
+def _measure(config, profile_dir=None, watchdog=None) -> None:
     import dataclasses
 
     from replication_faster_rcnn_tpu.config import (
@@ -228,6 +228,12 @@ def _measure(config, profile_dir=None) -> None:
             vs_baseline = images_per_sec / ref
 
     flops_per_step = _step_flops(step, state, device_batch)
+    if flops_per_step and cfg.train.backend == "spmd":
+        # jit(shard_map(...)) lowers the body at per-shard shapes, so the
+        # cost analysis counts ONE device's FLOPs; scale to the global step
+        # so mfu is comparable with the auto-partitioning backend (whose
+        # lowered module carries global shapes).
+        flops_per_step *= mesh.devices.size
     mfu = None
     if flops_per_step:
         peak = _peak_flops_per_sec(n_dev)
@@ -248,7 +254,11 @@ def _measure(config, profile_dir=None) -> None:
         # measurement: if one of its 4 extra stage compiles wedges the
         # remote tunnel (unkillable from Python), a side timer prints the
         # primary metric and exits instead of letting the main watchdog
-        # report value=0; a plain exception just annotates the JSON.
+        # report value=0; a plain exception just annotates the JSON. The
+        # main watchdog (whose firing would discard the metric) stands
+        # down first — from here on the guard is the only failure path.
+        if watchdog is not None:
+            watchdog.cancel()
         budget = float(os.environ.get("BENCH_BREAKDOWN_S", "600"))
         guard = threading.Timer(
             budget,
@@ -344,23 +354,30 @@ def _stage_breakdown(model, cfg, state, device_batch, step_ms: float):
         feats = feat if isinstance(feat, (list, tuple)) else [feat]
         return sum(f.astype(jnp.float32).sum() for f in feats)
 
+    def _features(state, images):
+        # train=True to match what the timed step executes (train-mode BN
+        # computes batch statistics; eval-mode would misattribute that
+        # cost to the forward_fn - propose_fn difference)
+        v = {"params": state.params, "batch_stats": state.batch_stats}
+        feat, _ = model.apply(
+            v, images, True, method="extract_features", mutable=["batch_stats"]
+        )
+        return v, feat
+
     @jax.jit
     def trunk_fn(state, images):
-        v = {"params": state.params, "batch_stats": state.batch_stats}
-        feat = model.apply(v, images, False, method="extract_features")
+        _, feat = _features(state, images)
         return _scalar(feat)
 
     @jax.jit
     def rpn_fn(state, images):
-        v = {"params": state.params, "batch_stats": state.batch_stats}
-        feat = model.apply(v, images, False, method="extract_features")
+        v, feat = _features(state, images)
         logits, deltas, _ = model.apply(v, feat, method="rpn_forward")
         return logits.astype(jnp.float32).sum() + deltas.astype(jnp.float32).sum()
 
     @jax.jit
     def propose_fn(state, images):
-        v = {"params": state.params, "batch_stats": state.batch_stats}
-        feat = model.apply(v, images, False, method="extract_features")
+        v, feat = _features(state, images)
         logits, deltas, anchors = model.apply(v, feat, method="rpn_forward")
         rois, valid = model.apply(
             v, logits, deltas, anchors, float(h), float(w), True, method="propose"

@@ -117,6 +117,16 @@ def validate_parallel(config, n_devices: Optional[int] = None) -> None:
         )
     n = n_devices if n_devices is not None else len(jax.devices())
     n_model = max(1, config.mesh.num_model)
+    if config.mesh.num_data > 0:
+        # explicit sub-mesh: the user chose both axes — only require that
+        # the requested grid actually fits the devices
+        need = config.mesh.num_data * n_model
+        if need > n:
+            raise ValueError(
+                f"mesh {config.mesh.num_data}x{n_model} needs {need} "
+                f"device(s) but only {n} are available"
+            )
+        return
     if n_model > n:
         raise ValueError(
             f"num_model={n_model} exceeds the {n} available device(s); "

@@ -54,10 +54,21 @@ def test_validate_parallel_mesh_fit():
     from replication_faster_rcnn_tpu.parallel import validate_parallel
 
     cfg = _cfg(8)
-    validate_parallel(cfg, 8)  # ok: 1 divides 8
+    validate_parallel(cfg, 8)  # ok: explicit 8x1 grid fits exactly
+    # explicit sub-mesh: both axes chosen -> only a fit check (2x3 on 8
+    # devices is a legal 6-device sub-mesh)
+    validate_parallel(
+        cfg.replace(mesh=dataclasses.replace(cfg.mesh, num_data=2, num_model=3)),
+        8,
+    )
     too_wide = cfg.replace(mesh=dataclasses.replace(cfg.mesh, num_model=16))
-    with pytest.raises(ValueError, match="exceeds the 8 available"):
+    with pytest.raises(ValueError, match="needs 128"):
         validate_parallel(too_wide, 8)
+    auto_too_wide = cfg.replace(
+        mesh=dataclasses.replace(cfg.mesh, num_data=-1, num_model=16)
+    )
+    with pytest.raises(ValueError, match="exceeds the 8 available"):
+        validate_parallel(auto_too_wide, 8)
     uneven = cfg.replace(
         mesh=dataclasses.replace(cfg.mesh, num_data=-1, num_model=3)
     )
