@@ -79,6 +79,15 @@ def main() -> None:
         mesh=MeshConfig(num_data=args.num_data),
     )
 
+    # a stale workdir would defeat the restore-consistency leg below:
+    # Trainer.save() dedups on latest_step(), so a rerun with identical
+    # step counts but different hyperparameters would silently keep (and
+    # then "restore") the previous run's checkpoints
+    if os.path.exists(args.workdir):
+        import shutil
+
+        shutil.rmtree(args.workdir)
+
     train_ds = SyntheticDataset(cfg.data, "train", length=args.images)
     trainer = Trainer(cfg, workdir=args.workdir, dataset=train_ds)
     curve_path = os.path.join(REPO, "benchmarks", "map_overfit_curve.jsonl")
@@ -108,6 +117,11 @@ def main() -> None:
     trainer2 = Trainer(cfg, workdir=args.workdir, dataset=train_ds)
     restored_step = trainer2.restore()
     restored_map = float(trainer2.evaluate()["mAP"])
+    final_map = last.get("mAP")
+    if final_map is not None and abs(restored_map - final_map) > 1e-9:
+        raise AssertionError(
+            f"restored checkpoint mAP {restored_map} != final mAP {final_map}"
+        )
 
     result = {
         "final_val_mAP": last.get("mAP"),

@@ -229,11 +229,12 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
 
     flops_per_step = _step_flops(step, state, device_batch)
     if flops_per_step and cfg.train.backend == "spmd":
-        # jit(shard_map(...)) lowers the body at per-shard shapes, so the
-        # cost analysis counts ONE device's FLOPs; scale to the global step
-        # so mfu is comparable with the auto-partitioning backend (whose
+        # jit(shard_map(...)) lowers the body at per-shard shapes — the
+        # batch is sharded over the DATA axis only — so the cost analysis
+        # counts global/num_data FLOPs; scale by the data-axis width so
+        # mfu is comparable with the auto-partitioning backend (whose
         # lowered module carries global shapes).
-        flops_per_step *= mesh.devices.size
+        flops_per_step *= mesh.shape[cfg.mesh.data_axis]
     mfu = None
     if flops_per_step:
         peak = _peak_flops_per_sec(n_dev)

@@ -18,7 +18,7 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_allreduce():
+def test_two_process_allreduce(tmp_path):
     port = _free_port()
     coordinator = f"127.0.0.1:{port}"
     script = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
@@ -40,9 +40,11 @@ def test_two_process_allreduce():
         ]
     )
 
+    workdir = str(tmp_path / "zero_ckpt")
     procs = [
         subprocess.Popen(
-            [sys.executable, script, coordinator, str(pid), "2", "trainstep"],
+            [sys.executable, script, coordinator, str(pid), "2", "trainstep",
+             workdir],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -55,11 +57,20 @@ def test_two_process_allreduce():
     for p in procs:
         try:
             # generous: two jax processes compile concurrently on one core
-            out, _ = p.communicate(timeout=540)
+            # (trainstep + zero1 + trainer ckpt legs each compile once)
+            out, _ = p.communicate(timeout=1500)
         except subprocess.TimeoutExpired:
+            partial = []
             for q in procs:
                 q.kill()
-            pytest.fail("multi-host worker timed out")
+                try:
+                    partial.append(q.communicate(timeout=10)[0] or "")
+                except Exception:
+                    partial.append("<unreadable>")
+            pytest.fail(
+                "multi-host worker timed out; partial output:\n"
+                + "\n---\n".join(partial)
+            )
         outs.append(out)
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}"
@@ -68,3 +79,6 @@ def test_two_process_allreduce():
     # the full sharded train step ran across the process boundary
     assert "trainstep loss=" in outs[0] and "trainstep loss=" in outs[1]
     assert "zero1 loss=" in outs[0] and "zero1 loss=" in outs[1]
+    # Trainer.save/restore of cross-process ZeRO-sharded moments (ADVICE #4)
+    assert "zero1 ckpt roundtrip OK" in outs[0]
+    assert "zero1 ckpt roundtrip OK" in outs[1]
