@@ -231,6 +231,24 @@ def cmd_predict(args) -> int:
     return 0
 
 
+def cmd_viz(args) -> int:
+    """Visual sanity artifacts (reference `utils/anchors.py:64-77` anchor
+    plot and `utils/data_loader.py:119-134` gt overlay, as a real command)."""
+    _apply_device(args.device)
+    cfg = _build_config(args)
+    from replication_faster_rcnn_tpu.utils import viz
+
+    if args.what == "anchors":
+        viz.draw_anchor_centers(cfg, args.output)
+    else:  # sample
+        from replication_faster_rcnn_tpu.data.loader import make_dataset
+
+        ds = make_dataset(cfg.data, args.split)
+        viz.draw_gt_overlay(ds[args.index], cfg, args.output)
+    print(f"{args.what} visualization written to {args.output}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(prog="replication_faster_rcnn_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -285,6 +303,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_pred.add_argument("--output", default=None,
                         help="write the image with boxes drawn to this path")
     p_pred.set_defaults(fn=cmd_predict)
+
+    p_viz = sub.add_parser("viz", help="visual sanity artifacts "
+                                       "(anchor centers / gt overlay)")
+    _add_common(p_viz)
+    p_viz.add_argument("what", choices=["anchors", "sample"])
+    p_viz.add_argument("--output", required=True)
+    p_viz.add_argument("--split", default="train")
+    p_viz.add_argument("--index", type=int, default=0,
+                       help="dataset sample index (what=sample)")
+    p_viz.set_defaults(fn=cmd_viz)
 
     args = parser.parse_args(argv)
     return args.fn(args)

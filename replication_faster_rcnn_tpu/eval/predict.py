@@ -62,15 +62,17 @@ def draw_detections(image_path: str, detections, out_path: str) -> None:
     """Render boxes + labels onto the image (PIL)."""
     from PIL import Image, ImageDraw
 
+    from replication_faster_rcnn_tpu.utils.viz import draw_labeled_boxes
+
     with Image.open(image_path) as im:
         im = im.convert("RGB")
         draw = ImageDraw.Draw(im)
-        for det in detections:
-            r1, c1, r2, c2 = det["box"]
-            draw.rectangle([c1, r1, c2, r2], outline=(255, 40, 40), width=2)
-            draw.text(
-                (c1 + 2, max(r1 - 12, 0)),
-                f"{det['class_name']} {det['score']:.2f}",
-                fill=(255, 40, 40),
-            )
+        draw_labeled_boxes(
+            draw,
+            (
+                (d["box"], f"{d['class_name']} {d['score']:.2f}")
+                for d in detections
+            ),
+            (255, 40, 40),
+        )
         im.save(out_path)

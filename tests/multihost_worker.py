@@ -64,14 +64,20 @@ def main() -> int:
 
     if len(sys.argv) > 4 and sys.argv[4] == "trainstep":
         _train_step_across_processes(process_id, n_global)
-        # default workdir is scoped to the coordinator address (unique per
-        # test run): a fixed shared path + Trainer.save()'s latest_step
-        # dedup would silently restore a PREVIOUS invocation's checkpoint
-        workdir = (
-            sys.argv[5]
-            if len(sys.argv) > 5
-            else f"/tmp/multihost_zero_ckpt_{coordinator.replace(':', '_')}"
-        )
+        # default workdir is scoped to the coordinator address AND cleaned
+        # by process 0: ephemeral ports get reused, and a stale dir +
+        # Trainer.save()'s latest_step dedup would silently restore a
+        # PREVIOUS invocation's checkpoint. (Safe to clean here: the save
+        # both processes participate in happens long after this point, and
+        # process 1 never reads the dir before that barrier.)
+        if len(sys.argv) > 5:
+            workdir = sys.argv[5]
+        else:
+            workdir = f"/tmp/multihost_zero_ckpt_{coordinator.replace(':', '_')}"
+            if process_id == 0 and os.path.exists(workdir):
+                import shutil
+
+                shutil.rmtree(workdir)
         _zero_checkpoint_across_processes(process_id, workdir)
     return 0
 

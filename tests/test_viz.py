@@ -1,0 +1,69 @@
+"""Visual sanity artifact tests (reference `utils/anchors.py:64-77` and
+`utils/data_loader.py:119-134` equivalents, `utils/viz.py` + `cli viz`)."""
+
+import numpy as np
+import pytest
+
+from replication_faster_rcnn_tpu import cli
+from replication_faster_rcnn_tpu.config import (
+    DataConfig,
+    FasterRCNNConfig,
+    ModelConfig,
+)
+from replication_faster_rcnn_tpu.data import SyntheticDataset
+from replication_faster_rcnn_tpu.utils import viz
+
+
+def _cfg():
+    return FasterRCNNConfig(
+        model=ModelConfig(backbone="resnet18", compute_dtype="float32"),
+        data=DataConfig(dataset="synthetic", image_size=(96, 96), max_boxes=4),
+    )
+
+
+class TestAnchorCenters:
+    def test_lattice_positions(self):
+        cfg = _cfg()
+        im = np.asarray(viz.draw_anchor_centers(cfg))
+        assert im.shape == (96, 96, 3)
+        # centers at multiples of feat_stride=16 (ops/anchors.py fixes the
+        # reference's transposed-center bug; a regression would leave
+        # (16,16) unpainted for non-square lattices — here check both a
+        # painted center and an off-lattice point staying white)
+        assert (im[16, 16] != [255, 255, 255]).any()
+        assert (im[8, 8] == [255, 255, 255]).all()
+
+    def test_saves_file(self, tmp_path):
+        out = tmp_path / "anchors.png"
+        viz.draw_anchor_centers(_cfg(), str(out))
+        assert out.exists()
+
+
+class TestGtOverlay:
+    def test_boxes_drawn_on_unnormalized_image(self):
+        cfg = _cfg()
+        ds = SyntheticDataset(cfg.data, "train", length=1)
+        sample = ds[0]
+        im = np.asarray(viz.draw_gt_overlay(sample, cfg))
+        assert im.shape == (96, 96, 3)
+        # every valid gt box's top edge carries the overlay color
+        boxes = sample["boxes"][sample["mask"]]
+        assert len(boxes) >= 1
+        for r1, c1, r2, c2 in boxes:
+            r1, c1 = int(max(r1, 0)), int(max(c1, 0))
+            edge = im[r1 : r1 + 2, int(c1) : int(c2)]
+            assert (edge == np.asarray([40, 220, 40])).all(axis=-1).any()
+
+    def test_cli_viz_writes_both_artifacts(self, tmp_path, capsys):
+        for what in ("anchors", "sample"):
+            out = tmp_path / f"{what}.png"
+            rc = cli.main(
+                ["viz", what, "--dataset", "synthetic", "--image-size", "96",
+                 "--output", str(out)]
+            )
+            assert rc == 0
+            assert out.exists()
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])
