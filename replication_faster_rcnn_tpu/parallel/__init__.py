@@ -1,6 +1,7 @@
 from replication_faster_rcnn_tpu.parallel.mesh import (  # noqa: F401
     batch_sharding,
     fit_data_parallelism,
+    gather_replicated,
     image_sharding,
     initialize_distributed,
     make_mesh,

@@ -19,6 +19,7 @@ scales out over DCN unchanged.
 
 from __future__ import annotations
 
+import functools
 import os
 from typing import Any, Dict, Optional, Sequence
 
@@ -193,3 +194,23 @@ def replicate_tree(tree: Any, mesh: Mesh) -> Any:
     """Place a pytree fully-replicated on the mesh (params, opt state)."""
     sharding = replicated(mesh)
     return jax.device_put(tree, sharding)
+
+
+@functools.lru_cache(maxsize=None)
+def _gather_fn(sharding: NamedSharding):
+    # one stable jit instance per target sharding, so repeated checkpoint
+    # events hit the jit cache instead of re-tracing a fresh lambda
+    return jax.jit(lambda t: t, out_shardings=sharding)
+
+
+def gather_replicated(tree: Any, mesh: Mesh) -> Any:
+    """All-gather a (possibly cross-process sharded) pytree to fully
+    replicated via a compiled identity.
+
+    `jax.device_put` resharding works within one process but DEADLOCKS
+    when the source shards live on other processes' devices (observed in
+    the 2-process ZeRO checkpoint test: both workers hung inside
+    `_host_state`); a jitted identity with replicated out_shardings
+    compiles to an explicit all-gather that every process executes
+    collectively, which is the supported cross-process path."""
+    return _gather_fn(replicated(mesh))(tree)

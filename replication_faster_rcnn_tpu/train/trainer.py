@@ -28,6 +28,7 @@ from replication_faster_rcnn_tpu.parallel import (
     batch_sharding,
     fit_data_parallelism,
     make_mesh,
+    gather_replicated,
     replicate_tree,
     shard_batch,
     validate_parallel,
@@ -156,15 +157,19 @@ class Trainer:
             )
         return self._ckpt_mgr
 
-    def _host_state(self):
-        """Full state on host. Sharded optimizer state (ZeRO-1) is
-        re-placed fully-replicated first — a device-side all-gather —
-        because device_get cannot fetch arrays whose shards live on other
-        processes' chips (multi-host)."""
+    def _replicated_state(self) -> TrainState:
+        """State with every leaf fully replicated on the mesh. Sharded
+        optimizer state (ZeRO-1) is all-gathered via a compiled identity
+        (`gather_replicated`) — a plain device_put cannot reshard leaves
+        whose shards live on other processes' chips (multi-host)."""
         state = self.state
         if self.config.train.shard_opt_state:
-            state = replicate_tree(state, self.mesh)
-        return jax.device_get(state)
+            state = gather_replicated(state, self.mesh)
+        return state
+
+    def _host_state(self):
+        """Full state on host (numpy)."""
+        return jax.device_get(self._replicated_state())
 
     def save(self, step: Optional[int] = None) -> None:
         import orbax.checkpoint as ocp
@@ -172,8 +177,13 @@ class Trainer:
         step = int(self.state.step) if step is None else step
         if self.checkpoint_manager.latest_step() == step:
             return  # already checkpointed (orbax raises on duplicate steps)
+        # Hand orbax the REPLICATED jax arrays, not host numpy: with
+        # jax.Array inputs orbax's replica logic makes process 0 the only
+        # writer in a multi-process run; a device_get'd numpy tree loses
+        # that information and every process tries to write the same files
+        # (observed as a deadlock inside save() in the 2-process test).
         self.checkpoint_manager.save(
-            step, args=ocp.args.StandardSave(self._host_state())
+            step, args=ocp.args.StandardSave(self._replicated_state())
         )
         self.checkpoint_manager.wait_until_finished()
 

@@ -195,15 +195,27 @@ def _zero_checkpoint_across_processes(process_id: int, workdir: str) -> None:
         train=TrainConfig(batch_size=n_global, shard_opt_state=True, n_epoch=1),
         mesh=MeshConfig(num_data=n_global),
     )
+    def mark(msg: str) -> None:
+        # stdout to the harness is a block-buffered PIPE: flush each stage
+        # marker so a hang is attributable from partial output
+        print(f"proc {process_id}: ckpt-leg {msg}", flush=True)
+
     ds = SyntheticDataset(cfg.data, length=n_global)
     trainer = Trainer(cfg, workdir=workdir, dataset=ds)
+    mark("trainer built")
     batch = collate([ds[i] for i in range(n_global)])
     trainer.train_one_batch(batch)
-    trainer.save()
+    mark("stepped")
+    # gather BEFORE save so a hang distinguishes the cross-process
+    # all-gather (_host_state) from the orbax write barrier
     want = trainer._host_state()
+    mark("gathered")
+    trainer.save()
+    mark("saved")
 
     trainer2 = Trainer(cfg, workdir=workdir, dataset=ds)
     assert trainer2.restore() == 1
+    mark("restored")
     got = trainer2._host_state()
 
     flat_w, tree_w = jax.tree_util.tree_flatten(want.opt_state)

@@ -43,8 +43,8 @@ def test_two_process_allreduce(tmp_path):
     workdir = str(tmp_path / "zero_ckpt")
     procs = [
         subprocess.Popen(
-            [sys.executable, script, coordinator, str(pid), "2", "trainstep",
-             workdir],
+            [sys.executable, "-u", script, coordinator, str(pid), "2",
+             "trainstep", workdir],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
