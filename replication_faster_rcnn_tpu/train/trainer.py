@@ -164,7 +164,14 @@ class Trainer:
         whose shards live on other processes' chips (multi-host)."""
         state = self.state
         if self.config.train.shard_opt_state:
-            state = gather_replicated(state, self.mesh)
+            # gather ONLY the sharded subtree: params/BN are already
+            # replicated, and a jitted identity (unlike device_put) always
+            # materializes fresh output buffers — gathering the whole state
+            # would transiently hold a second copy of the model at every
+            # checkpoint event
+            state = state.replace(
+                opt_state=gather_replicated(state.opt_state, self.mesh)
+            )
         return state
 
     def _host_state(self):

@@ -172,3 +172,85 @@ def test_make_dataset_dispatch(tmp_path):
     assert isinstance(
         make_dataset(_cfg(dataset="voc", root_dir=root), "train"), VOCDataset
     )
+
+
+def _write_coco(root: str):
+    """Mini COCO-2017 layout: 3 images (one crowd-only, so excluded),
+    sparse category ids (to exercise the contiguous remap), a crowd
+    annotation (skipped), and rectangular images (to exercise per-axis
+    scaling of xywh boxes into row-major corners)."""
+    import json
+
+    from PIL import Image
+
+    os.makedirs(os.path.join(root, "annotations"), exist_ok=True)
+    os.makedirs(os.path.join(root, "val2017"), exist_ok=True)
+    for name, (w, h) in [("a.jpg", (100, 50)), ("b.jpg", (80, 40)), ("c.jpg", (64, 64))]:
+        Image.new("RGB", (w, h), (90, 90, 90)).save(
+            os.path.join(root, "val2017", name)
+        )
+    ann = {
+        "images": [
+            {"id": 7, "file_name": "a.jpg", "width": 100, "height": 50},
+            {"id": 9, "file_name": "b.jpg", "width": 80, "height": 40},
+            {"id": 11, "file_name": "c.jpg", "width": 64, "height": 64},
+        ],
+        # sparse ids with gaps, like real COCO (1..90 for 80 classes)
+        "categories": [
+            {"id": 3, "name": "car"},
+            {"id": 17, "name": "cat"},
+            {"id": 90, "name": "toothbrush"},
+        ],
+        "annotations": [
+            # image 7: one normal box, xywh in a 100x50 image
+            {"image_id": 7, "category_id": 17, "bbox": [10, 5, 50, 40], "iscrowd": 0},
+            # image 7: crowd region -> must be skipped
+            {"image_id": 7, "category_id": 3, "bbox": [0, 0, 99, 49], "iscrowd": 1},
+            # image 9: two boxes incl. the highest sparse id
+            {"image_id": 9, "category_id": 3, "bbox": [8, 4, 16, 8], "iscrowd": 0},
+            {"image_id": 9, "category_id": 90, "bbox": [40, 20, 20, 10], "iscrowd": 0},
+            # image 11: crowd-only -> the image is excluded entirely
+            {"image_id": 11, "category_id": 3, "bbox": [1, 1, 10, 10], "iscrowd": 1},
+        ],
+    }
+    with open(os.path.join(root, "annotations", "instances_val2017.json"), "w") as f:
+        json.dump(ann, f)
+
+
+class TestCOCO:
+    def test_parse_remap_scale_and_exclusions(self, tmp_path):
+        from replication_faster_rcnn_tpu.data.coco import COCODataset
+
+        root = str(tmp_path / "coco")
+        _write_coco(root)
+        cfg = DataConfig(
+            dataset="coco", root_dir=root, image_size=(100, 100), max_boxes=5
+        )
+        ds = COCODataset(cfg, "val2017")
+
+        # image 11 is crowd-only -> excluded; order is sorted image id
+        assert len(ds) == 2
+        assert ds.classes == ["__background__", "car", "cat", "toothbrush"]
+
+        s0 = ds[0]  # image 7 (100x50): one real box, crowd skipped
+        assert s0["image"].shape == (100, 100, 3)
+        assert int(s0["mask"].sum()) == 1
+        assert int(s0["labels"][0]) == 2  # cat: sparse id 17 -> contiguous 2
+        # xywh [10,5,50,40] in 100x50 -> rows x2, cols x1 at 100x100:
+        # row-major [y1*2, x1*1, (y+h)*2, (x+w)*1]
+        np.testing.assert_allclose(s0["boxes"][0], [10.0, 10.0, 90.0, 60.0])
+
+        s1 = ds[1]  # image 9 (80x40): two boxes, sparse id 90 -> 3
+        assert int(s1["mask"].sum()) == 2
+        assert sorted(int(x) for x in s1["labels"][:2]) == [1, 3]
+        # car box xywh [8,4,16,8] in 80x40 -> rows x2.5, cols x1.25
+        np.testing.assert_allclose(s1["boxes"][0], [10.0, 10.0, 30.0, 30.0])
+
+    def test_make_dataset_dispatches_coco_split_map(self, tmp_path):
+        root = str(tmp_path / "coco")
+        _write_coco(root)
+        cfg = DataConfig(
+            dataset="coco", root_dir=root, image_size=(64, 64), max_boxes=5
+        )
+        ds = make_dataset(cfg, "val")  # "val" -> "val2017"
+        assert len(ds) == 2
