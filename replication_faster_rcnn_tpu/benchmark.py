@@ -40,11 +40,17 @@ import jax
 import numpy as np
 
 
+# failure-path metric label; refined to the actual mode/shape as soon as the
+# measurement resolves its config, so a wedge report never mislabels an eval
+# or non-600 run as the train 600x600 number
+_METRIC = "train_images_per_sec_600x600"
+
+
 def _wedge_exit(reason: str):
     print(
         json.dumps(
             {
-                "metric": "train_images_per_sec_600x600",
+                "metric": _METRIC,
                 "value": 0.0,
                 "unit": "images/sec",
                 "vs_baseline": None,
@@ -107,10 +113,19 @@ def main(config=None, profile_dir=None) -> None:
     """Measure the jitted train step of ``config`` (default: the flagship
     voc_resnet18 at 600x600, batch 8/device) on all available devices.
     ``profile_dir`` wraps the timed loop in a jax.profiler trace."""
+    eval_mode = os.environ.get("BENCH_MODE", "train") == "eval"
+    # label failure paths with the right mode even before the config
+    # resolves (a probe-stage wedge must not mislabel the run) — set for
+    # BOTH modes so a prior in-process run's label can never go stale
+    global _METRIC
+    _METRIC = ("eval" if eval_mode else "train") + "_images_per_sec_600x600"
     watchdog = _arm_watchdog()
     try:
         _probe_device()
-        _measure(config, profile_dir, watchdog=watchdog)
+        if eval_mode:
+            _measure_eval(config, profile_dir, watchdog=watchdog)
+        else:
+            _measure(config, profile_dir, watchdog=watchdog)
     finally:
         # a raised exception must not leave the timer alive to later print a
         # bogus zero-metric line and os._exit a host process
@@ -164,6 +179,8 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
             cfg = cfg.replace(
                 train=dataclasses.replace(cfg.train, batch_size=batch_size)
             )
+    global _METRIC
+    _METRIC = "train_images_per_sec_{}x{}".format(*cfg.data.image_size)
     validate_parallel(cfg, n_dev)
     mesh = make_mesh(cfg.mesh)
     tx, _ = make_optimizer(cfg, steps_per_epoch=100)
@@ -227,14 +244,12 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
         if ref:
             vs_baseline = images_per_sec / ref
 
-    flops_per_step = _step_flops(step, state, device_batch)
-    if flops_per_step and cfg.train.backend == "spmd":
-        # jit(shard_map(...)) lowers the body at per-shard shapes — the
-        # batch is sharded over the DATA axis only — so the cost analysis
-        # counts global/num_data FLOPs; scale by the data-axis width so
-        # mfu is comparable with the auto-partitioning backend (whose
-        # lowered module carries global shapes).
-        flops_per_step *= mesh.shape[cfg.mesh.data_axis]
+    # the primary metric is won; the remaining work (FLOPs subprocess, up
+    # to BENCH_FLOPS_TIMEOUT_S, and the breakdown's stage compiles) must
+    # not let the main watchdog fire and discard it as a bogus wedge
+    if watchdog is not None:
+        watchdog.cancel()
+    flops_per_step = _step_flops(cfg, batch_size)
     mfu = None
     if flops_per_step:
         peak = _peak_flops_per_sec(n_dev)
@@ -242,7 +257,7 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
             mfu = (flops_per_step * images_per_sec / batch_size) / peak
 
     out = {
-        "metric": "train_images_per_sec_600x600",
+        "metric": _METRIC,
         "value": round(images_per_sec, 3),
         "unit": "images/sec",
         "vs_baseline": round(vs_baseline, 3) if np.isfinite(vs_baseline) else None,
@@ -254,12 +269,10 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
         # The breakdown is strictly optional decoration on an already-won
         # measurement: if one of its 4 extra stage compiles wedges the
         # remote tunnel (unkillable from Python), a side timer prints the
-        # primary metric and exits instead of letting the main watchdog
-        # report value=0; a plain exception just annotates the JSON. The
-        # main watchdog (whose firing would discard the metric) stands
-        # down first — from here on the guard is the only failure path.
-        if watchdog is not None:
-            watchdog.cancel()
+        # primary metric and exits instead of hanging forever; a plain
+        # exception just annotates the JSON. The main watchdog already
+        # stood down before _step_flops — the guard is the only failure
+        # path from here on.
         budget = float(os.environ.get("BENCH_BREAKDOWN_S", "600"))
         guard = threading.Timer(
             budget,
@@ -291,19 +304,206 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
     print(json.dumps(out))
 
 
-def _step_flops(step, state, device_batch):
-    """One train step's FLOPs per XLA's HloCostAnalysis of the lowered
-    (pre-compile) module. Host-side only — never touches the device (the
-    remote-TPU tunnel in this image must not be asked to compile twice).
-    Returns None when the analysis is unavailable on the backend."""
+def _measure_eval(config, profile_dir=None, watchdog=None) -> None:
+    """``BENCH_MODE=eval``: jitted inference throughput — forward + fixed-
+    shape decode + per-class NMS (`eval/detect.py`), data-parallel over all
+    devices — on synthetic 600x600 tensors, images/sec.
+
+    ``vs_baseline`` is null by design: the reference has NO inference/eval
+    path to race against (`test_eval.py` is 0 bytes — SURVEY.md §2.1 #15);
+    this metric exists because the eval path is new capability whose cost
+    still needs a number of record."""
+    import dataclasses
+
+    from replication_faster_rcnn_tpu.config import (
+        DataConfig,
+        MeshConfig,
+        get_config,
+    )
+    from replication_faster_rcnn_tpu.data import SyntheticDataset
+    from replication_faster_rcnn_tpu.data.loader import collate
+    from replication_faster_rcnn_tpu.eval import Evaluator
+    from replication_faster_rcnn_tpu.train import (
+        create_train_state,
+        make_optimizer,
+    )
+    from replication_faster_rcnn_tpu.utils.profiling import trace
+
+    n_dev = len(jax.devices())
+    if config is None:
+        cfg = get_config("voc_resnet18").replace(
+            data=DataConfig(
+                dataset="synthetic", image_size=(600, 600), max_boxes=32
+            ),
+            mesh=MeshConfig(num_data=n_dev),
+        )
+    else:
+        cfg = config.replace(
+            data=dataclasses.replace(config.data, dataset="synthetic")
+        )
+        if cfg.mesh.num_model > 1 or cfg.mesh.spatial:
+            # the eval path is data-parallel only (Evaluator._eval_sharding
+            # forces num_model=1): refuse rather than print a number
+            # labeled as if the requested model-parallel layout ran
+            raise ValueError(
+                "BENCH_MODE=eval measures the data-parallel eval path only; "
+                "drop --num-model/--spatial (got num_model="
+                f"{cfg.mesh.num_model}, spatial={cfg.mesh.spatial})"
+            )
+        from replication_faster_rcnn_tpu.parallel import validate_parallel
+
+        validate_parallel(cfg, n_dev)
+    global _METRIC
+    _METRIC = "eval_images_per_sec_{}x{}".format(*cfg.data.image_size)
+    # batch precedence: BENCH_EVAL_BATCH env > the CLI/caller config's
+    # train.batch_size > 8 per device; the JSON reports the effective value
+    if "BENCH_EVAL_BATCH" in os.environ:
+        batch_size = int(os.environ["BENCH_EVAL_BATCH"])
+    elif config is not None:
+        batch_size = cfg.train.batch_size
+    else:
+        batch_size = 8 * n_dev
+    tx, _ = make_optimizer(cfg, steps_per_epoch=100)
+    _, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    ev = Evaluator(cfg)
+    img_sharding, rep_sharding = ev._eval_sharding(batch_size)
+    if rep_sharding is not None:
+        variables = jax.device_put(variables, rep_sharding)
+    ds = SyntheticDataset(cfg.data, length=batch_size)
+    images = collate([ds[i] for i in range(batch_size)])["image"]
+    # same sync discipline as the train measurement: upload once, queue all
+    # jitted calls, one device_get of the final outputs at the end (the
+    # per-call device_put/get inside Evaluator.predict_batch would add a
+    # host round-trip per step — ruinous over the remote-TPU tunnel)
+    images_dev = jax.device_put(np.asarray(images), img_sharding)
+    for _ in range(3):
+        out = ev._jit_infer(variables, images_dev)
+    jax.device_get(out)
+    n_steps = 10
+    t0 = time.time()
+    with trace(profile_dir):
+        for _ in range(n_steps):
+            out = ev._jit_infer(variables, images_dev)
+        jax.device_get(out)
+    dt = time.time() - t0
+    if watchdog is not None:
+        watchdog.cancel()  # measurement won; only printing remains
+    print(
+        json.dumps(
+            {
+                "metric": _METRIC,
+                "value": round(n_steps * batch_size / dt, 3),
+                "unit": "images/sec",
+                "vs_baseline": None,
+                "batch_size": batch_size,
+                "note": "reference has no eval/inference path (empty "
+                "test_eval.py); no baseline ratio exists",
+            }
+        )
+    )
+
+
+def _step_flops(cfg, batch_size):
+    """Global FLOPs of one train step (full ``batch_size``), from XLA's
+    HloCostAnalysis of the step lowered for ONE CPU device in a
+    scrubbed-env subprocess.
+
+    Why a subprocess: the axon remote-TPU plugin routes ``cost_analysis``
+    through the device tunnel and has been observed to block indefinitely
+    (round-2 measurement), so the analysis must never run against the
+    plugin backend. FLOP counts are backend-independent; the child only
+    traces abstract values — it allocates no batch arrays and never
+    compiles. The count is *model* FLOPs (1-device graph, no halo/collective
+    duplication), the conventional MFU numerator. Returns None on any
+    failure or after BENCH_FLOPS_TIMEOUT_S (default 420s)."""
+    import dataclasses
+    import subprocess
+    import sys
+
     try:
-        ca = step.lower(state, device_batch).cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else None
-        flops = float(ca.get("flops", 0.0)) if ca else 0.0
-        return flops if flops > 0 else None
+        child_cfg = cfg.replace(
+            mesh=dataclasses.replace(
+                cfg.mesh, num_data=1, num_model=1, spatial=False
+            ),
+            train=dataclasses.replace(
+                cfg.train, backend="auto", batch_size=batch_size
+            ),
+        )
+        if jax.default_backend() == "cpu":
+            # plain CPU backend (tests, CI): in-process analysis is safe
+            # and skips a whole extra Python+JAX cold start
+            flops = _flops_of_config(child_cfg)
+            return flops if flops and flops > 0 else None
+        payload = json.dumps(dataclasses.asdict(child_cfg))
+        env = dict(os.environ)
+        env.update(
+            PALLAS_AXON_POOL_IPS="",
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        )
+        r = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "from replication_faster_rcnn_tpu.benchmark import "
+                "_flops_child; _flops_child()",
+            ],
+            input=payload,
+            text=True,
+            capture_output=True,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            timeout=float(os.environ.get("BENCH_FLOPS_TIMEOUT_S", "420")),
+        )
+        flops = json.loads(r.stdout.strip().splitlines()[-1])["flops"]
+        return flops if flops and flops > 0 else None
     except Exception:
         return None
+
+
+def _flops_of_config(cfg) -> float:
+    """HloCostAnalysis FLOPs of one train step of ``cfg`` (abstract
+    lowering — no batch arrays, no compile). Only safe on a non-plugin
+    backend; callers guard (see :func:`_step_flops`)."""
+    import jax.numpy as jnp
+
+    from replication_faster_rcnn_tpu.data import SyntheticDataset
+    from replication_faster_rcnn_tpu.data.loader import collate
+    from replication_faster_rcnn_tpu.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    tx, _ = make_optimizer(cfg, steps_per_epoch=100)
+    model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
+    state_abs = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), state
+    )
+    sample = collate([SyntheticDataset(cfg.data, length=1)[0]])
+    b = cfg.train.batch_size
+    batch_abs = {
+        k: jax.ShapeDtypeStruct((b,) + v.shape[1:], v.dtype)
+        for k, v in sample.items()
+    }
+    step = jax.jit(make_train_step(model, cfg, tx))
+    ca = step.lower(state_abs, batch_abs).cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return float(ca.get("flops", 0.0)) if ca else 0.0
+
+
+def _flops_child():
+    """Subprocess body for :func:`_step_flops`: stdin carries the config as
+    ``dataclasses.asdict`` JSON; stdout's last line is ``{"flops": N}``.
+    Must run with JAX_PLATFORMS=cpu (the parent scrubs the env)."""
+    import sys
+
+    from replication_faster_rcnn_tpu.config import config_from_dict
+
+    cfg = config_from_dict(json.load(sys.stdin))
+    print(json.dumps({"flops": _flops_of_config(cfg)}))
 
 
 def _peak_flops_per_sec(n_dev: int):

@@ -353,3 +353,28 @@ def get_config(name: str = "voc_resnet18", **overrides) -> FasterRCNNConfig:
         raise KeyError(f"unknown config {name!r}; choices: {sorted(CONFIGS)}")
     cfg = CONFIGS[name]
     return cfg.replace(**overrides) if overrides else cfg
+
+
+def config_from_dict(d: dict) -> FasterRCNNConfig:
+    """Rebuild a :class:`FasterRCNNConfig` from ``dataclasses.asdict``
+    output, e.g. after a JSON round-trip (lists re-become tuples). Used to
+    ship a config to a subprocess (benchmark FLOPs analysis)."""
+    import typing
+
+    def deep_tuple(v):
+        return tuple(deep_tuple(x) for x in v) if isinstance(v, list) else v
+
+    def build(cls, dd):
+        hints = typing.get_type_hints(cls)
+        kw = {}
+        for f in dataclasses.fields(cls):
+            v = dd[f.name]
+            t = hints.get(f.name)
+            if dataclasses.is_dataclass(t) and isinstance(v, dict):
+                v = build(t, v)
+            else:
+                v = deep_tuple(v)
+            kw[f.name] = v
+        return cls(**kw)
+
+    return build(FasterRCNNConfig, d)

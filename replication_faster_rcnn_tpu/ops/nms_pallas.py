@@ -157,8 +157,9 @@ def nms_fixed_auto(
     plain XLA ops, so it carries none of the remote-compile risk that keeps
     Pallas opt-in. The loop's ~600 serial dispatches were measured at ~35%
     of the whole train step on v5e in round 1, which is why the loop is no
-    longer any backend's default; in-step TPU timing of the tiled default
-    is pending hardware access (the tunnel died before it could run).
+    longer any backend's default; validated in-step on v5e (round 2): the
+    b8 600x600 train step went 124 -> 180 images/sec with this default,
+    proposal NMS now 4.2 ms of a 44.4 ms step.
 
     Overrides via FRCNN_NMS (explicit choice always wins; the legacy
     FRCNN_PALLAS_NMS=1 is honored only when FRCNN_NMS is unset):

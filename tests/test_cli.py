@@ -78,7 +78,7 @@ class TestBenchSuccess:
         rc = cli.main(["bench", "--image-size", "64", "--batch-size", "8"])
         assert rc == 0
         line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-        assert line["metric"] == "train_images_per_sec_600x600"
+        assert line["metric"] == "train_images_per_sec_64x64"
         assert line["value"] > 0
         assert "error" not in line
         # VERDICT r1 weak #4: the bench must report the step's FLOPs and a
@@ -91,6 +91,22 @@ class TestBenchSuccess:
             "trunk_ms", "rpn_heads_ms", "proposal_nms_ms",
             "targets_head_loss_ms", "backward_update_ms", "step_ms",
         }
+
+    def test_bench_eval_mode(self, capsys, monkeypatch):
+        """BENCH_MODE=eval measures the inference path (forward + decode +
+        per-class NMS) and reports no baseline ratio (the reference has no
+        eval path to race — SURVEY.md §2.1 #15)."""
+        import json
+
+        monkeypatch.setenv("BENCH_MODE", "eval")
+        monkeypatch.setenv("BENCH_EVAL_BATCH", "2")
+        rc = cli.main(["bench", "--image-size", "64", "--batch-size", "2"])
+        assert rc == 0
+        line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert line["metric"] == "eval_images_per_sec_64x64"
+        assert line["value"] > 0
+        assert line["vs_baseline"] is None
+        assert "error" not in line
 
 
 class TestBenchMeshValidation:

@@ -46,3 +46,16 @@ def test_preset_one_train_step(name):
     new_state, metrics = step(state, batch)
     assert np.isfinite(float(jax.device_get(metrics["loss"]))), name
     assert int(new_state.step) == 1
+
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+def test_config_json_round_trip(name):
+    """config_from_dict rebuilds every preset exactly after a JSON round
+    trip (the path bench.py uses to ship a config to its FLOPs subprocess)."""
+    import json
+
+    from replication_faster_rcnn_tpu.config import config_from_dict
+
+    cfg = get_config(name)
+    rebuilt = config_from_dict(json.loads(json.dumps(dataclasses.asdict(cfg))))
+    assert rebuilt == cfg
