@@ -111,14 +111,17 @@ def _probe_device() -> None:
 
 def main(config=None, profile_dir=None) -> None:
     """Measure the jitted train step of ``config`` (default: the flagship
-    voc_resnet18 at 600x600, batch 8/device) on all available devices.
+    voc_resnet18 at 600x600, batch 16/device) on all available devices.
     ``profile_dir`` wraps the timed loop in a jax.profiler trace."""
     eval_mode = os.environ.get("BENCH_MODE", "train") == "eval"
-    # label failure paths with the right mode even before the config
-    # resolves (a probe-stage wedge must not mislabel the run) — set for
-    # BOTH modes so a prior in-process run's label can never go stale
+    # label failure paths with the right mode AND shape even before the
+    # measurement starts (a probe-stage wedge must not mislabel the run) —
+    # set for BOTH modes so a prior in-process run's label can never go
+    # stale, and read the caller's image size so a non-600 run that wedges
+    # is never recorded against the flagship shape
     global _METRIC
-    _METRIC = ("eval" if eval_mode else "train") + "_images_per_sec_600x600"
+    shape = "600x600" if config is None else "{}x{}".format(*config.data.image_size)
+    _METRIC = ("eval" if eval_mode else "train") + f"_images_per_sec_{shape}"
     watchdog = _arm_watchdog()
     try:
         _probe_device()
@@ -132,15 +135,23 @@ def main(config=None, profile_dir=None) -> None:
         watchdog.cancel()
 
 
+def _flagship_cfg(n_dev):
+    """The bench default config: voc_resnet18 at 600x600 on synthetic
+    tensors, data-parallel over every device. One definition shared by the
+    train and eval measurements so the flagship shape cannot drift between
+    the two metrics."""
+    from replication_faster_rcnn_tpu.config import DataConfig, MeshConfig, get_config
+
+    return get_config("voc_resnet18").replace(
+        data=DataConfig(dataset="synthetic", image_size=(600, 600), max_boxes=32),
+        mesh=MeshConfig(num_data=n_dev),
+    )
+
+
 def _measure(config, profile_dir=None, watchdog=None) -> None:
     import dataclasses
 
-    from replication_faster_rcnn_tpu.config import (
-        DataConfig,
-        MeshConfig,
-        TrainConfig,
-        get_config,
-    )
+    from replication_faster_rcnn_tpu.config import TrainConfig
     from replication_faster_rcnn_tpu.data import SyntheticDataset
     from replication_faster_rcnn_tpu.data.loader import collate
     from replication_faster_rcnn_tpu.parallel import (
@@ -156,11 +167,15 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
 
     n_dev = len(jax.devices())
     if config is None:
-        batch_size = 8 * n_dev
-        cfg = get_config("voc_resnet18").replace(
-            data=DataConfig(dataset="synthetic", image_size=(600, 600), max_boxes=32),
-            train=TrainConfig(batch_size=batch_size),
-            mesh=MeshConfig(num_data=n_dev),
+        # 16/device is the measured best operating point on v5e with the
+        # tiled-NMS default (210 img/s vs 186 at 8/device; with the old
+        # loop NMS b16 was *slower* — 96 vs 124 — so this default is tied
+        # to the tiled backend). BENCH_BATCH overrides per device. Do NOT
+        # raise past 16: the batch-32 600x600 compile wedges this image's
+        # remote-TPU service (verify SKILL.md gotchas).
+        batch_size = int(os.environ.get("BENCH_BATCH", "16")) * n_dev
+        cfg = _flagship_cfg(n_dev).replace(
+            train=TrainConfig(batch_size=batch_size)
         )
     else:
         # honor the caller's model/image/batch/mesh choices (incl. a model
@@ -315,11 +330,6 @@ def _measure_eval(config, profile_dir=None, watchdog=None) -> None:
     still needs a number of record."""
     import dataclasses
 
-    from replication_faster_rcnn_tpu.config import (
-        DataConfig,
-        MeshConfig,
-        get_config,
-    )
     from replication_faster_rcnn_tpu.data import SyntheticDataset
     from replication_faster_rcnn_tpu.data.loader import collate
     from replication_faster_rcnn_tpu.eval import Evaluator
@@ -331,12 +341,7 @@ def _measure_eval(config, profile_dir=None, watchdog=None) -> None:
 
     n_dev = len(jax.devices())
     if config is None:
-        cfg = get_config("voc_resnet18").replace(
-            data=DataConfig(
-                dataset="synthetic", image_size=(600, 600), max_boxes=32
-            ),
-            mesh=MeshConfig(num_data=n_dev),
-        )
+        cfg = _flagship_cfg(n_dev)
     else:
         cfg = config.replace(
             data=dataclasses.replace(config.data, dataset="synthetic")
@@ -466,10 +471,9 @@ def _flops_of_config(cfg) -> float:
     """HloCostAnalysis FLOPs of one train step of ``cfg`` (abstract
     lowering — no batch arrays, no compile). Only safe on a non-plugin
     backend; callers guard (see :func:`_step_flops`)."""
-    import jax.numpy as jnp
-
     from replication_faster_rcnn_tpu.data import SyntheticDataset
     from replication_faster_rcnn_tpu.data.loader import collate
+    from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
     from replication_faster_rcnn_tpu.train import (
         create_train_state,
         make_optimizer,
@@ -477,9 +481,11 @@ def _flops_of_config(cfg) -> float:
     )
 
     tx, _ = make_optimizer(cfg, steps_per_epoch=100)
-    model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
-    state_abs = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), state
+    model = FasterRCNN(cfg)
+    # abstract init: shapes/dtypes of the train state without ever running
+    # the (compiled) param-init programs — keeps this a pure trace
+    state_abs = jax.eval_shape(
+        lambda rng: create_train_state(cfg, rng, tx)[1], jax.random.PRNGKey(0)
     )
     sample = collate([SyntheticDataset(cfg.data, length=1)[0]])
     b = cfg.train.batch_size

@@ -158,8 +158,9 @@ def nms_fixed_auto(
     Pallas opt-in. The loop's ~600 serial dispatches were measured at ~35%
     of the whole train step on v5e in round 1, which is why the loop is no
     longer any backend's default; validated in-step on v5e (round 2): the
-    b8 600x600 train step went 124 -> 180 images/sec with this default,
-    proposal NMS now 4.2 ms of a 44.4 ms step.
+    b8 600x600 train step went 124 -> 180-186 images/sec across runs with
+    this default (proposal NMS 3.7 ms of a 42.9 ms step), and b16 went
+    96 -> 210 (benchmarks/bench_v5e_round2.json).
 
     Overrides via FRCNN_NMS (explicit choice always wins; the legacy
     FRCNN_PALLAS_NMS=1 is honored only when FRCNN_NMS is unset):

@@ -99,7 +99,8 @@ class TestBenchSuccess:
         import json
 
         monkeypatch.setenv("BENCH_MODE", "eval")
-        monkeypatch.setenv("BENCH_EVAL_BATCH", "2")
+        # no BENCH_EVAL_BATCH: exercise the second precedence tier (the
+        # CLI config's train.batch_size feeds the eval batch)
         rc = cli.main(["bench", "--image-size", "64", "--batch-size", "2"])
         assert rc == 0
         line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
