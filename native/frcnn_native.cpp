@@ -13,6 +13,14 @@
 #include <algorithm>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
+#include <vector>
+
+#ifndef FRCNN_NO_JPEG
+#include <csetjmp>
+
+#include <jpeglib.h>
+#endif
 
 extern "C" {
 
@@ -25,34 +33,148 @@ void resize_bilinear_normalize(const uint8_t* src, int sh, int sw,
                                const float* mean, const float* stddev) {
   const float rscale = static_cast<float>(sh) / dh;
   const float cscale = static_cast<float>(sw) / dw;
-  const float inv_std[3] = {1.0f / stddev[0], 1.0f / stddev[1], 1.0f / stddev[2]};
+  // fold /255 into the per-channel affine so the inner loop is one fma
+  float scale[3], shift[3];
+  for (int ch = 0; ch < 3; ++ch) {
+    scale[ch] = 1.0f / (255.0f * stddev[ch]);
+    shift[ch] = -mean[ch] / stddev[ch];
+  }
+  // column sample positions don't depend on the row: precompute byte
+  // offsets and blend weights once instead of per output pixel
+  std::vector<int32_t> off0(dw), off1(dw);
+  std::vector<float> fcs(dw);
+  for (int c = 0; c < dw; ++c) {
+    float sc = (c + 0.5f) * cscale - 0.5f;
+    sc = std::min(std::max(sc, 0.0f), static_cast<float>(sw - 1));
+    const int c0 = static_cast<int>(sc);
+    const int c1 = std::min(c0 + 1, sw - 1);
+    off0[c] = c0 * 3;
+    off1[c] = c1 * 3;
+    fcs[c] = sc - c0;
+  }
   for (int r = 0; r < dh; ++r) {
     float sr = (r + 0.5f) * rscale - 0.5f;
     sr = std::min(std::max(sr, 0.0f), static_cast<float>(sh - 1));
     const int r0 = static_cast<int>(sr);
     const int r1 = std::min(r0 + 1, sh - 1);
     const float fr = sr - r0;
+    const uint8_t* row0 = src + static_cast<int64_t>(r0) * sw * 3;
+    const uint8_t* row1 = src + static_cast<int64_t>(r1) * sw * 3;
+    float* out = dst + static_cast<int64_t>(r) * dw * 3;
     for (int c = 0; c < dw; ++c) {
-      float sc = (c + 0.5f) * cscale - 0.5f;
-      sc = std::min(std::max(sc, 0.0f), static_cast<float>(sw - 1));
-      const int c0 = static_cast<int>(sc);
-      const int c1 = std::min(c0 + 1, sw - 1);
-      const float fc = sc - c0;
+      const float fc = fcs[c];
       const float w00 = (1 - fr) * (1 - fc), w01 = (1 - fr) * fc;
       const float w10 = fr * (1 - fc), w11 = fr * fc;
-      const uint8_t* p00 = src + (static_cast<int64_t>(r0) * sw + c0) * 3;
-      const uint8_t* p01 = src + (static_cast<int64_t>(r0) * sw + c1) * 3;
-      const uint8_t* p10 = src + (static_cast<int64_t>(r1) * sw + c0) * 3;
-      const uint8_t* p11 = src + (static_cast<int64_t>(r1) * sw + c1) * 3;
-      float* out = dst + (static_cast<int64_t>(r) * dw + c) * 3;
+      const uint8_t* p00 = row0 + off0[c];
+      const uint8_t* p01 = row0 + off1[c];
+      const uint8_t* p10 = row1 + off0[c];
+      const uint8_t* p11 = row1 + off1[c];
       for (int ch = 0; ch < 3; ++ch) {
         const float v =
             p00[ch] * w00 + p01[ch] * w01 + p10[ch] * w10 + p11[ch] * w11;
-        out[ch] = (v * (1.0f / 255.0f) - mean[ch]) * inv_std[ch];
+        out[ch] = v * scale[ch] + shift[ch];
       }
+      out += 3;
     }
   }
 }
+
+}  // extern "C"
+
+#ifndef FRCNN_NO_JPEG
+
+namespace {
+
+// libjpeg's default error handler exit()s the process; a longjmp handler
+// turns decode failures into an error return so Python can fall back to PIL.
+struct JpegErr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jb, 1);
+}
+
+void jpeg_err_silent(j_common_ptr, int) {}
+void jpeg_err_nomsg(j_common_ptr) {}
+
+}  // namespace
+
+extern "C" {
+
+// Decode a JPEG from memory straight into the fused resize+normalize
+// kernel above: one native call replaces PIL.open + np.asarray + resize +
+// normalize in the loader hot loop, and reports the pre-resize source
+// dimensions (*orig_h, *orig_w — the loader scales gt boxes by them).
+// Grayscale/CMYK sources are converted to RGB by libjpeg. With
+// fast_scale != 0, the decoder's DCT-domain scaling (1/2, 1/4, 1/8) is
+// used to decode at the smallest intermediate size that still covers
+// (dh, dw), cutting IDCT + bilinear cost for downscales; the quality
+// difference vs full-size decode is below the bilinear kernel's own
+// resampling error for the >= 2x reductions it triggers on. Returns 0 on
+// success, -1 on any decode error.
+int decode_jpeg_resize_normalize(const uint8_t* data, int64_t len,
+                                 float* dst, int dh, int dw,
+                                 const float* mean, const float* stddev,
+                                 int fast_scale, int32_t* orig_h,
+                                 int32_t* orig_w) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jpeg_err_exit;
+  jerr.pub.emit_message = jpeg_err_silent;
+  jerr.pub.output_message = jpeg_err_nomsg;
+  std::vector<uint8_t> pixels;  // declared before setjmp: longjmp-safe
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  *orig_h = static_cast<int32_t>(cinfo.image_height);
+  *orig_w = static_cast<int32_t>(cinfo.image_width);
+  cinfo.out_color_space = JCS_RGB;
+  if (fast_scale && dh > 0 && dw > 0) {
+    // largest denominator whose scaled size still covers the target
+    for (int denom = 8; denom >= 2; denom /= 2) {
+      if (static_cast<int>(cinfo.image_height) >= dh * denom &&
+          static_cast<int>(cinfo.image_width) >= dw * denom) {
+        cinfo.scale_num = 1;
+        cinfo.scale_denom = denom;
+        break;
+      }
+    }
+  }
+  jpeg_start_decompress(&cinfo);
+  const int sh = static_cast<int>(cinfo.output_height);
+  const int sw = static_cast<int>(cinfo.output_width);
+  if (cinfo.output_components != 3 || sh <= 0 || sw <= 0) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  pixels.resize(static_cast<size_t>(sh) * sw * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row =
+        pixels.data() + static_cast<size_t>(cinfo.output_scanline) * sw * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  resize_bilinear_normalize(pixels.data(), sh, sw, dst, dh, dw, mean, stddev);
+  return 0;
+}
+
+}  // extern "C"
+
+#endif  // FRCNN_NO_JPEG
+
+extern "C" {
 
 // Greedy score-sorted NMS (torchvision semantics: suppress IoU strictly
 // greater than thresh). boxes are [n, 4] row-major [r1, c1, r2, c2].

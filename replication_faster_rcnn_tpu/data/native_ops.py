@@ -29,6 +29,47 @@ _lib_checked = False
 _lib_lock = threading.Lock()  # loader threads race here on first batch
 
 
+def _try_build(rebuild: bool = False) -> bool:
+    """Best-effort make, degrading through host capabilities: full build,
+    then without -march=native (older gcc), then without libjpeg (missing
+    jpeglib.h — the JPEG entry points are simply absent), then both."""
+    flag_sets = [[], ["MARCH="], ["JPEG=0"], ["MARCH=", "JPEG=0"]]
+    base = ["make", "-C", os.path.join(_REPO, "native")]
+    if rebuild:
+        base.insert(1, "-B")
+    for flags in flag_sets:
+        try:
+            subprocess.run(
+                base + flags, check=True, capture_output=True, timeout=120
+            )
+            return True
+        except Exception:
+            continue
+    return False
+
+
+def _rebuild_and_reload() -> Optional[ctypes.CDLL]:
+    """Rebuild the .so and dlopen it under a fresh unique pathname (glibc
+    caches dlopen by path, so reloading _SO_PATH would return the old
+    handle). Returns None if the rebuild or reload fails, or if the
+    rebuilt library still lacks the JPEG entry points (JPEG=0 fallback
+    build) — callers then keep whatever library they already have."""
+    import shutil
+    import tempfile
+
+    if not _try_build(rebuild=True):
+        return None
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", prefix="frcnn_native_")
+        os.close(fd)
+        shutil.copy2(_SO_PATH, tmp)
+        lib = ctypes.CDLL(tmp)
+        os.unlink(tmp)  # the mapping survives the unlink
+    except Exception:
+        return None
+    return lib if hasattr(lib, "decode_jpeg_resize_normalize") else None
+
+
 def _load_lib() -> Optional[ctypes.CDLL]:
     global _lib, _lib_checked
     if _lib_checked:
@@ -43,19 +84,19 @@ def _load_lib_locked() -> Optional[ctypes.CDLL]:
         return _lib
     _lib_checked = True
     if not os.path.exists(_SO_PATH):
-        try:  # best-effort build; numpy fallback covers failure
-            subprocess.run(
-                ["make", "-C", os.path.join(_REPO, "native")],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
-        except Exception:
-            return None
+        if not _try_build():
+            return None  # numpy fallbacks cover everything
     try:
         lib = ctypes.CDLL(_SO_PATH)
     except OSError:
         return None
+    if not hasattr(lib, "decode_jpeg_resize_normalize"):
+        # stale .so from before the JPEG kernels. Rebuild, then load the
+        # fresh file through a unique temp copy: re-dlopening the same
+        # pathname would return the cached stale handle (ctypes never
+        # dlcloses). On any failure keep the stale-but-working library —
+        # resize/NMS/scale_boxes don't need libjpeg.
+        lib = _rebuild_and_reload() or lib
     f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
     u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
     i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
@@ -72,6 +113,12 @@ def _load_lib_locked() -> Optional[ctypes.CDLL]:
         f32p, i32p, ctypes.c_int, ctypes.c_float, ctypes.c_float,
     ]
     lib.scale_boxes.restype = None
+    if hasattr(lib, "decode_jpeg_resize_normalize"):  # absent in JPEG=0 builds
+        lib.decode_jpeg_resize_normalize.argtypes = [
+            u8p, ctypes.c_int64, f32p, ctypes.c_int, ctypes.c_int,
+            f32p, f32p, ctypes.c_int, i32p, i32p,
+        ]
+        lib.decode_jpeg_resize_normalize.restype = ctypes.c_int
     _lib = lib
     return _lib
 
@@ -140,6 +187,46 @@ def scale_boxes(
         return np.where(real[:, None], np.round(boxes * scale), boxes)
     lib.scale_boxes(boxes, labels, len(boxes), row_scale, col_scale)
     return boxes
+
+
+def decode_jpeg_resize_normalize(
+    data: bytes,
+    out_hw: Tuple[int, int],
+    mean,
+    std,
+    fast_scale: bool = True,
+) -> Optional[Tuple[np.ndarray, int, int]]:
+    """JPEG bytes -> (normalized float32 [out_h, out_w, 3], orig_h, orig_w).
+
+    The whole loader hot path — decode, RGB conversion, bilinear resize,
+    /255 + mean/std — in one native call. ``fast_scale`` enables libjpeg's
+    DCT-domain 1/2..1/8 prescaling when the source is at least 2x the
+    target in both dims (large decode savings, sub-bilinear-error quality
+    difference). Returns None when the native library is unavailable or
+    the bytes don't decode (caller falls back to PIL — which also covers
+    non-JPEG files like the occasional PNG-in-.jpg).
+    """
+    lib = _load_lib()
+    if lib is None or not hasattr(lib, "decode_jpeg_resize_normalize"):
+        return None
+    buf = np.frombuffer(data, np.uint8)
+    dims = np.empty((2,), np.int32)
+    dst = np.empty((out_hw[0], out_hw[1], 3), np.float32)
+    rc = lib.decode_jpeg_resize_normalize(
+        buf,
+        buf.size,
+        dst,
+        out_hw[0],
+        out_hw[1],
+        np.asarray(mean, np.float32),
+        np.asarray(std, np.float32),
+        1 if fast_scale else 0,
+        dims[0:1],
+        dims[1:2],
+    )
+    if rc != 0:
+        return None
+    return dst, int(dims[0]), int(dims[1])
 
 
 def _nms_numpy(

@@ -37,13 +37,25 @@ from replication_faster_rcnn_tpu.data import native_ops
 def _load_image(path: str, image_size, pixel_mean, pixel_std):
     """JPEG -> normalized float32 [H, W, 3] + original size.
 
-    Decode via PIL; resize+normalize via the native C++ kernel
-    (data/native_ops.py, numpy fallback) — the fused host-side fast path
-    standing in for the reference's skimage resize + torch Normalize
-    (`utils/data_loader.py:38,72`)."""
+    Fast path: one native C++ call does decode + RGB conversion + bilinear
+    resize + normalize (native/frcnn_native.cpp, libjpeg with DCT-domain
+    prescaling) — the fused host-side pipeline standing in for the
+    reference's skimage resize + torch Normalize
+    (`utils/data_loader.py:38,72`). Fallback (no native lib, or the file
+    isn't a decodable JPEG): PIL decode + the resize_normalize kernel.
+    """
+    with open(path, "rb") as f:
+        data = f.read()
+    native = native_ops.decode_jpeg_resize_normalize(
+        data, image_size, pixel_mean, pixel_std
+    )
+    if native is not None:
+        return native
+    import io
+
     from PIL import Image
 
-    with Image.open(path) as im:
+    with Image.open(io.BytesIO(data)) as im:
         im = im.convert("RGB")
         orig_w, orig_h = im.size
         arr = np.asarray(im, np.uint8)
