@@ -62,8 +62,80 @@ def _wedge_exit(reason: str):
     os._exit(2)
 
 
-def _arm_watchdog() -> threading.Timer:
-    """Print a diagnostic JSON line and exit if the measurement wedges.
+def _cpu_fallback(reason: str, config=None) -> None:
+    """Measure on a scrubbed-env CPU subprocess instead of recording 0.0.
+
+    When the remote-TPU tunnel is wedged (round-1 failure mode: the
+    official number of record became 0.0 despite a working framework),
+    a JAX-CPU measurement against the torch-CPU baseline is still an
+    honest single-core apples-to-apples number. The child gets a fresh
+    interpreter with the axon plugin suppressed, a small batch (CPU
+    steps are seconds, not milliseconds) and few steps; the printed line
+    carries ``fallback_backend``/``fallback_reason`` so nobody mistakes
+    it for a TPU number. Never returns.
+    """
+    import dataclasses
+    import subprocess
+    import sys
+
+    try:
+        env = dict(os.environ)
+        env.update(
+            PALLAS_AXON_POOL_IPS="",
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=1",
+            BENCH_NO_FALLBACK="1",
+            BENCH_BATCH=os.environ.get("BENCH_FALLBACK_BATCH", "2"),
+            BENCH_STEPS="3",
+            BENCH_BREAKDOWN="0",
+            BENCH_WATCHDOG_S="1100",
+        )
+        env.pop("JAX_PLATFORM_NAME", None)
+        payload = ""
+        if config is not None:
+            env["BENCH_CONFIG_STDIN"] = "1"
+            cpu_cfg = config.replace(
+                train=dataclasses.replace(
+                    config.train,
+                    batch_size=min(config.train.batch_size, 2),
+                )
+            )
+            payload = json.dumps(dataclasses.asdict(cpu_cfg))
+        r = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "from replication_faster_rcnn_tpu.benchmark import main; main()",
+            ],
+            input=payload,
+            text=True,
+            capture_output=True,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            timeout=1300,
+        )
+        obj = json.loads(r.stdout.strip().splitlines()[-1])
+        if not obj.get("value"):
+            raise RuntimeError(f"fallback produced no throughput: {obj}")
+        obj["fallback_backend"] = "cpu"
+        obj["fallback_reason"] = reason
+        print(json.dumps(obj), flush=True)
+        os._exit(0)
+    except Exception as e:  # noqa: BLE001 — any failure -> the 0.0 record
+        _wedge_exit(f"{reason}; cpu fallback failed: {e!r}")
+
+
+def _maybe_fallback(reason: str, config=None) -> None:
+    """Wedge handler: CPU-subprocess fallback unless this process IS the
+    fallback child (BENCH_NO_FALLBACK=1 — then report the 0.0)."""
+    if os.environ.get("BENCH_NO_FALLBACK") == "1":
+        _wedge_exit(reason)
+    _cpu_fallback(reason, config)
+
+
+def _arm_watchdog(config=None) -> threading.Timer:
+    """CPU-fallback (else print a diagnostic JSON line) and exit if the
+    measurement wedges.
 
     The remote-TPU tunnel in this image can hang indefinitely inside a
     compile (no Python-level interrupt possible); without this the driver
@@ -73,8 +145,9 @@ def _arm_watchdog() -> threading.Timer:
     budget = float(os.environ.get("BENCH_WATCHDOG_S", "1500"))
 
     def fire():
-        _wedge_exit(
-            f"watchdog: device wedged >{budget:.0f}s (remote compile tunnel hang)"
+        _maybe_fallback(
+            f"watchdog: device wedged >{budget:.0f}s (remote compile tunnel hang)",
+            config,
         )
 
     t = threading.Timer(budget, fire)
@@ -83,22 +156,24 @@ def _arm_watchdog() -> threading.Timer:
     return t
 
 
-def _probe_device() -> None:
+def _probe_device(config=None) -> None:
     """Fail fast if the device tunnel is already wedged.
 
     A wedged remote-TPU service blocks even a trivial op forever, and a
     blocked device call cannot be interrupted from Python — so a short
-    side watchdog reports the wedge in minutes instead of burning the
-    full measurement budget before saying anything.
+    side watchdog reports the wedge (or launches the CPU fallback) in
+    minutes instead of burning the full measurement budget before saying
+    anything.
     """
     import jax.numpy as jnp
 
     budget = float(os.environ.get("BENCH_PROBE_S", "180"))
     t = threading.Timer(
         budget,
-        lambda: _wedge_exit(
+        lambda: _maybe_fallback(
             f"probe: device unresponsive >{budget:.0f}s before compile "
-            "(tunnel wedged at start)"
+            "(tunnel wedged at start)",
+            config,
         ),
     )
     t.daemon = True
@@ -114,6 +189,17 @@ def main(config=None, profile_dir=None) -> None:
     voc_resnet18 at 600x600, batch 16/device) on all available devices.
     ``profile_dir`` wraps the timed loop in a jax.profiler trace."""
     eval_mode = os.environ.get("BENCH_MODE", "train") == "eval"
+    if config is None and os.environ.get("BENCH_CONFIG_STDIN") == "1":
+        # the CPU-fallback child receives the parent's resolved config on
+        # stdin so a wedged non-default run is re-measured, not replaced
+        # by the flagship default
+        import sys
+
+        from replication_faster_rcnn_tpu.config import config_from_dict
+
+        payload = sys.stdin.read().strip()
+        if payload:
+            config = config_from_dict(json.loads(payload))
     # label failure paths with the right mode AND shape even before the
     # measurement starts (a probe-stage wedge must not mislabel the run) —
     # set for BOTH modes so a prior in-process run's label can never go
@@ -122,9 +208,9 @@ def main(config=None, profile_dir=None) -> None:
     global _METRIC
     shape = "600x600" if config is None else "{}x{}".format(*config.data.image_size)
     _METRIC = ("eval" if eval_mode else "train") + f"_images_per_sec_{shape}"
-    watchdog = _arm_watchdog()
+    watchdog = _arm_watchdog(config)
     try:
-        _probe_device()
+        _probe_device(config)
         if eval_mode:
             _measure_eval(config, profile_dir, watchdog=watchdog)
         else:
@@ -237,7 +323,7 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
 
     from replication_faster_rcnn_tpu.utils.profiling import trace
 
-    n_steps = 10
+    n_steps = int(os.environ.get("BENCH_STEPS", "10"))
     t0 = time.time()
     with trace(profile_dir):
         for _ in range(n_steps):
@@ -385,7 +471,7 @@ def _measure_eval(config, profile_dir=None, watchdog=None) -> None:
     for _ in range(3):
         out = ev._jit_infer(variables, images_dev)
     jax.device_get(out)
-    n_steps = 10
+    n_steps = int(os.environ.get("BENCH_STEPS", "10"))
     t0 = time.time()
     with trace(profile_dir):
         for _ in range(n_steps):

@@ -127,8 +127,8 @@ class TestBenchMeshValidation:
 
 class TestBenchWatchdog:
     def test_watchdog_fires_on_wedge(self):
-        """If the device wedges, bench must emit a diagnostic JSON line and
-        exit instead of hanging the driver."""
+        """If the device wedges with the fallback disabled, bench must emit
+        a diagnostic JSON line and exit instead of hanging the driver."""
         import json
         import subprocess
         import sys as _sys
@@ -146,12 +146,50 @@ class TestBenchWatchdog:
             text=True,
             timeout=60,
             env={**__import__("os").environ, "JAX_PLATFORMS": "cpu",
-                 "PALLAS_AXON_POOL_IPS": ""},
+                 "PALLAS_AXON_POOL_IPS": "",
+                 "BENCH_NO_FALLBACK": "1"},
         )
         assert proc.returncode == 2
         line = json.loads(proc.stdout.strip().splitlines()[-1])
         assert line["value"] == 0.0
         assert "watchdog" in line["error"]
+
+    def test_wedge_falls_back_to_cpu_measurement(self):
+        """A wedged TPU must yield a real (labeled) CPU measurement, not a
+        0.0 record — the round-1 failure mode. Drives _cpu_fallback with a
+        tiny config; the child re-measures it on a scrubbed CPU backend."""
+        import json
+        import os as _os
+        import subprocess
+        import sys as _sys
+
+        code = (
+            "import dataclasses\n"
+            "from replication_faster_rcnn_tpu.config import ("
+            "DataConfig, TrainConfig, MeshConfig, ProposalConfig, get_config)\n"
+            "from replication_faster_rcnn_tpu import benchmark\n"
+            "cfg = get_config('voc_resnet18').replace(\n"
+            "    data=DataConfig(dataset='synthetic', image_size=(64, 64),"
+            " max_boxes=8),\n"
+            "    proposals=ProposalConfig(pre_nms_train=128, post_nms_train=32),\n"
+            "    train=TrainConfig(batch_size=2), mesh=MeshConfig(num_data=1))\n"
+            "benchmark._cpu_fallback('simulated wedge', cfg)\n"
+        )
+        proc = subprocess.run(
+            [_sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env={**_os.environ, "JAX_PLATFORMS": "cpu",
+                 "PALLAS_AXON_POOL_IPS": ""},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        line = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert line["fallback_backend"] == "cpu"
+        assert "simulated wedge" in line["fallback_reason"]
+        assert line["value"] > 0
+        assert line["metric"] == "train_images_per_sec_64x64"
+        assert "error" not in line
 
 
 class TestTrainSmoke:
