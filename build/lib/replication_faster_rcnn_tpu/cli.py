@@ -1,0 +1,322 @@
+"""Command-line interface — the config/flag layer the reference never had
+(SURVEY.md §5: hyperparameters live in scattered constants and a flagless
+``__main__`` at reference `train.py:153-161`; BASELINE.json requires a
+``--device=tpu`` path).
+
+Subcommands:
+  train  — run the jitted SPMD trainer
+  eval   — run inference + VOC mAP over a dataset split
+  bench  — train-step throughput (same measurement as bench.py)
+
+``--config`` selects one of the five BASELINE presets (config.CONFIGS);
+individual flags override preset fields.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from typing import List, Optional
+
+
+def _apply_device(device: str) -> None:
+    """--device=tpu|cpu: pick the JAX backend before any computation."""
+    import jax
+
+    if device != "auto":
+        jax.config.update("jax_platforms", device)
+
+
+def _build_config(args):
+    from replication_faster_rcnn_tpu.config import get_config
+
+    cfg = get_config(args.config)
+    if args.dataset:
+        cfg = cfg.replace(data=dataclasses.replace(cfg.data, dataset=args.dataset))
+    if args.data_root:
+        cfg = cfg.replace(data=dataclasses.replace(cfg.data, root_dir=args.data_root))
+    if args.image_size:
+        cfg = cfg.replace(
+            data=dataclasses.replace(
+                cfg.data, image_size=(args.image_size, args.image_size)
+            )
+        )
+    train_kw = {}
+    if args.lr is not None:
+        train_kw["lr"] = args.lr
+    if args.batch_size is not None:
+        train_kw["batch_size"] = args.batch_size
+    if args.epochs is not None:
+        train_kw["n_epoch"] = args.epochs
+    if args.seed is not None:
+        train_kw["seed"] = args.seed
+    if getattr(args, "backend", None):
+        train_kw["backend"] = args.backend
+    if getattr(args, "shard_opt", False):
+        train_kw["shard_opt_state"] = True
+    if getattr(args, "eval_every", None) is not None:
+        train_kw["eval_every_epochs"] = args.eval_every
+    if train_kw:
+        cfg = cfg.replace(train=dataclasses.replace(cfg.train, **train_kw))
+    if args.backbone or args.roi_op or getattr(args, "remat", False):
+        model_kw = {}
+        if args.backbone:
+            model_kw["backbone"] = args.backbone
+        if args.roi_op:
+            model_kw["roi_op"] = args.roi_op
+        if getattr(args, "remat", False):
+            model_kw["remat"] = True
+        cfg = cfg.replace(model=dataclasses.replace(cfg.model, **model_kw))
+    mesh_kw = {}
+    if getattr(args, "num_model", None) is not None:
+        mesh_kw["num_model"] = args.num_model
+    if getattr(args, "spatial", False):
+        mesh_kw["spatial"] = True
+    if mesh_kw:
+        cfg = cfg.replace(mesh=dataclasses.replace(cfg.mesh, **mesh_kw))
+    eval_kw = {}
+    if getattr(args, "iou_thresh", None) is not None:
+        eval_kw["iou_thresh"] = args.iou_thresh
+    if getattr(args, "use_07_metric", False):
+        eval_kw["use_07_metric"] = True
+    if getattr(args, "metric", None):
+        eval_kw["metric"] = args.metric
+    if eval_kw:
+        cfg = cfg.replace(eval=dataclasses.replace(cfg.eval, **eval_kw))
+    return cfg
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", default="voc_resnet18",
+                   help="preset name (see replication_faster_rcnn_tpu.config.CONFIGS)")
+    p.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"],
+                   help="JAX backend (BASELINE --device flag)")
+    p.add_argument("--dataset", default=None, choices=[None, "voc", "coco", "synthetic"])
+    p.add_argument("--data-root", default=None)
+    p.add_argument("--image-size", type=int, default=None)
+    p.add_argument("--backbone", default=None,
+                   choices=[None, "resnet18", "resnet34", "resnet50", "resnet101",
+                            "resnet152", "resnext50_32x4d", "resnext101_32x8d",
+                            "wide_resnet50_2", "wide_resnet101_2", "vgg16"])
+    p.add_argument("--roi-op", default=None, choices=[None, "align", "pool"])
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--backend", default=None, choices=[None, "auto", "spmd"],
+                   help="SPMD backend: jit auto-partitioning or explicit "
+                        "shard_map collectives (parallel/spmd.py)")
+    p.add_argument("--shard-opt", action="store_true",
+                   help="ZeRO-1 weight-update sharding: Adam moments shard "
+                        "over the data axis (arXiv:2004.13336)")
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint each trunk block (recompute "
+                        "activations in backward; saves HBM)")
+    p.add_argument("--num-model", type=int, default=None,
+                   help="size of the mesh's model axis")
+    p.add_argument("--spatial", action="store_true",
+                   help="shard image rows over the model axis (spatial "
+                        "partitioning; GSPMD conv halo exchange)")
+
+
+def cmd_train(args) -> int:
+    _apply_device(args.device)
+    if args.debug_nans:
+        from replication_faster_rcnn_tpu.utils.debug import enable_nan_checks
+
+        enable_nan_checks()
+    from replication_faster_rcnn_tpu.train import Trainer
+
+    cfg = _build_config(args)
+    trainer = Trainer(cfg, workdir=args.workdir)
+    if args.pretrained_backbone:
+        trainer.load_pretrained_backbone(args.pretrained_backbone)
+    from replication_faster_rcnn_tpu.utils.profiling import trace
+
+    if args.steps:
+        # bounded-step mode (smoke/CI): iterate the loader cyclically
+        import itertools
+
+        it = itertools.cycle(iter(trainer.loader))
+        with trace(args.profile):
+            for i in range(args.steps):
+                metrics = trainer.train_one_batch(next(it))
+                if i % max(1, args.log_every) == 0:
+                    import jax
+
+                    from replication_faster_rcnn_tpu.utils.debug import finite_or_raise
+
+                    trainer.logger.log(i, finite_or_raise(jax.device_get(metrics), i))
+        return 0
+    with trace(args.profile):
+        trainer.train(resume=args.resume, log_every=args.log_every)
+    trainer.save()
+    return 0
+
+
+def cmd_eval(args) -> int:
+    _apply_device(args.device)
+    from replication_faster_rcnn_tpu.data import make_dataset
+    from replication_faster_rcnn_tpu.eval import Evaluator
+    from replication_faster_rcnn_tpu.train.trainer import load_eval_variables
+
+    cfg = _build_config(args)
+    model, variables = load_eval_variables(cfg, args.workdir, args.checkpoint_step)
+    dataset = make_dataset(cfg.data, args.split)
+    ev = Evaluator(cfg, model)
+    result = ev.evaluate(
+        variables, dataset, batch_size=cfg.train.batch_size,
+        max_images=args.max_images,
+    )
+    if cfg.eval.metric == "coco":
+        print(
+            f"mAP@[.50:.95]: {result['mAP']:.4f} "
+            f"(AP50 {result.get('AP50', float('nan')):.4f}, "
+            f"AP75 {result.get('AP75', float('nan')):.4f})"
+        )
+    else:
+        print(f"mAP@{cfg.eval.iou_thresh}: {result['mAP']:.4f}")
+    if args.per_class and "ap_per_class" in result:
+        import numpy as np
+
+        from replication_faster_rcnn_tpu.config import COCO_CLASSES, VOC_CLASSES
+
+        names = {len(VOC_CLASSES): VOC_CLASSES, len(COCO_CLASSES): COCO_CLASSES}.get(
+            cfg.model.num_classes,
+            [str(i) for i in range(cfg.model.num_classes)],
+        )
+        aps = result["ap_per_class"]
+        for c in range(1, cfg.model.num_classes):
+            ap = aps[c]
+            shown = "   n/a" if not np.isfinite(ap) else f"{ap:6.4f}"
+            print(f"  {names[c]:>16s}  AP {shown}")
+    return 0
+
+
+def cmd_bench(args) -> int:
+    _apply_device(args.device)
+    from replication_faster_rcnn_tpu.benchmark import main as bench_main
+
+    # pass flag overrides through; None keeps the flagship default setup
+    flagged = any(
+        v is not None
+        for v in (
+            args.dataset, args.data_root, args.image_size, args.backbone,
+            args.roi_op, args.batch_size, args.lr, args.epochs, args.seed,
+            args.num_model, args.backend,
+        )
+    ) or args.spatial or args.remat or args.shard_opt or args.config != "voc_resnet18"
+    bench_main(_build_config(args) if flagged else None, profile_dir=args.profile)
+    return 0
+
+
+def cmd_predict(args) -> int:
+    _apply_device(args.device)
+    import json
+
+    from replication_faster_rcnn_tpu.eval.predict import (
+        draw_detections,
+        predict_image,
+    )
+    from replication_faster_rcnn_tpu.train.trainer import load_eval_variables
+
+    cfg = _build_config(args)
+    model, variables = load_eval_variables(cfg, args.workdir, args.checkpoint_step)
+    dets = predict_image(cfg, model, variables, args.image, args.score_thresh)
+    print(json.dumps(dets, indent=2))
+    if args.output:
+        draw_detections(args.image, dets, args.output)
+        print(f"annotated image written to {args.output}")
+    return 0
+
+
+def cmd_viz(args) -> int:
+    """Visual sanity artifacts (reference `utils/anchors.py:64-77` anchor
+    plot and `utils/data_loader.py:119-134` gt overlay, as a real command)."""
+    _apply_device(args.device)
+    cfg = _build_config(args)
+    from replication_faster_rcnn_tpu.utils import viz
+
+    if args.what == "anchors":
+        viz.draw_anchor_centers(cfg, args.output)
+    else:  # sample
+        from replication_faster_rcnn_tpu.data.loader import make_dataset
+
+        ds = make_dataset(cfg.data, args.split)
+        viz.draw_gt_overlay(ds[args.index], cfg, args.output)
+    print(f"{args.what} visualization written to {args.output}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="replication_faster_rcnn_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_train = sub.add_parser("train", help="train a detector")
+    _add_common(p_train)
+    p_train.add_argument("--workdir", default="checkpoints")
+    p_train.add_argument("--steps", type=int, default=0,
+                         help="run exactly N steps instead of the epoch loop")
+    p_train.add_argument("--log-every", type=int, default=10)
+    p_train.add_argument("--resume", action="store_true")
+    p_train.add_argument("--pretrained-backbone", default=None,
+                         help="torch resnet .pth to graft (reference readme.md:10-12)")
+    p_train.add_argument("--eval-every", type=int, default=None,
+                         help="run val mAP every N epochs (0 = never)")
+    p_train.add_argument("--profile", default=None, metavar="DIR",
+                         help="jax.profiler trace of the training loop")
+    p_train.add_argument("--debug-nans", action="store_true",
+                         help="enable jax_debug_nans (every jit output "
+                              "checked; errors pinpoint the emitting op)")
+    p_train.set_defaults(fn=cmd_train)
+
+    p_eval = sub.add_parser("eval", help="evaluate mAP")
+    _add_common(p_eval)
+    p_eval.add_argument("--workdir", default="checkpoints")
+    p_eval.add_argument("--split", default="val")
+    p_eval.add_argument("--checkpoint-step", type=int, default=None)
+    p_eval.add_argument("--max-images", type=int, default=None)
+    p_eval.add_argument("--per-class", action="store_true",
+                        help="print the per-class AP table")
+    p_eval.add_argument("--iou-thresh", type=float, default=None,
+                        help="matching IoU for VOC mAP (default 0.5)")
+    p_eval.add_argument("--use-07-metric", action="store_true",
+                        help="VOC2007 11-point AP instead of area-under-PR")
+    p_eval.add_argument("--metric", default=None, choices=[None, "voc", "coco"],
+                        help="voc: mAP@iou-thresh; coco: mAP@[.50:.95]")
+    p_eval.set_defaults(fn=cmd_eval)
+
+    p_bench = sub.add_parser("bench", help="train-step throughput")
+    _add_common(p_bench)
+    p_bench.add_argument("--profile", default=None, metavar="DIR",
+                         help="write a jax.profiler trace of the timed "
+                              "loop (TensorBoard/Perfetto)")
+    p_bench.set_defaults(fn=cmd_bench)
+
+    p_pred = sub.add_parser("predict", help="detect objects in one image")
+    _add_common(p_pred)
+    p_pred.add_argument("--image", required=True)
+    p_pred.add_argument("--workdir", default="checkpoints")
+    p_pred.add_argument("--checkpoint-step", type=int, default=None)
+    p_pred.add_argument("--score-thresh", type=float, default=0.5)
+    p_pred.add_argument("--output", default=None,
+                        help="write the image with boxes drawn to this path")
+    p_pred.set_defaults(fn=cmd_predict)
+
+    p_viz = sub.add_parser("viz", help="visual sanity artifacts "
+                                       "(anchor centers / gt overlay)")
+    _add_common(p_viz)
+    p_viz.add_argument("what", choices=["anchors", "sample"])
+    p_viz.add_argument("--output", required=True)
+    p_viz.add_argument("--split", default="train")
+    p_viz.add_argument("--index", type=int, default=0,
+                       help="dataset sample index (what=sample)")
+    p_viz.set_defaults(fn=cmd_viz)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

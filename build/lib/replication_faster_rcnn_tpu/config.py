@@ -1,0 +1,380 @@
+"""Central configuration registry.
+
+The reference scatters every hyperparameter across module constants and
+hard-coded literals (SURVEY.md §2.2; reference `utils/utils.py:6-21`,
+`train.py:139-159`, `utils/data_loader.py:21,81`, `nets/heads.py:8,21-22`,
+`nets/faster_rcnn.py:4-5`). This module centralizes all of them as frozen
+dataclasses so configs are hashable (usable as jit static args) and the five
+BASELINE.json configs are expressible as presets.
+
+Box convention used throughout the framework (matches the reference's
+row-major convention, reference `nets/faster_rcnn.py:10`,
+`utils/data_loader.py:104-105`): boxes are ``[r1, c1, r2, c2]`` where ``r``
+indexes image rows (height) and ``c`` image columns (width). Regression
+deltas are ``[dr, dc, dh, dw]`` with ``h`` = row extent, ``w`` = col extent
+(reference `utils/utils.py:47-100`, which calls the row axis "x").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+VOC_CLASSES: Tuple[str, ...] = (
+    "__background__",
+    "aeroplane", "bicycle", "bird", "boat",
+    "bottle", "bus", "car", "cat", "chair",
+    "cow", "diningtable", "dog", "horse",
+    "motorbike", "person", "pottedplant",
+    "sheep", "sofa", "train", "tvmonitor",
+)
+VOC_NUM_CLASSES = len(VOC_CLASSES)  # 21 incl. background (reference utils/utils.py:15-21)
+
+# COCO-2017 "thing" classes for the BASELINE config #5 (80 + background).
+COCO_CLASSES: Tuple[str, ...] = (
+    "__background__",
+    "person", "bicycle", "car", "motorcycle", "airplane", "bus", "train",
+    "truck", "boat", "traffic light", "fire hydrant", "stop sign",
+    "parking meter", "bench", "bird", "cat", "dog", "horse", "sheep", "cow",
+    "elephant", "bear", "zebra", "giraffe", "backpack", "umbrella", "handbag",
+    "tie", "suitcase", "frisbee", "skis", "snowboard", "sports ball", "kite",
+    "baseball bat", "baseball glove", "skateboard", "surfboard",
+    "tennis racket", "bottle", "wine glass", "cup", "fork", "knife", "spoon",
+    "bowl", "banana", "apple", "sandwich", "orange", "broccoli", "carrot",
+    "hot dog", "pizza", "donut", "cake", "chair", "couch", "potted plant",
+    "bed", "dining table", "toilet", "tv", "laptop", "mouse", "remote",
+    "keyboard", "cell phone", "microwave", "oven", "toaster", "sink",
+    "refrigerator", "book", "clock", "vase", "scissors", "teddy bear",
+    "hair drier", "toothbrush",
+)
+COCO_NUM_CLASSES = len(COCO_CLASSES)  # 81 incl. background
+
+
+@dataclasses.dataclass(frozen=True)
+class AnchorConfig:
+    """Anchor grid definition (reference `utils/anchors.py:5-61`,
+    `nets/faster_rcnn.py:4-5`)."""
+
+    base_size: int = 16
+    ratios: Tuple[float, ...] = (0.5, 1.0, 2.0)
+    scales: Tuple[float, ...] = (8.0, 16.0, 32.0)
+    feat_stride: int = 16
+
+    @property
+    def num_base_anchors(self) -> int:
+        return len(self.ratios) * len(self.scales)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProposalConfig:
+    """Proposal-layer budgets (reference `utils/utils.py:7-12`,
+    `nets/rpn.py:20-79`). Fixed-shape on TPU: outputs are padded to
+    ``post_nms`` with a validity mask."""
+
+    nms_thresh: float = 0.7
+    pre_nms_train: int = 12000
+    post_nms_train: int = 600
+    pre_nms_test: int = 3000
+    post_nms_test: int = 300
+    min_size: float = 16.0
+
+    def pre_nms(self, train: bool) -> int:
+        return self.pre_nms_train if train else self.pre_nms_test
+
+    def post_nms(self, train: bool) -> int:
+        return self.post_nms_train if train else self.post_nms_test
+
+
+@dataclasses.dataclass(frozen=True)
+class RPNTargetConfig:
+    """RPN (first-stage) target sampling (reference `utils/utils.py:122-204`,
+    `train.py:24-25`)."""
+
+    n_sample: int = 256
+    pos_iou_thresh: float = 0.7
+    neg_iou_thresh: float = 0.3
+    pos_ratio: float = 0.5
+
+
+@dataclasses.dataclass(frozen=True)
+class ROITargetConfig:
+    """Second-stage (head) target sampling (reference
+    `utils/utils.py:207-276`, `train.py:26`). Output is a deterministic,
+    padded ``n_sample`` rois per image (fixing the reference's latent
+    variable-length bug, SURVEY.md §2.1 #5)."""
+
+    n_sample: int = 128
+    pos_ratio: float = 0.5
+    pos_iou_thresh: float = 0.5
+    neg_iou_thresh_high: float = 0.5
+    neg_iou_thresh_low: float = 0.0
+    reg_mean: Tuple[float, float, float, float] = (0.0, 0.0, 0.0, 0.0)
+    reg_std: Tuple[float, float, float, float] = (0.1, 0.1, 0.2, 0.2)
+
+    @property
+    def n_pos_max(self) -> int:
+        return int(round(self.n_sample * self.pos_ratio))
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Network architecture (reference `nets/` — resnet_torch.py:392-409 split,
+    rpn.py:82-100, heads.py:7-26)."""
+
+    # any arch from the reference's constructor table (`nets/resnet_torch.py:
+    # 271-390`): resnet18/34/50/101/152, resnext50_32x4d, resnext101_32x8d,
+    # wide_resnet50_2, wide_resnet101_2
+    backbone: str = "resnet18"
+    num_classes: int = VOC_NUM_CLASSES
+    rpn_mid_channels: int = 256
+    roi_size: int = 7
+    roi_op: str = "align"  # "align" (bilinear ROIAlign) | "pool" (quantized ROIPool)
+    roi_sampling_ratio: int = 2  # ROIAlign samples per bin side
+    fpn: bool = False  # FPN neck (BASELINE config #3)
+    fpn_channels: int = 256  # P-level width (FPN paper)
+    # compute dtype for conv stacks; params/losses stay float32
+    compute_dtype: str = "bfloat16"
+    # jax.checkpoint each residual block in the trunk: the backward pass
+    # recomputes block activations instead of holding them in HBM — ~1/3
+    # more FLOPs for large activation-memory savings (bigger batches /
+    # deeper backbones at 600x600). Parameter trees are unchanged.
+    remat: bool = False
+    # mesh axis name for cross-replica (sync) BatchNorm — set ONLY when the
+    # model runs inside shard_map (`parallel/spmd.py`); under jit
+    # auto-partitioning the global-batch BN reduction happens automatically
+    # and a named axis here would be unbound.
+    bn_axis: Optional[str] = None
+
+    def __post_init__(self):
+        if self.roi_op not in ("align", "pool"):
+            raise ValueError(f"roi_op must be 'align' or 'pool', got {self.roi_op!r}")
+
+    @property
+    def backbone_channels(self) -> int:
+        """Feature channels out of the stride-16 trunk (conv1..layer3, or
+        conv5_3 for VGG16). Delegates to the model layer's arch tables so
+        unknown names fail fast here (at config time) rather than deep
+        inside model init."""
+        if self.backbone == "vgg16":
+            from replication_faster_rcnn_tpu.models.vgg import VGG16_TRUNK_CHANNELS
+
+            return VGG16_TRUNK_CHANNELS
+        from replication_faster_rcnn_tpu.models.resnet import trunk_channels
+
+        return trunk_channels(self.backbone)
+
+    @property
+    def head_channels(self) -> int:
+        """Channels out of the classifier tail (layer4+avgpool, or fc7)."""
+        if self.backbone == "vgg16":
+            from replication_faster_rcnn_tpu.models.vgg import VGG16_TAIL_CHANNELS
+
+            return VGG16_TAIL_CHANNELS
+        from replication_faster_rcnn_tpu.models.resnet import tail_channels
+
+        return tail_channels(self.backbone)
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Data pipeline (reference `utils/data_loader.py:17-117`)."""
+
+    root_dir: str = "data/voc/VOCdevkit/VOC2012"
+    dataset: str = "voc"  # voc | coco | synthetic
+    image_size: Tuple[int, int] = (600, 600)
+    max_boxes: int = 32
+    use_difficult: bool = False
+    # ImageNet normalization (reference utils/data_loader.py:38)
+    pixel_mean: Tuple[float, float, float] = (0.485, 0.456, 0.406)
+    pixel_std: Tuple[float, float, float] = (0.229, 0.224, 0.225)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Optimization (reference `train.py:139-159`)."""
+
+    lr: float = 1e-4
+    # The reference's __main__ uses lr=0.01 with Adam, which diverges in
+    # practice; 1e-4 is the stable default. `--lr` restores any value.
+    weight_decay: float = 5e-6
+    n_epoch: int = 50
+    batch_size: int = 8  # per-step global batch (reference default 2)
+    smooth_l1_sigma: float = 1.0
+    checkpoint_every_epochs: int = 10
+    seed: int = 0
+    # loss weights: the reference sums the 4 losses unweighted (train.py:123)
+    loss_weights: Tuple[float, float, float, float] = (1.0, 1.0, 1.0, 1.0)
+    # SPMD backend: "auto" = jit auto-partitioning (XLA places collectives),
+    # "spmd" = explicit shard_map step with hand-placed psums + sync-BN
+    # (`parallel/spmd.py`); both compute the same update (tested).
+    backend: str = "auto"
+    # ZeRO-1 / cross-replica weight-update sharding (arXiv:2004.13336,
+    # `parallel/zero.py`): shard Adam moments over the data axis; each chip
+    # updates 1/N of the weights (reduce-scatter + all-gather via GSPMD).
+    # Auto-partitioning backend only.
+    shard_opt_state: bool = False
+    # run the mAP evaluator on the val split every N epochs (0 = off)
+    eval_every_epochs: int = 0
+
+    def __post_init__(self):
+        if self.backend not in ("auto", "spmd"):
+            raise ValueError(f"backend must be 'auto' or 'spmd', got {self.backend!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class EvalConfig:
+    """Inference decode + mAP. The reference never wrote its eval path
+    (`test_eval.py` is empty, SURVEY.md §3.2) so these are our own choices."""
+
+    score_thresh: float = 0.05
+    nms_thresh: float = 0.3
+    max_detections: int = 100
+    iou_thresh: float = 0.5  # mAP@0.5
+    use_07_metric: bool = False  # area-under-PR by default; True = 11-point
+    metric: str = "voc"  # "voc" (mAP@iou_thresh) | "coco" (mAP@[.50:.95])
+
+    def __post_init__(self):
+        if self.metric not in ("voc", "coco"):
+            raise ValueError(f"metric must be 'voc' or 'coco', got {self.metric!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh for SPMD parallelism (SURVEY.md §2.4). The workload is
+    data-parallel; the `model` axis exists so tensor-parallel shardings can
+    be introduced without changing the mesh plumbing.
+
+    ``spatial`` turns on spatial partitioning over the ``model`` axis: each
+    image's row (H) dimension is sharded across it, the vision analogue of
+    sequence/context parallelism (there is no sequence axis in a detector —
+    SURVEY.md §5 — the long axis is image extent). GSPMD inserts the halo
+    exchanges every conv needs at shard boundaries; one image then spans
+    ``num_model`` chips, so images larger than a single chip's HBM budget
+    still train. Requires the default jit auto-partitioning backend."""
+
+    data_axis: str = "data"
+    model_axis: str = "model"
+    num_data: int = -1  # -1: all available devices
+    num_model: int = 1
+    spatial: bool = False  # shard image rows over the model axis
+
+
+@dataclasses.dataclass(frozen=True)
+class FasterRCNNConfig:
+    anchors: AnchorConfig = dataclasses.field(default_factory=AnchorConfig)
+    proposals: ProposalConfig = dataclasses.field(default_factory=ProposalConfig)
+    rpn_targets: RPNTargetConfig = dataclasses.field(default_factory=RPNTargetConfig)
+    roi_targets: ROITargetConfig = dataclasses.field(default_factory=ROITargetConfig)
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    eval: EvalConfig = dataclasses.field(default_factory=EvalConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+
+    def feature_size(self, image_size: Optional[Tuple[int, int]] = None) -> Tuple[int, int]:
+        """Spatial size of the stride-16 feature map for a given image size.
+
+        The ResNet trunk applies four stride-2 stages, each of which maps
+        ``n -> ceil(n / 2)`` under the reference's torch padding
+        (conv 7x7/s2/p3, maxpool 3x3/s2/p1, two 3x3/s2/p1 convs) — e.g.
+        600 -> 300 -> 150 -> 75 -> 38.
+        """
+        h, w = image_size if image_size is not None else self.data.image_size
+        for _ in range(4):
+            h = math.ceil(h / 2)
+            w = math.ceil(w / 2)
+        return h, w
+
+    def num_anchors(self, image_size: Optional[Tuple[int, int]] = None) -> int:
+        fh, fw = self.feature_size(image_size)
+        return fh * fw * self.anchors.num_base_anchors
+
+    def replace(self, **kwargs) -> "FasterRCNNConfig":
+        return dataclasses.replace(self, **kwargs)
+
+
+def _cfg(**kw) -> FasterRCNNConfig:
+    return FasterRCNNConfig(**kw)
+
+
+# The five BASELINE.json configs.
+CONFIGS = {
+    # 1. ResNet18 + RPN + ROIPool on VOC07 (the reference's train.py defaults,
+    #    pointed at the VOC2007 devkit per the BASELINE.json metric; the
+    #    reference itself hard-codes VOC2012, `frcnn.py:19`)
+    "voc_resnet18": _cfg(
+        model=ModelConfig(backbone="resnet18", roi_op="pool"),
+        data=DataConfig(root_dir="data/voc/VOCdevkit/VOC2007"),
+    ),
+    # 2. ResNet50 backbone on VOC07
+    "voc_resnet50": _cfg(
+        model=ModelConfig(backbone="resnet50", roi_op="pool"),
+        data=DataConfig(root_dir="data/voc/VOCdevkit/VOC2007"),
+    ),
+    # 3. FPN neck over ResNet50 + multi-scale anchors
+    "voc_resnet50_fpn": _cfg(
+        model=ModelConfig(backbone="resnet50", roi_op="align", fpn=True),
+        anchors=AnchorConfig(scales=(8.0,)),  # one scale per FPN level
+    ),
+    # 4. ROIAlign head on VOC12
+    "voc12_resnet18_align": _cfg(
+        model=ModelConfig(backbone="resnet18", roi_op="align"),
+        data=DataConfig(root_dir="data/voc/VOCdevkit/VOC2012"),
+    ),
+    # 5. COCO-2017 80-class, batch 32, data-parallel v5e-8
+    "coco_resnet50": _cfg(
+        model=ModelConfig(backbone="resnet50", num_classes=COCO_NUM_CLASSES, roi_op="align"),
+        data=DataConfig(dataset="coco", root_dir="data/coco", max_boxes=100),
+        train=TrainConfig(batch_size=32),
+        eval=EvalConfig(metric="coco"),
+    ),
+    # 6. The py-faster-rcnn VGG16 COCO net the reference documents via its
+    #    checked-in Caffe prototxt (`reference/train_frcnn.prototxt`: VGG16
+    #    features, 512-wide RPN conv, 12 anchors = 3 ratios x 4 scales
+    #    [num_output 48 = 4*12 at :410-417], RoIPool 7x7, 81 classes).
+    "coco_vgg16": _cfg(
+        model=ModelConfig(
+            backbone="vgg16",
+            num_classes=COCO_NUM_CLASSES,
+            roi_op="pool",
+            rpn_mid_channels=512,
+        ),
+        anchors=AnchorConfig(scales=(4.0, 8.0, 16.0, 32.0)),
+        data=DataConfig(dataset="coco", root_dir="data/coco", max_boxes=100),
+        eval=EvalConfig(metric="coco"),
+    ),
+}
+
+
+def get_config(name: str = "voc_resnet18", **overrides) -> FasterRCNNConfig:
+    """Look up a preset config by name, optionally replacing top-level fields."""
+    if name not in CONFIGS:
+        raise KeyError(f"unknown config {name!r}; choices: {sorted(CONFIGS)}")
+    cfg = CONFIGS[name]
+    return cfg.replace(**overrides) if overrides else cfg
+
+
+def config_from_dict(d: dict) -> FasterRCNNConfig:
+    """Rebuild a :class:`FasterRCNNConfig` from ``dataclasses.asdict``
+    output, e.g. after a JSON round-trip (lists re-become tuples). Used to
+    ship a config to a subprocess (benchmark FLOPs analysis)."""
+    import typing
+
+    def deep_tuple(v):
+        return tuple(deep_tuple(x) for x in v) if isinstance(v, list) else v
+
+    def build(cls, dd):
+        hints = typing.get_type_hints(cls)
+        kw = {}
+        for f in dataclasses.fields(cls):
+            v = dd[f.name]
+            t = hints.get(f.name)
+            if dataclasses.is_dataclass(t) and isinstance(v, dict):
+                v = build(t, v)
+            else:
+                v = deep_tuple(v)
+            kw[f.name] = v
+        return cls(**kw)
+
+    return build(FasterRCNNConfig, d)

@@ -1,0 +1,91 @@
+"""COCO-2017 dataset — BASELINE.json config #5 ("COCO-2017 80-class").
+
+No reference equivalent exists (the reference is VOC-only; its prototxt
+docs describe the original COCO py-faster-rcnn, `reference/
+train_frcnn.prototxt:410-417`). Annotation parsing uses stdlib json —
+pycocotools is not in this image and is only needed for COCO's own eval
+metric, not for training.
+
+Samples come out in the same fixed-shape format as VOCDataset: row-major
+[ymin, xmin, ymax, xmax] boxes scaled to the resized image, labels 1..80
+(contiguous, background 0), -1 padding.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+import numpy as np
+
+from replication_faster_rcnn_tpu.config import DataConfig
+from replication_faster_rcnn_tpu.data.voc import _load_image
+
+
+class COCODataset:
+    """Map-style COCO detection dataset.
+
+    Expects the standard layout: {root}/annotations/instances_{split}.json
+    and {root}/{split}/ images (split like 'train2017'/'val2017').
+    """
+
+    def __init__(self, cfg: DataConfig, split: str = "train2017") -> None:
+        self.cfg = cfg
+        self.split = split
+        ann_path = os.path.join(
+            cfg.root_dir, "annotations", f"instances_{split}.json"
+        )
+        with open(ann_path) as f:
+            ann = json.load(f)
+
+        # category ids are sparse (1..90 with gaps); remap to contiguous 1..80
+        cat_ids = sorted(c["id"] for c in ann["categories"])
+        self.cat_to_label = {cid: i + 1 for i, cid in enumerate(cat_ids)}
+        self.classes = ["__background__"] + [
+            c["name"] for c in sorted(ann["categories"], key=lambda c: c["id"])
+        ]
+
+        self.images = {im["id"]: im for im in ann["images"]}
+        self.anns_by_image: Dict[int, List[dict]] = {}
+        for a in ann["annotations"]:
+            if a.get("iscrowd", 0):
+                continue  # crowd regions are not box targets
+            self.anns_by_image.setdefault(a["image_id"], []).append(a)
+        # train on images that have at least one target, like py-faster-rcnn
+        self.ids = [i for i in self.images if self.anns_by_image.get(i)]
+        self.ids.sort()
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        img_id = self.ids[idx]
+        info = self.images[img_id]
+        path = os.path.join(self.cfg.root_dir, self.split, info["file_name"])
+        image, orig_h, orig_w = _load_image(
+            path, self.cfg.image_size, self.cfg.pixel_mean, self.cfg.pixel_std
+        )
+
+        m = self.cfg.max_boxes
+        labels = np.full((m,), -1, np.int32)
+        boxes = np.full((m, 4), -1.0, np.float32)
+        new_h, new_w = self.cfg.image_size
+        for i, a in enumerate(self.anns_by_image[img_id][:m]):
+            x, y, w, h = a["bbox"]  # COCO xywh, column-major
+            boxes[i] = [
+                y * new_h / orig_h,
+                x * new_w / orig_w,
+                (y + h) * new_h / orig_h,
+                (x + w) * new_w / orig_w,
+            ]
+            labels[i] = self.cat_to_label[a["category_id"]]
+
+        return {
+            "image": image.astype(np.float32),
+            "boxes": boxes,
+            "labels": labels,
+            "mask": labels >= 0,
+            # COCO has no 'difficult' notion; uniform key for collate
+            "difficult": np.zeros((m,), bool),
+        }

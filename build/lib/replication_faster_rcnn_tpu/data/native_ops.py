@@ -1,0 +1,267 @@
+"""ctypes bindings for the native host-side kernels (native/frcnn_native.cpp)
+with exact-equivalent numpy fallbacks.
+
+The native library replaces, in the framework's own code, the compiled host
+kernels the reference borrows from skimage/torchvision (SURVEY.md §2.3):
+fused bilinear-resize+normalize for the data pipeline and greedy NMS for
+CPU-side post-processing. If the ``.so`` is absent, a best-effort ``make``
+builds it; failing that, the numpy fallbacks keep everything working (the
+fallbacks ARE the behavioral spec — parity is tested both ways).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SO_PATH = os.path.join(_REPO, "native", "build", "libfrcnn_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_checked = False
+_lib_lock = threading.Lock()  # loader threads race here on first batch
+
+
+def _try_build(rebuild: bool = False) -> bool:
+    """Best-effort make, degrading through host capabilities: full build,
+    then without -march=native (older gcc), then without libjpeg (missing
+    jpeglib.h — the JPEG entry points are simply absent), then both."""
+    flag_sets = [[], ["MARCH="], ["JPEG=0"], ["MARCH=", "JPEG=0"]]
+    base = ["make", "-C", os.path.join(_REPO, "native")]
+    if rebuild:
+        base.insert(1, "-B")
+    for flags in flag_sets:
+        try:
+            subprocess.run(
+                base + flags, check=True, capture_output=True, timeout=120
+            )
+            return True
+        except Exception:
+            continue
+    return False
+
+
+def _rebuild_and_reload() -> Optional[ctypes.CDLL]:
+    """Rebuild the .so and dlopen it under a fresh unique pathname (glibc
+    caches dlopen by path, so reloading _SO_PATH would return the old
+    handle). Returns None if the rebuild or reload fails, or if the
+    rebuilt library still lacks the JPEG entry points (JPEG=0 fallback
+    build) — callers then keep whatever library they already have."""
+    import shutil
+    import tempfile
+
+    if not _try_build(rebuild=True):
+        return None
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", prefix="frcnn_native_")
+        os.close(fd)
+        shutil.copy2(_SO_PATH, tmp)
+        lib = ctypes.CDLL(tmp)
+        os.unlink(tmp)  # the mapping survives the unlink
+    except Exception:
+        return None
+    return lib if hasattr(lib, "decode_jpeg_resize_normalize") else None
+
+
+def _load_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_checked
+    if _lib_checked:
+        return _lib
+    with _lib_lock:
+        return _load_lib_locked()
+
+
+def _load_lib_locked() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_checked
+    if _lib_checked:
+        return _lib
+    _lib_checked = True
+    if not os.path.exists(_SO_PATH):
+        if not _try_build():
+            return None  # numpy fallbacks cover everything
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    if not hasattr(lib, "decode_jpeg_resize_normalize"):
+        # stale .so from before the JPEG kernels. Rebuild, then load the
+        # fresh file through a unique temp copy: re-dlopening the same
+        # pathname would return the cached stale handle (ctypes never
+        # dlcloses). On any failure keep the stale-but-working library —
+        # resize/NMS/scale_boxes don't need libjpeg.
+        lib = _rebuild_and_reload() or lib
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.resize_bilinear_normalize.argtypes = [
+        u8p, ctypes.c_int, ctypes.c_int, f32p, ctypes.c_int, ctypes.c_int,
+        f32p, f32p,
+    ]
+    lib.resize_bilinear_normalize.restype = None
+    lib.nms_greedy.argtypes = [
+        f32p, f32p, ctypes.c_int, ctypes.c_float, i32p, ctypes.c_int,
+    ]
+    lib.nms_greedy.restype = ctypes.c_int
+    lib.scale_boxes.argtypes = [
+        f32p, i32p, ctypes.c_int, ctypes.c_float, ctypes.c_float,
+    ]
+    lib.scale_boxes.restype = None
+    if hasattr(lib, "decode_jpeg_resize_normalize"):  # absent in JPEG=0 builds
+        lib.decode_jpeg_resize_normalize.argtypes = [
+            u8p, ctypes.c_int64, f32p, ctypes.c_int, ctypes.c_int,
+            f32p, f32p, ctypes.c_int, i32p, i32p,
+        ]
+        lib.decode_jpeg_resize_normalize.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load_lib() is not None
+
+
+def _resize_normalize_numpy(
+    img: np.ndarray, out_hw: Tuple[int, int], mean: np.ndarray, std: np.ndarray
+) -> np.ndarray:
+    """The behavioral spec of the C++ kernel: bilinear with
+    align_corners=False sampling, fused /255 + mean/std normalization."""
+    sh, sw = img.shape[:2]
+    dh, dw = out_hw
+    sr = np.clip((np.arange(dh) + 0.5) * (sh / dh) - 0.5, 0, sh - 1)
+    sc = np.clip((np.arange(dw) + 0.5) * (sw / dw) - 0.5, 0, sw - 1)
+    r0 = sr.astype(np.int32)
+    c0 = sc.astype(np.int32)
+    r1 = np.minimum(r0 + 1, sh - 1)
+    c1 = np.minimum(c0 + 1, sw - 1)
+    fr = (sr - r0).astype(np.float32)[:, None, None]
+    fc = (sc - c0).astype(np.float32)[None, :, None]
+    im = img.astype(np.float32)
+    top = im[r0][:, c0] * (1 - fc) + im[r0][:, c1] * fc
+    bot = im[r1][:, c0] * (1 - fc) + im[r1][:, c1] * fc
+    out = top * (1 - fr) + bot * fr
+    return ((out / 255.0 - mean) / std).astype(np.float32)
+
+
+def resize_normalize(
+    img: np.ndarray,
+    out_hw: Tuple[int, int],
+    mean,
+    std,
+) -> np.ndarray:
+    """uint8 HWC RGB -> normalized float32 [out_h, out_w, 3]."""
+    img = np.ascontiguousarray(img, np.uint8)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    lib = _load_lib()
+    if lib is None:
+        return _resize_normalize_numpy(img, out_hw, mean, std)
+    dst = np.empty((out_hw[0], out_hw[1], 3), np.float32)
+    lib.resize_bilinear_normalize(
+        img, img.shape[0], img.shape[1], dst, out_hw[0], out_hw[1], mean, std
+    )
+    return dst
+
+
+def scale_boxes(
+    boxes: np.ndarray,
+    labels: np.ndarray,
+    row_scale: float,
+    col_scale: float,
+) -> np.ndarray:
+    """Scale + round padded [m, 4] boxes to resized-image coords, leaving
+    entries with label < 0 untouched (reference
+    `utils/data_loader.py:66-69,115` semantics)."""
+    boxes = np.ascontiguousarray(boxes, np.float32).copy()
+    labels = np.ascontiguousarray(labels, np.int32)
+    lib = _load_lib()
+    if lib is None:
+        real = labels >= 0
+        scale = np.asarray([row_scale, col_scale, row_scale, col_scale], np.float32)
+        return np.where(real[:, None], np.round(boxes * scale), boxes)
+    lib.scale_boxes(boxes, labels, len(boxes), row_scale, col_scale)
+    return boxes
+
+
+def decode_jpeg_resize_normalize(
+    data: bytes,
+    out_hw: Tuple[int, int],
+    mean,
+    std,
+    fast_scale: bool = True,
+) -> Optional[Tuple[np.ndarray, int, int]]:
+    """JPEG bytes -> (normalized float32 [out_h, out_w, 3], orig_h, orig_w).
+
+    The whole loader hot path — decode, RGB conversion, bilinear resize,
+    /255 + mean/std — in one native call. ``fast_scale`` enables libjpeg's
+    DCT-domain 1/2..1/8 prescaling when the source is at least 2x the
+    target in both dims (large decode savings, sub-bilinear-error quality
+    difference). Returns None when the native library is unavailable or
+    the bytes don't decode (caller falls back to PIL — which also covers
+    non-JPEG files like the occasional PNG-in-.jpg).
+    """
+    lib = _load_lib()
+    if lib is None or not hasattr(lib, "decode_jpeg_resize_normalize"):
+        return None
+    buf = np.frombuffer(data, np.uint8)
+    dims = np.empty((2,), np.int32)
+    dst = np.empty((out_hw[0], out_hw[1], 3), np.float32)
+    rc = lib.decode_jpeg_resize_normalize(
+        buf,
+        buf.size,
+        dst,
+        out_hw[0],
+        out_hw[1],
+        np.asarray(mean, np.float32),
+        np.asarray(std, np.float32),
+        1 if fast_scale else 0,
+        dims[0:1],
+        dims[1:2],
+    )
+    if rc != 0:
+        return None
+    return dst, int(dims[0]), int(dims[1])
+
+
+def _nms_numpy(
+    boxes: np.ndarray, scores: np.ndarray, thresh: float, max_keep: int
+) -> np.ndarray:
+    order = np.argsort(-scores, kind="stable")
+    dead = np.zeros(len(boxes), bool)
+    area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    keep = []
+    for i in order:
+        if dead[i] or len(keep) >= max_keep:
+            if len(keep) >= max_keep:
+                break
+            continue
+        keep.append(int(i))
+        tl = np.maximum(boxes[i, :2], boxes[:, :2])
+        br = np.minimum(boxes[i, 2:], boxes[:, 2:])
+        wh = np.clip(br - tl, 0, None)
+        inter = wh[:, 0] * wh[:, 1]
+        union = area[i] + area - inter
+        iou = np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+        dead |= iou > thresh
+    return np.asarray(keep, np.int32)
+
+
+def nms(
+    boxes: np.ndarray, scores: np.ndarray, thresh: float, max_keep: int = 1 << 30
+) -> np.ndarray:
+    """Greedy NMS on host; returns kept indices in descending score order."""
+    boxes = np.ascontiguousarray(boxes, np.float32)
+    scores = np.ascontiguousarray(scores, np.float32)
+    max_keep = int(min(max_keep, len(boxes)))
+    lib = _load_lib()
+    if lib is None:
+        return _nms_numpy(boxes, scores, thresh, max_keep)
+    keep = np.empty((max(max_keep, 1),), np.int32)
+    n = lib.nms_greedy(boxes, scores, len(boxes), thresh, keep, max_keep)
+    return keep[:n]

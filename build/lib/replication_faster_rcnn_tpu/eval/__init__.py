@@ -1,0 +1,3 @@
+from replication_faster_rcnn_tpu.eval.detect import batched_decode, decode_detections  # noqa: F401
+from replication_faster_rcnn_tpu.eval.evaluator import Evaluator  # noqa: F401
+from replication_faster_rcnn_tpu.eval.voc_eval import coco_map, voc_ap  # noqa: F401

@@ -1,0 +1,100 @@
+"""Inference decode — proposals + head outputs -> final detections.
+
+The reference never wrote this path (`test_eval.py` is empty; the combined
+forward is broken — SURVEY.md §3.2), so the decode is designed from the
+Faster R-CNN paper + the reference's training-time conventions:
+
+  * head reg outputs were trained against targets normalized by
+    ``roi_targets.reg_std`` (reference `utils/utils.py:216,271-272`), so
+    predictions are de-normalized with the same std/mean before decoding.
+  * class-specific boxes: class c uses deltas [4c:4c+4] (the gather
+    semantics of reference `train.py:112-117`).
+  * scores are softmax over 21 classes; background (class 0) is dropped.
+  * score threshold, per-class NMS (class-offset trick), top
+    ``max_detections`` kept — all fixed-shape with validity masks.
+
+Everything is jit/vmap-safe; the batch decode is one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from replication_faster_rcnn_tpu.config import EvalConfig, ROITargetConfig
+from replication_faster_rcnn_tpu.ops import boxes as box_ops
+from replication_faster_rcnn_tpu.ops import nms as nms_ops
+
+Array = jnp.ndarray
+
+
+def decode_detections(
+    rois: Array,
+    roi_valid: Array,
+    cls_logits: Array,
+    reg_out: Array,
+    img_h: float,
+    img_w: float,
+    eval_cfg: EvalConfig,
+    roi_cfg: ROITargetConfig,
+) -> Dict[str, Array]:
+    """Per-image decode.
+
+    Args:
+      rois: [R, 4]; roi_valid: [R]; cls_logits: [R, C]; reg_out: [R, C*4].
+
+    Returns dict with 'boxes' [D, 4], 'scores' [D], 'classes' [D] int32,
+    'valid' [D] bool, D = eval_cfg.max_detections.
+    """
+    r = rois.shape[0]
+    c = cls_logits.shape[-1]
+    probs = jax.nn.softmax(cls_logits, axis=-1)  # [R, C]
+
+    # de-normalize all class deltas and decode each class's box
+    mean = jnp.asarray(roi_cfg.reg_mean, jnp.float32)
+    std = jnp.asarray(roi_cfg.reg_std, jnp.float32)
+    deltas = reg_out.reshape(r, c, 4) * std + mean  # [R, C, 4]
+    boxes = box_ops.decode(rois[:, None, :], deltas)  # [R, C, 4]
+    boxes = box_ops.clip(boxes, img_h, img_w)
+
+    # flatten (roi, class>0) pairs; background column dropped by masking
+    flat_boxes = boxes.reshape(r * c, 4)
+    flat_scores = probs.reshape(r * c)
+    class_ids = jnp.tile(jnp.arange(c), (r,))
+    fg = (class_ids > 0) & jnp.repeat(roi_valid, c)
+    fg &= flat_scores >= eval_cfg.score_thresh
+
+    idx, valid = nms_ops.batched_nms_fixed(
+        flat_boxes,
+        flat_scores,
+        class_ids,
+        eval_cfg.nms_thresh,
+        eval_cfg.max_detections,
+        mask=fg,
+    )
+    return {
+        "boxes": flat_boxes[idx] * valid[:, None],
+        "scores": jnp.where(valid, flat_scores[idx], 0.0),
+        "classes": jnp.where(valid, class_ids[idx], 0).astype(jnp.int32),
+        "valid": valid,
+    }
+
+
+def batched_decode(
+    rois: Array,
+    roi_valid: Array,
+    cls_logits: Array,
+    reg_out: Array,
+    img_h: float,
+    img_w: float,
+    eval_cfg: EvalConfig,
+    roi_cfg: ROITargetConfig,
+) -> Dict[str, Array]:
+    """vmap over the batch: rois [N, R, 4] -> dict of [N, D, ...]."""
+    return jax.vmap(
+        lambda r, v, cl, rg: decode_detections(
+            r, v, cl, rg, img_h, img_w, eval_cfg, roi_cfg
+        )
+    )(rois, roi_valid, cls_logits, reg_out)

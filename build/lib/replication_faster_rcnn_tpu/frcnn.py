@@ -1,0 +1,127 @@
+"""``FRCNN`` facade — API parity with the reference's user-facing wrapper
+(`frcnn.py:14-35`): construct by mode, `get_data_loader()`, `get_network()`,
+`load_param()` / `save_param()`.
+
+A reference user's entry points map directly:
+
+    reference                               here
+    ---------                               ----
+    FRCNN('train')                          FRCNN('train')
+    .get_data_loader(root_dir, bs, shuffle) .get_data_loader(root_dir, bs, shuffle)
+    .get_network()                          .get_network() -> (model, variables)
+    .load_param(path) / .save_param(path)   same names (orbax under the hood;
+                                            fixes the reference's save_param,
+                                            which calls a nonexistent
+                                            self.net.save — `frcnn.py:33-35`)
+
+plus `.train(lr, n_epoch, ...)`, mirroring reference `trainer.train`
+(`train.py:130-151`), built on the SPMD Trainer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from replication_faster_rcnn_tpu.config import FasterRCNNConfig, get_config
+
+
+class FRCNN:
+    """Thin convenience wrapper over config + Trainer + model."""
+
+    def __init__(self, mode: str = "train", config: Optional[FasterRCNNConfig] = None):
+        if mode not in ("train", "test"):
+            raise ValueError("mode should be train or test")  # ref frcnn.py:15
+        self.mode = mode
+        self.config = config if config is not None else get_config("voc_resnet18")
+        self._trainer = None
+
+    # -- reference API ------------------------------------------------------
+
+    def get_data_loader(
+        self,
+        root_dir: Optional[str] = None,
+        batch_size: int = 2,
+        shuffle: bool = True,
+    ):
+        """Build the dataset+loader (reference `frcnn.py:19-23`; its default
+        batch_size=2 and VOC root are kept)."""
+        from replication_faster_rcnn_tpu.data import DataLoader, make_dataset
+
+        cfg = self.config
+        if root_dir is not None:
+            cfg = cfg.replace(data=dataclasses.replace(cfg.data, root_dir=root_dir))
+            self.config = cfg
+        split = "train" if self.mode == "train" else "val"
+        dataset = make_dataset(cfg.data, split)
+        return DataLoader(
+            dataset, batch_size=batch_size, shuffle=shuffle,
+            seed=cfg.train.seed,
+        )
+
+    def get_network(self) -> Tuple[object, dict]:
+        """(model, variables) — reference `frcnn.py:25-27` wires
+        backbone+RPN+head; here the assembly is one flax module."""
+        import jax
+
+        from replication_faster_rcnn_tpu.models import faster_rcnn
+
+        model, variables = faster_rcnn.init_variables(
+            self.config, jax.random.PRNGKey(self.config.train.seed)
+        )
+        self.model, self.variables = model, variables
+        return model, variables
+
+    @property
+    def trainer(self):
+        if self._trainer is None:
+            from replication_faster_rcnn_tpu.train import Trainer
+
+            self._trainer = Trainer(self.config)
+        return self._trainer
+
+    def load_param(self, load_path: str) -> None:
+        """Warm-start from a checkpoint dir (reference `frcnn.py:29-31`
+        loads a torch state_dict; torch resnet ``.pth`` files are also
+        accepted and grafted into the backbone). The trainer's save
+        directory is left untouched — loading must not redirect where new
+        checkpoints go."""
+        if load_path.endswith((".pth", ".pt")):
+            self.trainer.load_pretrained_backbone(load_path)
+        else:
+            self.trainer.restore(directory=load_path)
+
+    def save_param(self, save_path: str) -> None:
+        """Save a checkpoint (fixes reference `frcnn.py:33-35`, which calls
+        the nonexistent ``self.net.save``)."""
+        self.trainer.workdir = save_path
+        self.trainer._ckpt_mgr = None
+        self.trainer.save()
+        print(f"parameters saved to {save_path}")  # ref prints too (frcnn.py:35)
+
+    def train(
+        self,
+        lr: Optional[float] = None,
+        n_epoch: Optional[int] = None,
+        save_folder: Optional[str] = None,
+        load_path: Optional[str] = None,
+    ):
+        """Mirror of reference `trainer.train(lr, n_epoch, save_folder,
+        load_path)` (`train.py:130-151`) on the SPMD trainer."""
+        cfg = self.config
+        kw = {}
+        if lr is not None:
+            kw["lr"] = lr
+        if n_epoch is not None:
+            kw["n_epoch"] = n_epoch
+        if kw:
+            cfg = cfg.replace(train=dataclasses.replace(cfg.train, **kw))
+            self.config = cfg
+            self._trainer = None
+        if save_folder is not None:
+            from replication_faster_rcnn_tpu.train import Trainer
+
+            self._trainer = Trainer(cfg, workdir=save_folder)
+        if load_path is not None:
+            self.load_param(load_path)
+        return self.trainer.train()

@@ -1,0 +1,1 @@
+from replication_faster_rcnn_tpu.models import convert, faster_rcnn, head, resnet, rpn  # noqa: F401

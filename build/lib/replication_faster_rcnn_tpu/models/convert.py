@@ -1,0 +1,241 @@
+"""torch -> flax checkpoint conversion for pretrained ResNet backbones.
+
+The reference warm-starts from a torchvision resnet18 ``.pth`` loaded off
+disk (`nets/resnet_torch.py:392-409`, path conventions `readme.md:10-12`)
+and splits it into `features` (conv1..layer3) and `classifier` (layer4 +
+avgpool). This module performs the equivalent one-time conversion into the
+flax parameter trees of :class:`~replication_faster_rcnn_tpu.models.resnet`
+— a pure name/layout mapping, since the flax modules mirror the torch
+module names.
+
+Layout rules:
+  * torch conv weight [O, I, kh, kw]  -> flax kernel [kh, kw, I, O]
+  * torch linear weight [O, I]        -> flax kernel [I, O]
+  * torch BN {weight, bias} -> params {scale, bias};
+    {running_mean, running_var} -> batch_stats {mean, var}
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Tuple
+
+import numpy as np
+
+# torch is an optional dependency (CPU-only in this image); import lazily so
+# the framework itself never requires it.
+
+
+def _to_np(t: Any) -> np.ndarray:
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t)
+
+
+def _conv_kernel(w: Any) -> np.ndarray:
+    return _to_np(w).transpose(2, 3, 1, 0)  # OIHW -> HWIO
+
+
+def _split_state_dict(
+    state: Mapping[str, Any]
+) -> Tuple[Dict[str, Any], Dict[str, Any], Dict[str, Any]]:
+    """Split a torchvision resnet state_dict into (trunk, tail, fc) groups,
+    mirroring the reference's features/classifier split
+    (`nets/resnet_torch.py:399-403`)."""
+    trunk: Dict[str, Any] = {}
+    tail: Dict[str, Any] = {}
+    fc: Dict[str, Any] = {}
+    for k, v in state.items():
+        if k.startswith("fc."):
+            fc[k] = v
+        elif k.startswith("layer4."):
+            tail[k] = v
+        else:
+            trunk[k] = v
+    return trunk, tail, fc
+
+
+def _bn_entries(prefix: str, state: Mapping[str, Any]):
+    params = {
+        "scale": _to_np(state[f"{prefix}.weight"]),
+        "bias": _to_np(state[f"{prefix}.bias"]),
+    }
+    stats = {
+        "mean": _to_np(state[f"{prefix}.running_mean"]),
+        "var": _to_np(state[f"{prefix}.running_var"]),
+    }
+    return params, stats
+
+
+def _convert_block(prefix: str, state: Mapping[str, Any]):
+    """One BasicBlock/Bottleneck: torch `layerL.B.*` -> flax `layerL.B` dict."""
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    i = 1
+    while f"{prefix}.conv{i}.weight" in state:
+        params[f"conv{i}"] = {"kernel": _conv_kernel(state[f"{prefix}.conv{i}.weight"])}
+        p, s = _bn_entries(f"{prefix}.bn{i}", state)
+        params[f"bn{i}"] = p
+        stats[f"bn{i}"] = s
+        i += 1
+    if f"{prefix}.downsample.0.weight" in state:
+        params["downsample_conv"] = {
+            "kernel": _conv_kernel(state[f"{prefix}.downsample.0.weight"])
+        }
+        p, s = _bn_entries(f"{prefix}.downsample.1", state)
+        params["downsample_bn"] = p
+        stats["downsample_bn"] = s
+    return params, stats
+
+
+def _convert_stage(name: str, state: Mapping[str, Any]):
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    b = 0
+    while f"{name}.{b}.conv1.weight" in state:
+        p, s = _convert_block(f"{name}.{b}", state)
+        params[f"{name}.{b}"] = p
+        stats[f"{name}.{b}"] = s
+        b += 1
+    return params, stats
+
+
+def convert_trunk(state: Mapping[str, Any]):
+    """torch state_dict (full resnet) -> (params, batch_stats) for ResNetTrunk."""
+    params: Dict[str, Any] = {"conv1": {"kernel": _conv_kernel(state["conv1.weight"])}}
+    stats: Dict[str, Any] = {}
+    p, s = _bn_entries("bn1", state)
+    params["bn1"] = p
+    stats["bn1"] = s
+    for layer in ("layer1", "layer2", "layer3"):
+        p, s = _convert_stage(layer, state)
+        params.update(p)
+        stats.update(s)
+    return params, stats
+
+
+def convert_tail(state: Mapping[str, Any]):
+    """torch state_dict (full resnet) -> (params, batch_stats) for ResNetTail."""
+    return _convert_stage("layer4", state)
+
+
+# torchvision vgg16 `features` Sequential index -> our conv name
+# (reference documents this net via `reference/train_frcnn.prototxt`)
+_VGG16_FEATURE_IDX = {
+    0: "conv1_1", 2: "conv1_2",
+    5: "conv2_1", 7: "conv2_2",
+    10: "conv3_1", 12: "conv3_2", 14: "conv3_3",
+    17: "conv4_1", 19: "conv4_2", 21: "conv4_3",
+    24: "conv5_1", 26: "conv5_2", 28: "conv5_3",
+}
+
+
+def _fc_kernel_from_chw(w: Any, c: int, h: int, ww: int) -> np.ndarray:
+    """torch Linear weight [O, c*h*w] consuming a CHW-flattened input ->
+    flax kernel [h*w*c, O] consuming our HWC flatten."""
+    wn = _to_np(w)
+    return wn.reshape(-1, c, h, ww).transpose(2, 3, 1, 0).reshape(h * ww * c, -1)
+
+
+def convert_vgg16(state: Mapping[str, Any], roi_size: int = 7):
+    """torchvision vgg16 state_dict -> (trunk_params, tail_params) for
+    VGG16Trunk / VGG16Tail. fc6's kernel is re-laid-out from torch's
+    CHW-flatten to our NHWC-flatten; fc8 (ImageNet logits) is dropped."""
+    trunk = {
+        name: {
+            "kernel": _conv_kernel(state[f"features.{idx}.weight"]),
+            "bias": _to_np(state[f"features.{idx}.bias"]),
+        }
+        for idx, name in _VGG16_FEATURE_IDX.items()
+    }
+    tail = {
+        "fc6": {
+            "kernel": _fc_kernel_from_chw(
+                state["classifier.0.weight"], 512, roi_size, roi_size
+            ),
+            "bias": _to_np(state["classifier.0.bias"]),
+        },
+        "fc7": {
+            "kernel": _to_np(state["classifier.3.weight"]).T,
+            "bias": _to_np(state["classifier.3.bias"]),
+        },
+    }
+    return trunk, tail
+
+
+def _load_state_dict(pth_path: str) -> Mapping[str, Any]:
+    import torch
+
+    return torch.load(pth_path, map_location="cpu", weights_only=True)
+
+
+def load_pretrained_backbone(pth_path: str):
+    """Load a torchvision resnet ``.pth`` and return flax-ready trees:
+    ((trunk_params, trunk_stats), (tail_params, tail_stats)).
+
+    Equivalent of reference ``resnet_backbone`` (`nets/resnet_torch.py:392-409`).
+    """
+    state = _load_state_dict(pth_path)
+    return convert_trunk(state), convert_tail(state)
+
+
+def graft_into_variables(variables: Dict[str, Any], pth_path: str) -> Dict[str, Any]:
+    """Return a copy of FasterRCNN `variables` with the pretrained weights
+    grafted in, preserving the pytree structure (so optimizer state built
+    from the original params stays valid).
+
+    Two layouts exist:
+      * single-scale: conv1..layer3 under `trunk`, layer4 under `head.tail`
+        (the reference's features/classifier split);
+      * FPN: the whole resnet incl. layer4 under `trunk` (ResNetFeatures);
+        the two-fc head has no pretrained counterpart.
+    The layout is detected from the variables themselves.
+    """
+    import jax
+
+    variables = jax.tree_util.tree_map(lambda x: x, variables)  # shallow copy
+    params = dict(variables["params"])
+    stats = dict(variables.get("batch_stats", {}))
+
+    if "conv1_1" in params.get("trunk", {}):  # VGG16 layout (no BN stats)
+        # derive the model's roi_size from its fc6 kernel so a non-7x7
+        # configuration fails fast here instead of as an XLA shape error
+        fc6_rows = params["head"]["tail"]["fc6"]["kernel"].shape[0]
+        roi_size = int(round((fc6_rows // 512) ** 0.5))
+        if roi_size * roi_size * 512 != fc6_rows:
+            raise ValueError(f"unexpected fc6 in-features {fc6_rows}")
+        state = _load_state_dict(pth_path)
+        # validate the CHECKPOINT side before reshaping: a mismatched
+        # roi_size would otherwise fold silently into the output dim
+        ckpt_in = state["classifier.0.weight"].shape[1]
+        if ckpt_in != fc6_rows:
+            raise ValueError(
+                f"pretrained fc6 consumes {ckpt_in} in-features but the "
+                f"model was built with {fc6_rows} (roi_size {roi_size}) — "
+                "torchvision vgg16 checkpoints require roi_size=7"
+            )
+        tp, lp = convert_vgg16(state, roi_size=roi_size)
+        params["trunk"] = {**params["trunk"], **tp}
+        head = dict(params.get("head", {}))
+        head["tail"] = {**head.get("tail", {}), **lp}
+        params["head"] = head
+        out = dict(variables)
+        out["params"] = params
+        return out
+
+    (tp, ts), (lp, ls) = load_pretrained_backbone(pth_path)
+
+    fpn = "layer4.0" in params.get("trunk", {})
+    params["trunk"] = {**params.get("trunk", {}), **tp}
+    stats["trunk"] = {**stats.get("trunk", {}), **ts}
+    if fpn:
+        params["trunk"].update(lp)
+        stats["trunk"].update(ls)
+    else:
+        head = dict(params.get("head", {}))
+        head["tail"] = {**head.get("tail", {}), **lp}
+        params["head"] = head
+        hstats = dict(stats.get("head", {}))
+        hstats["tail"] = {**hstats.get("tail", {}), **ls}
+        stats["head"] = hstats
+    out = dict(variables)
+    out["params"] = params
+    out["batch_stats"] = stats
+    return out

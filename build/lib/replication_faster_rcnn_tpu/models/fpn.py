@@ -1,0 +1,152 @@
+"""Feature Pyramid Network — BASELINE.json config #3 ("FPN neck over
+ResNet50 + multi-scale anchors").
+
+No reference implementation exists (the reference is single-scale C4;
+its `utils/anchors.py` multi-scale anchors are scale-multiples at one
+stride). This follows the FPN paper (Lin et al., arXiv:1612.03144) with the
+standard Faster-R-CNN-FPN wiring, built fixed-shape for XLA:
+
+  * backbone exposes C2..C5 (strides 4/8/16/32);
+  * 1x1 lateral convs + nearest top-down upsample + 3x3 smoothing -> P2..P5,
+    plus P6 = stride-2 subsample of P5 (RPN-only level);
+  * the RPN head is ONE set of convs shared across levels;
+  * anchors use one scale per level (AnchorConfig.scales=(8,)) over
+    per-level strides (4, 8, 16, 32, 64);
+  * ROIs are assigned to levels by the paper's k = k0 + log2(sqrt(area)/224)
+    rule. On TPU the per-level gather is computed for ALL rois on every
+    level and blended by a one-hot level mask — 4x the (cheap) ROIAlign
+    gathers in exchange for fully static shapes, no sorting/regrouping.
+
+All spatial tensors are NHWC; levels are a list ordered fine -> coarse.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from replication_faster_rcnn_tpu.models.resnet import _WIDTHS, _conv, _norm, _spec, _stage
+from replication_faster_rcnn_tpu.ops import roi_ops
+
+Array = jnp.ndarray
+
+FPN_STRIDES: Tuple[int, ...] = (4, 8, 16, 32, 64)  # P2..P6
+
+
+class ResNetFeatures(nn.Module):
+    """ResNet trunk exposing every stage: [C2, C3, C4, C5]
+    (strides 4/8/16/32; channels x1 for BasicBlock, x4 for Bottleneck).
+
+    Same parameter naming/layout as ResNetTrunk+ResNetTail so pretrained
+    torch checkpoints convert identically (layer4 lives here, not in the
+    head, when FPN is on)."""
+
+    arch: str = "resnet50"
+    dtype: Any = jnp.bfloat16
+    bn_axis: Any = None
+    remat: bool = False  # jax.checkpoint each residual block
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> List[Array]:
+        depths = _spec(self.arch)[1]
+        ax, rm = self.bn_axis, self.remat
+        x = x.astype(self.dtype)
+        x = _conv(64, 7, 2, 3, self.dtype, "conv1")(x)
+        x = _norm(self.dtype, train, "bn1", ax)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        c2 = _stage(self.arch, x, _WIDTHS[0], depths[0], 1, self.dtype, train, "layer1", ax, rm)
+        c3 = _stage(self.arch, c2, _WIDTHS[1], depths[1], 2, self.dtype, train, "layer2", ax, rm)
+        c4 = _stage(self.arch, c3, _WIDTHS[2], depths[2], 2, self.dtype, train, "layer3", ax, rm)
+        c5 = _stage(self.arch, c4, _WIDTHS[3], depths[3], 2, self.dtype, train, "layer4", ax, rm)
+        return [c2, c3, c4, c5]
+
+
+def _upsample_nearest(x: Array, target_hw: Tuple[int, int]) -> Array:
+    """2x nearest upsample cropped to the (possibly odd) finer shape."""
+    n, h, w, c = x.shape
+    y = jnp.repeat(jnp.repeat(x, 2, axis=1), 2, axis=2)
+    return y[:, : target_hw[0], : target_hw[1], :]
+
+
+class FPNNeck(nn.Module):
+    """[C2..C5] -> [P2..P6], all ``channels`` wide."""
+
+    channels: int = 256
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, feats: Sequence[Array]) -> List[Array]:
+        c2, c3, c4, c5 = feats
+        laterals = [
+            _conv(self.channels, 1, 1, 0, self.dtype, f"lateral{i}")(c)
+            for i, c in enumerate((c2, c3, c4, c5))
+        ]
+        # top-down pathway
+        tds = [laterals[3]]
+        for i in (2, 1, 0):
+            finer = laterals[i]
+            tds.insert(
+                0, finer + _upsample_nearest(tds[0], finer.shape[1:3])
+            )
+        outs = [
+            _conv(self.channels, 3, 1, 1, self.dtype, f"smooth{i}")(t)
+            for i, t in enumerate(tds)
+        ]
+        # P6: stride-2 subsample of P5 (maxpool k=1 s=2, Detectron convention)
+        p6 = outs[3][:, ::2, ::2, :]
+        return outs + [p6]
+
+
+def roi_levels(rois: Array, k0: int = 4, canonical: float = 224.0) -> Array:
+    """FPN paper level assignment: [..., 4] rois -> int level index 0..3
+    (P2..P5; P6 is RPN-only). k = k0 + log2(sqrt(area)/canonical)."""
+    h = jnp.maximum(rois[..., 2] - rois[..., 0], 1e-6)
+    w = jnp.maximum(rois[..., 3] - rois[..., 1], 1e-6)
+    k = jnp.floor(k0 + jnp.log2(jnp.sqrt(h * w) / canonical))
+    return jnp.clip(k, 2, 5).astype(jnp.int32) - 2
+
+
+def multilevel_roi_align(
+    feats: Sequence[Array],
+    rois: Array,
+    img_h: float,
+    img_w: float,
+    out_size: int = 7,
+    sampling_ratio: int = 2,
+) -> Array:
+    """ROIAlign across P2..P5 with level assignment, fixed-shape.
+
+    feats: 4 arrays [N, Hl, Wl, C]; rois: [N, R, 4] image coords.
+    Returns [N, R, out, out, C]. Every roi is aligned on every level and the
+    results blended with a one-hot mask — static shapes, no partitioning.
+
+    Uses the gather roi_align method: the einsum (MXU) formulation's dense
+    [R, P, H] weight matmul is a win on the stride-16 single-scale map but
+    scales with H*W, which at P2 (stride 4, e.g. 150x150 for 600 input)
+    costs ~10x the whole backbone — random gathers are the right tool on
+    the fine levels.
+    """
+    levels = roi_levels(rois)  # [N, R]
+    out = None
+    for li, feat in enumerate(feats[:4]):
+        scale_r = feat.shape[1] / img_h
+        scale_c = feat.shape[2] / img_w
+        scale = jnp.asarray([scale_r, scale_c, scale_r, scale_c], rois.dtype)
+
+        def align_one(f: Array, rb: Array) -> Array:
+            return roi_ops.roi_align(
+                f,
+                rb * scale,
+                out_size=out_size,
+                sampling_ratio=sampling_ratio,
+                method="gather",
+            )
+
+        crops = jax.vmap(align_one)(feat, rois)  # [N, R, s, s, C]
+        mask = (levels == li).astype(crops.dtype)[..., None, None, None]
+        out = crops * mask if out is None else out + crops * mask
+    return out

@@ -1,0 +1,107 @@
+"""VGG16 backbone — the original py-faster-rcnn architecture that the
+reference documents via its checked-in Caffe prototxt
+(`reference/train_frcnn.prototxt:1-641`: conv1_1..conv5_3 shared features,
+RoIPool 7x7 at spatial_scale 1/16, fc6/fc7 4096 head; SURVEY.md §2.1 #16).
+The reference never executes it — the prototxt is documentation — so this
+is built from the published architecture, TPU-first (NHWC, bfloat16
+compute, float32 params).
+
+Split mirrors the framework's trunk/tail convention:
+  * ``VGG16Trunk``: conv1_1..conv5_3 with 2x2/s2 max pools after blocks
+    1-4 only (pool5 is dropped, as in py-faster-rcnn) -> stride-16,
+    512-channel feature map. Pools use ceil semantics (Caffe's default
+    rounding, and what keeps 600 -> 38 matching the ResNet trunks and
+    ``FasterRCNNConfig.feature_size``).
+  * ``VGG16Tail``: flatten the pooled 7x7x512 ROI crop -> fc6 -> relu ->
+    dropout -> fc7 -> relu -> dropout -> 4096-d embedding (the prototxt's
+    classifier head; dropout p=0.5 active in train mode).
+
+Parameter names (conv1_1, ..., fc7) map 1:1 onto torchvision's vgg16
+state_dict via the index table in `models/convert.py::convert_vgg16`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+# (block, convs-in-block, channels) — VGG configuration "D" (16 layers)
+VGG16_BLOCKS = ((1, 2, 64), (2, 2, 128), (3, 3, 256), (4, 3, 512), (5, 3, 512))
+
+VGG16_TRUNK_CHANNELS = 512
+VGG16_TAIL_CHANNELS = 4096
+
+
+def _ceil_max_pool(x: Array) -> Array:
+    """2x2/s2 max pool with Caffe's ceil rounding: odd extents are padded
+    (with -inf, via flax's reduce_window init) so 75 -> 38, matching the
+    ResNet trunks' ceil-halving and ``FasterRCNNConfig.feature_size``."""
+    ph, pw = x.shape[1] % 2, x.shape[2] % 2
+    return nn.max_pool(x, (2, 2), strides=(2, 2), padding=((0, ph), (0, pw)))
+
+
+class VGG16Trunk(nn.Module):
+    """conv1_1..conv5_3 -> [N, ceil(H/16), ceil(W/16), 512].
+
+    ``remat`` applies jax.checkpoint per conv block (conv{b}_1..conv{b}_n):
+    backward recomputes the block's activations instead of keeping them in
+    HBM. Wrapping the bound method keeps the parameter names (conv1_1, ...)
+    at trunk scope, so checkpoints/conversion are unaffected.
+    """
+
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    def _block(self, x: Array, block: int, n_convs: int, ch: int) -> Array:
+        for i in range(1, n_convs + 1):
+            x = nn.Conv(
+                ch,
+                (3, 3),
+                padding=((1, 1), (1, 1)),
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                name=f"conv{block}_{i}",
+            )(x)
+            x = nn.relu(x)
+        return x
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Array:
+        run = (
+            nn.remat(VGG16Trunk._block, static_argnums=(2, 3, 4))
+            if self.remat
+            else VGG16Trunk._block
+        )
+        x = x.astype(self.dtype)
+        for block, n_convs, ch in VGG16_BLOCKS:
+            if block > 1:
+                x = _ceil_max_pool(x)
+            x = run(self, x, block, n_convs, ch)
+        return x
+
+
+class VGG16Tail(nn.Module):
+    """Pooled ROI crop [R, s, s, 512] -> fc6/fc7 -> [R, 4096] embedding.
+
+    The two 25088x4096 / 4096x4096 matmuls run in compute dtype on the MXU
+    (param_dtype f32). Dropout (p=0.5, prototxt `train_frcnn.prototxt`
+    drop6/drop7) is active only in train mode and needs a 'dropout' rng.
+    """
+
+    dtype: Any = jnp.bfloat16
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Array:
+        x = x.reshape(x.shape[0], -1).astype(self.dtype)
+        for name in ("fc6", "fc7"):
+            x = nn.Dense(
+                VGG16_TAIL_CHANNELS, dtype=self.dtype, param_dtype=jnp.float32, name=name
+            )(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,7 @@
+from replication_faster_rcnn_tpu.ops import (  # noqa: F401
+    anchors,
+    boxes,
+    nms,
+    nms_tiled,
+    roi_ops,
+)

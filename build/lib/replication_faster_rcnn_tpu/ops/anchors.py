@@ -1,0 +1,63 @@
+"""Anchor generation — static-shape jnp, computed once per (image_size, cfg).
+
+Reference: `utils/anchors.py:5-61`. Base anchors are K = len(ratios) *
+len(scales) boxes centered at the origin with ``h = base * scale * sqrt(r)``,
+``w = base * scale / sqrt(r)``; the grid places them at every feat_stride
+step over the feature map, flattened position-major with the K base anchors
+contiguous per cell (matching how the RPN heads reshape their conv output,
+reference `nets/rpn.py:118-124`).
+
+Deliberate fix vs the reference: `utils/anchors.py:46-52` pairs conv cell
+(row, col) with an anchor centered at the *transposed* image location
+(its meshgrid "x" runs along columns but lands in the row coordinate of the
+row-major box). That only appears to work because images are square. Here
+cell (r, c) is centered at image (r * stride, c * stride).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from replication_faster_rcnn_tpu.config import AnchorConfig
+
+
+def anchor_base(
+    base_size: int = 16,
+    ratios: Sequence[float] = (0.5, 1.0, 2.0),
+    scales: Sequence[float] = (8.0, 16.0, 32.0),
+) -> np.ndarray:
+    """[K, 4] origin-centered base anchors, ratio-major (reference
+    `utils/anchors.py:17-31` ordering: index = r_ind * len(scales) + s_ind)."""
+    ratios = np.asarray(ratios, np.float32)
+    scales = np.asarray(scales, np.float32)
+    h = base_size * scales[None, :] * np.sqrt(ratios)[:, None]  # [R, S]
+    w = base_size * scales[None, :] * np.sqrt(1.0 / ratios)[:, None]
+    h = h.reshape(-1)
+    w = w.reshape(-1)
+    return np.stack([-h / 2, -w / 2, h / 2, w / 2], axis=1).astype(np.float32)
+
+
+def grid_anchors(
+    base: np.ndarray, feat_stride: int, feat_h: int, feat_w: int
+) -> np.ndarray:
+    """[feat_h * feat_w * K, 4] anchors over the feature grid.
+
+    Flat index = (r * feat_w + c) * K + k, so it aligns with an RPN head
+    output reshaped from [H, W, K*d] to [H*W*K, d].
+    """
+    rr = np.arange(feat_h, dtype=np.float32) * feat_stride
+    cc = np.arange(feat_w, dtype=np.float32) * feat_stride
+    shift_r, shift_c = np.meshgrid(rr, cc, indexing="ij")
+    shifts = np.stack(
+        [shift_r.ravel(), shift_c.ravel(), shift_r.ravel(), shift_c.ravel()], axis=1
+    )  # [HW, 4]
+    all_anchors = shifts[:, None, :] + base[None, :, :]  # [HW, K, 4]
+    return all_anchors.reshape(-1, 4).astype(np.float32)
+
+
+def make_anchors(cfg: AnchorConfig, feat_size: Tuple[int, int]) -> np.ndarray:
+    """All anchors for a feature map of size ``feat_size`` under ``cfg``."""
+    base = anchor_base(cfg.base_size, cfg.ratios, cfg.scales)
+    return grid_anchors(base, cfg.feat_stride, feat_size[0], feat_size[1])

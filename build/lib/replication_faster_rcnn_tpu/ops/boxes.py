@@ -1,0 +1,102 @@
+"""Box geometry primitives — pure jnp, fixed-shape, vmap/jit-ready.
+
+Convention (identical to the reference's, SURVEY.md preamble): boxes are
+``[r1, c1, r2, c2]`` with ``r`` along image rows (height), ``c`` along
+columns (width); deltas are ``[dr, dc, dh, dw]`` where ``h`` is the row
+extent and ``w`` the column extent. The reference calls rows "x"
+(`nets/faster_rcnn.py:10`); we use row/col naming to avoid that ambiguity.
+
+Semantics match reference `utils/utils.py`:
+  * :func:`decode`  == ``reg2bbox``  (`utils/utils.py:47-73`)
+  * :func:`encode`  == ``bbox2reg``  (`utils/utils.py:75-100`)
+  * :func:`iou`     == ``bbox_iou``  (`utils/utils.py:102-119`)
+with two deliberate deviations: all functions are defined for batched/
+broadcast shapes, and :func:`iou` divides safely (0 where the union is
+empty) instead of emitting NaN for degenerate boxes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+# Clamp for log-space size deltas before exp(): exp(12) ~ 1.6e5 px, far beyond
+# any valid box, but finite — keeps decode/gradients NaN-free early in training
+# when the regression head emits garbage.
+_MAX_DLOG = 12.0
+
+
+def centers_sizes(b: Array) -> tuple[Array, Array, Array, Array]:
+    """Return (center_r, center_c, h, w) for boxes [..., 4]."""
+    h = b[..., 2] - b[..., 0]
+    w = b[..., 3] - b[..., 1]
+    cr = (b[..., 0] + b[..., 2]) * 0.5
+    cc = (b[..., 1] + b[..., 3]) * 0.5
+    return cr, cc, h, w
+
+
+def decode(anchors: Array, deltas: Array) -> Array:
+    """Deltas -> boxes (reference ``reg2bbox``, `utils/utils.py:47-73`).
+
+    anchors: [..., 4] boxes; deltas: [..., 4] ``[dr, dc, dh, dw]``.
+    ``r = dr * h_a + cr_a``; ``h = exp(dh) * h_a`` (likewise for c/w).
+    """
+    cr, cc, h, w = centers_sizes(anchors)
+    r = deltas[..., 0] * h + cr
+    c = deltas[..., 1] * w + cc
+    nh = jnp.exp(jnp.clip(deltas[..., 2], max=_MAX_DLOG)) * h
+    nw = jnp.exp(jnp.clip(deltas[..., 3], max=_MAX_DLOG)) * w
+    return jnp.stack(
+        [r - nh * 0.5, c - nw * 0.5, r + nh * 0.5, c + nw * 0.5], axis=-1
+    )
+
+
+def encode(anchors: Array, boxes: Array, eps: float = 1e-8) -> Array:
+    """Boxes -> deltas (reference ``bbox2reg``, `utils/utils.py:75-100`).
+
+    ``dr = (cr_b - cr_a) / h_a``; ``dh = log(h_b / h_a)``. The reference's
+    numpy version emits -inf/NaN for degenerate boxes; we clamp sizes to
+    ``eps`` so padded (invalid) entries stay finite — callers mask them.
+    """
+    acr, acc, ah, aw = centers_sizes(anchors)
+    bcr, bcc, bh, bw = centers_sizes(boxes)
+    ah = jnp.maximum(ah, eps)
+    aw = jnp.maximum(aw, eps)
+    return jnp.stack(
+        [
+            (bcr - acr) / ah,
+            (bcc - acc) / aw,
+            jnp.log(jnp.maximum(bh, eps) / ah),
+            jnp.log(jnp.maximum(bw, eps) / aw),
+        ],
+        axis=-1,
+    )
+
+
+def area(b: Array) -> Array:
+    """Signed area product, as the reference computes it (`utils/utils.py:117-118`)."""
+    return (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1])
+
+
+def iou(a: Array, b: Array) -> Array:
+    """Pairwise IoU: a [..., Na, 4], b [..., Nb, 4] -> [..., Na, Nb].
+
+    Matches reference ``bbox_iou`` (`utils/utils.py:102-119`): intersection
+    counts only when top-left < bottom-right on both axes. Division is safe
+    (0 where the union is <= 0) rather than NaN.
+    """
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = br - tl
+    valid = jnp.all(wh > 0, axis=-1)
+    inter = jnp.where(valid, wh[..., 0] * wh[..., 1], 0.0)
+    union = area(a)[..., :, None] + area(b)[..., None, :] - inter
+    return jnp.where(union > 0, inter / jnp.where(union > 0, union, 1.0), 0.0)
+
+
+def clip(b: Array, img_h: float, img_w: float) -> Array:
+    """Clamp boxes to the image (reference `nets/rpn.py:62-63`)."""
+    r = jnp.clip(b[..., 0::2], 0.0, img_h)
+    c = jnp.clip(b[..., 1::2], 0.0, img_w)
+    return jnp.stack([r[..., 0], c[..., 0], r[..., 1], c[..., 1]], axis=-1)

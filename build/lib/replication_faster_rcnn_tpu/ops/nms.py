@@ -1,0 +1,103 @@
+"""Fixed-shape greedy NMS — the TPU-native replacement for
+``torchvision.ops.nms`` (reference `nets/rpn.py:75`; SURVEY.md §2.3).
+
+The reference's NMS returns a data-dependent number of boxes, which cannot
+live inside a jit-compiled graph. Here NMS is a `lax.fori_loop` with exactly
+``max_out`` iterations: each iteration selects the highest-scoring surviving
+candidate and suppresses everything with IoU above the threshold against it.
+The result is the same set, in the same score order, as sort-then-greedy NMS,
+but as padded ``[max_out]`` indices plus a validity mask — a fixed shape XLA
+can compile once and the batch dimension can vmap over.
+
+Cost: ``max_out`` sequential steps of O(N) vector work. At the reference's
+budgets (600 selections over <=12k candidates) this is latency- not
+FLOP-bound; a Pallas kernel is the optimization path if profiling shows it
+dominating (it does not — the conv stacks do).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from replication_faster_rcnn_tpu.ops import boxes as box_ops
+
+Array = jnp.ndarray
+
+_NEG = -jnp.inf
+
+
+@partial(jax.jit, static_argnames=("max_out",))
+def nms_fixed(
+    boxes: Array,
+    scores: Array,
+    iou_thresh: float,
+    max_out: int,
+    mask: Array | None = None,
+) -> tuple[Array, Array]:
+    """Greedy NMS with a fixed output size.
+
+    Args:
+      boxes: [N, 4] candidate boxes ([r1, c1, r2, c2]).
+      scores: [N] scores; higher is better.
+      iou_thresh: suppress candidates with IoU strictly greater than this
+        against a kept box (torchvision semantics).
+      max_out: number of output slots (e.g. post_nms budget).
+      mask: optional [N] bool; False entries are never selected.
+
+    Returns:
+      (idx, valid): [max_out] int32 indices into ``boxes`` in descending
+      score order, and a [max_out] bool mask of which slots hold real
+      selections. Invalid slots point at index 0.
+    """
+    n = boxes.shape[0]
+    live_scores = scores.astype(jnp.float32)
+    # Non-finite scores (NaN from a diverging score head) must never win
+    # argmax — a NaN selection would mark the slot invalid without
+    # suppressing anything, stalling every remaining iteration.
+    live_scores = jnp.where(jnp.isfinite(live_scores), live_scores, _NEG)
+    if mask is not None:
+        live_scores = jnp.where(mask, live_scores, _NEG)
+
+    def body(i, state):
+        live, idx, valid = state
+        best = jnp.argmax(live)
+        best_score = live[best]
+        is_valid = best_score > _NEG
+        idx = idx.at[i].set(jnp.where(is_valid, best, 0).astype(jnp.int32))
+        valid = valid.at[i].set(is_valid)
+        ious = box_ops.iou(boxes[best][None, :], boxes)[0]  # [N]
+        # The selected box suppresses itself (IoU 1) and all overlaps.
+        suppress = (ious > iou_thresh) | (jnp.arange(n) == best)
+        live = jnp.where(is_valid & suppress, _NEG, live)
+        return live, idx, valid
+
+    idx0 = jnp.zeros((max_out,), jnp.int32)
+    valid0 = jnp.zeros((max_out,), bool)
+    _, idx, valid = jax.lax.fori_loop(0, max_out, body, (live_scores, idx0, valid0))
+    return idx, valid
+
+
+def batched_nms_fixed(
+    boxes: Array,
+    scores: Array,
+    class_ids: Array,
+    iou_thresh: float,
+    max_out: int,
+    mask: Array | None = None,
+) -> tuple[Array, Array]:
+    """Per-class NMS in one pass (for inference postprocessing).
+
+    Boxes of different classes never suppress each other: each class's boxes
+    are shifted into a disjoint coordinate region (the standard trick), then
+    a single fixed-shape NMS runs over all of them (backend chosen by
+    `nms_pallas.nms_fixed_auto` — same dispatch as the proposal path).
+    """
+    from replication_faster_rcnn_tpu.ops.nms_pallas import nms_fixed_auto
+
+    extent = jnp.max(boxes) + 1.0
+    offsets = class_ids.astype(boxes.dtype)[:, None] * extent
+    shifted = boxes + offsets
+    return nms_fixed_auto(shifted, scores, iou_thresh, max_out, mask=mask)

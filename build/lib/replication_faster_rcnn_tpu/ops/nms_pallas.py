@@ -1,0 +1,207 @@
+"""Pallas TPU kernel for fixed-size greedy NMS.
+
+Why a kernel: the XLA version (`ops/nms.py`) is a ``lax.fori_loop`` whose
+``max_out`` iterations each dispatch a handful of small HBM-bound vector ops
+— at the training budgets (600 selections over 12k candidates) that serial
+overhead is ~35% of the whole train step (measured; see git history). This
+kernel keeps scores and box planes resident in VMEM and runs the entire
+greedy loop in-core on the VPU: per iteration it is ~6 vector passes over an
+[R, 128] tile set with no HBM traffic and no dispatch.
+
+Kernel-level design choices:
+  * candidates are laid out as lane-major planes: scores [R, 128] and
+    coordinates [4R, 128] (rows 0..R-1 = r1 plane, R..2R-1 = c1, ...), with
+    flat candidate index = row * 128 + lane;
+  * the argmax winner is extracted with a first-occurrence one-hot
+    (min over index-where-max) and masked sums — no dynamic gathers;
+  * the IoU-vs-threshold test is division-free:
+    ``inter > t * union  <=>  iou > t`` since union > 0 wherever inter > 0;
+  * selected indices/validity are scalar-stored into SMEM outputs.
+
+Semantics are identical to ``nms.nms_fixed`` (same selection set, same
+order, same tie-breaking on the lowest index) — parity-tested in
+tests/test_nms_pallas.py, in interpret mode on CPU and compiled on TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jnp.ndarray
+
+_LANES = 128
+_NEG = -1e30  # well below any real score; avoids inf arithmetic in-kernel
+
+
+def _nms_kernel(score_ref, coords_ref, sel_ref, live_ref, *, max_out, iou_thresh):
+    """Writes sel_ref [R, 128] i32: the greedy selection round (0-based) of
+    each candidate, or R*128 where never selected. The wrapper recovers the
+    ordered index list with one argsort — SMEM scalar outputs would break
+    vmap's batching rules, a VMEM plane doesn't."""
+    r = score_ref.shape[0]
+    live_ref[:] = score_ref[:]
+    r1 = coords_ref[0:r, :]
+    c1 = coords_ref[r : 2 * r, :]
+    r2 = coords_ref[2 * r : 3 * r, :]
+    c2 = coords_ref[3 * r : 4 * r, :]
+    area = (r2 - r1) * (c2 - c1)
+    flat = (
+        jax.lax.broadcasted_iota(jnp.int32, (r, _LANES), 0) * _LANES
+        + jax.lax.broadcasted_iota(jnp.int32, (r, _LANES), 1)
+    )
+    big = jnp.int32(r * _LANES)
+    sel_ref[:] = jnp.full((r, _LANES), big, jnp.int32)
+
+    def body(i, _):
+        live = live_ref[:]
+        m = jnp.max(live)
+        is_valid = m > jnp.float32(_NEG / 2)
+        # first occurrence of the max -> one-hot (ties: lowest flat index,
+        # matching jnp.argmax in the XLA version)
+        best_flat = jnp.min(jnp.where(live == m, flat, big))
+        one_hot = flat == best_flat
+        # winner's box via masked reductions (no dynamic indexing)
+        br1 = jnp.sum(jnp.where(one_hot, r1, 0.0))
+        bc1 = jnp.sum(jnp.where(one_hot, c1, 0.0))
+        br2 = jnp.sum(jnp.where(one_hot, r2, 0.0))
+        bc2 = jnp.sum(jnp.where(one_hot, c2, 0.0))
+        barea = (br2 - br1) * (bc2 - bc1)
+        # intersection with every candidate
+        ih = jnp.minimum(br2, r2) - jnp.maximum(br1, r1)
+        iw = jnp.minimum(bc2, c2) - jnp.maximum(bc1, c1)
+        pos = (ih > 0.0) & (iw > 0.0)
+        inter = jnp.where(pos, ih * iw, 0.0)
+        union = barea + area - inter
+        # iou > t  <=>  inter > t * union (union > 0 wherever inter > 0)
+        suppress = (inter > iou_thresh * union) | one_hot
+        keep = jnp.logical_and(is_valid, one_hot)
+        sel_ref[:] = jnp.where(keep, i, sel_ref[:])
+        live_ref[:] = jnp.where(jnp.logical_and(is_valid, suppress), _NEG, live)
+        return 0
+
+    jax.lax.fori_loop(0, max_out, body, 0)
+
+
+@partial(jax.jit, static_argnames=("iou_thresh", "max_out", "interpret"))
+def nms_fixed_pallas(
+    boxes: Array,
+    scores: Array,
+    iou_thresh: float,
+    max_out: int,
+    mask: Array | None = None,
+    interpret: bool = False,
+) -> tuple[Array, Array]:
+    """Drop-in replacement for :func:`ops.nms.nms_fixed` backed by the
+    Pallas kernel. Same contract: ([max_out] int32 indices in selection
+    order, [max_out] bool validity)."""
+    n = boxes.shape[0]
+    r = max(-(-n // _LANES), 1)
+    n_pad = r * _LANES
+
+    s = scores.astype(jnp.float32)
+    s = jnp.where(jnp.isfinite(s), s, _NEG)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG)
+    s = jnp.pad(s, (0, n_pad - n), constant_values=_NEG)
+    b = jnp.pad(boxes.astype(jnp.float32), ((0, n_pad - n), (0, 0)))
+
+    score_planes = s.reshape(r, _LANES)
+    # [4, n_pad] -> [4r, 128]: each coordinate's n_pad values reshape to an
+    # [r, 128] plane, stacked coordinate-major
+    coord_planes = b.T.reshape(4 * r, _LANES)
+
+    sel = pl.pallas_call(
+        partial(_nms_kernel, max_out=max_out, iou_thresh=float(iou_thresh)),
+        out_shape=jax.ShapeDtypeStruct((r, _LANES), jnp.int32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((r, _LANES), jnp.float32)],
+        interpret=interpret,
+    )(score_planes, coord_planes)
+
+    # selection rounds are unique, so ascending argsort puts round i at
+    # position i; unselected candidates (sentinel n_pad) sort after them
+    flat_sel = sel.reshape(-1)
+    order = jnp.argsort(flat_sel)
+    take = min(max_out, n_pad)
+    idx = order[:take].astype(jnp.int32)
+    valid = flat_sel[idx] < n_pad
+    if take < max_out:  # fewer candidates than output slots: pad
+        idx = jnp.pad(idx, (0, max_out - take))
+        valid = jnp.pad(valid, (0, max_out - take))
+    return jnp.where(valid, idx, 0), valid
+
+
+def nms_fixed_auto(
+    boxes: Array,
+    scores: Array,
+    iou_thresh: float,
+    max_out: int,
+    mask: Array | None = None,
+) -> tuple[Array, Array]:
+    """Backend dispatch for the proposal path.
+
+    Default on every backend (TPU included): the tiled exact algorithm
+    (`ops/nms_tiled.py`; ~25-75 sequential matrix steps instead of one per
+    selection). It is bit-identical to the selection loop (parity-tested in
+    tests/test_nms_tiled.py), 10.8x the loop on CPU at the 12k->600 training
+    budget (benchmarks/nms_backends.py), and — unlike the Pallas kernel —
+    plain XLA ops, so it carries none of the remote-compile risk that keeps
+    Pallas opt-in. The loop's ~600 serial dispatches were measured at ~35%
+    of the whole train step on v5e in round 1, which is why the loop is no
+    longer any backend's default; validated in-step on v5e (round 2): the
+    b8 600x600 train step went 124 -> 180-186 images/sec across runs with
+    this default (proposal NMS 3.7 ms of a 42.9 ms step), and b16 went
+    96 -> 210 (benchmarks/bench_v5e_round2.json).
+
+    Overrides via FRCNN_NMS (explicit choice always wins; the legacy
+    FRCNN_PALLAS_NMS=1 is honored only when FRCNN_NMS is unset):
+
+      * ``FRCNN_NMS=loop`` — the `ops/nms.py` selection loop, any backend.
+      * ``FRCNN_NMS=tiled`` — the tiled algorithm, any backend.
+      * ``FRCNN_NMS=pallas`` — the in-VMEM Pallas kernel, TPU only.
+        Standalone it measures 3.2x the XLA loop (9.4ms vs 30.2ms for a
+        batch-8 12k->600 NMS on v5e), but this image's remote-compile TPU
+        service has been observed to wedge when the kernel is compiled
+        INSIDE the full train-step module, taking the whole chip tunnel
+        down with it — hence opt-in.
+    """
+    import os
+
+    from replication_faster_rcnn_tpu.ops import nms as nms_xla
+
+    choice = os.environ.get("FRCNN_NMS", "") or (
+        "pallas" if os.environ.get("FRCNN_PALLAS_NMS") == "1" else ""
+    )
+    if choice == "pallas":
+        if jax.default_backend() == "tpu":
+            return nms_fixed_pallas(boxes, scores, iou_thresh, max_out, mask=mask)
+        import warnings
+
+        warnings.warn(
+            "the Pallas NMS kernel needs a TPU backend; using the tiled default"
+        )
+        choice = "tiled"
+    elif choice not in ("", "loop", "tiled"):
+        import warnings
+
+        warnings.warn(
+            f"unknown FRCNN_NMS={choice!r} (choices: loop, tiled, pallas); "
+            "using the backend default"
+        )
+        choice = ""
+    if not choice:
+        choice = "tiled"
+    if choice == "tiled":
+        from replication_faster_rcnn_tpu.ops.nms_tiled import nms_fixed_tiled
+
+        return nms_fixed_tiled(boxes, scores, iou_thresh, max_out, mask=mask)
+    return nms_xla.nms_fixed(boxes, scores, iou_thresh, max_out, mask=mask)

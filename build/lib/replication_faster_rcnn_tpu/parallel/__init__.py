@@ -1,0 +1,16 @@
+from replication_faster_rcnn_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    fit_data_parallelism,
+    gather_replicated,
+    image_sharding,
+    initialize_distributed,
+    make_mesh,
+    replicate_tree,
+    replicated,
+    shard_batch,
+    validate_parallel,
+    validate_spatial,
+)
+from replication_faster_rcnn_tpu.parallel.spmd import (  # noqa: F401
+    make_shard_map_train_step,
+)

@@ -1,0 +1,113 @@
+"""Explicit-collective SPMD train step — the hand-written counterpart of
+the jit auto-partitioned step in `train/train_step.py`.
+
+The reference has no distributed training at all (SURVEY.md §2.4); the
+framework's default path gets data parallelism "for free" from jit
+auto-partitioning (annotate shardings, XLA inserts the collectives). This
+module is the same training step with every collective PLACED BY HAND via
+``jax.shard_map`` — the moral equivalent of writing the DDP/NCCL-allreduce
+loop yourself, in XLA collectives:
+
+  * each shard runs forward/backward on its local batch slice;
+  * loss normalizers (`#positives`, `#valid labels`) are `lax.psum`'d
+    across the ``data`` axis before dividing (train/losses.py
+    ``axis_name``), so the objective is the batch-global one;
+  * BatchNorm runs in cross-replica (sync) mode — flax's ``axis_name``
+    pmean — matching what auto-partitioning computes on a global batch;
+  * per-image sampling keys fold in the GLOBAL batch position
+    (``lax.axis_index`` offset), so target subsampling draws the same
+    randomness as the auto-partitioned step;
+  * gradients are `lax.psum`'d, then every shard applies the identical
+    optimizer update to its replicated state.
+
+Because of the four properties above, this step computes the same update
+as the jit auto-partitioned step up to floating-point reduction order —
+asserted by `tests/test_parallel.py`. One documented exception: dropout
+(VGG16's fc6/fc7). The jit path draws one mask over the global crop batch;
+here each shard draws its own mask (rng_do folds in ``lax.axis_index`` so
+shards are decorrelated — statistically equivalent, not bitwise). It
+exists (a) as an independent check on the auto path, (b) as the place
+where collective placement is explicit and profilable, and (c) as the
+template for adding shardings XLA cannot infer (e.g. tensor-parallel heads
+over the mesh's ``model`` axis).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from replication_faster_rcnn_tpu.config import FasterRCNNConfig
+from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
+from replication_faster_rcnn_tpu.train.train_step import TrainState, compute_losses
+
+Array = jnp.ndarray
+
+
+def make_shard_map_train_step(
+    config: FasterRCNNConfig, tx: optax.GradientTransformation, mesh: Mesh
+):
+    """Build the explicitly-collectivized (state, batch) -> (state, metrics)
+    step. State must be replicated on ``mesh``; batch arrays sharded on
+    their leading dim over the data axis (`parallel.shard_batch`).
+
+    Returns (step_fn, model): the model is constructed with sync-BN bound
+    to the data axis; its parameter tree is identical to the default
+    model's, so states are interchangeable between the two backends.
+    """
+    axis = config.mesh.data_axis
+    cfg = config.replace(
+        model=dataclasses.replace(config.model, bn_axis=axis)
+    )
+    model = FasterRCNN(cfg)
+
+    def per_shard(
+        state: TrainState, batch: Dict[str, Array]
+    ) -> Tuple[TrainState, Dict[str, Array]]:
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        n_local = batch["image"].shape[0]
+        positions = jax.lax.axis_index(axis) * n_local + jnp.arange(
+            n_local, dtype=jnp.int32
+        )
+
+        def loss_fn(params):
+            return compute_losses(
+                model, cfg, params, state.batch_stats, batch, step_rng,
+                True, axis_name=axis, positions=positions,
+            )
+
+        (_, (metrics, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+
+        # THE allreduce: local grads of (local numerator / global normalizer)
+        # sum to the global gradient.
+        grads = jax.lax.psum(grads, axis)
+        # loss/count metrics are local-contribution / global-normalizer (or
+        # plain local counts), so psum yields the batch-global values.
+        metrics = jax.lax.psum(metrics, axis)
+        metrics["grad_norm"] = optax.global_norm(grads)
+
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,  # sync-BN already pmean'd these
+            opt_state=new_opt,
+        )
+        return new_state, metrics
+
+    sharded = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(), P(axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,)), model

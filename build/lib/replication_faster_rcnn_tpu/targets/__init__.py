@@ -1,0 +1,12 @@
+from replication_faster_rcnn_tpu.targets.anchor_targets import (  # noqa: F401
+    anchor_targets,
+    batched_anchor_targets,
+)
+from replication_faster_rcnn_tpu.targets.proposal_targets import (  # noqa: F401
+    batched_proposal_targets,
+    proposal_targets,
+)
+from replication_faster_rcnn_tpu.targets.sampling import (  # noqa: F401
+    pack_by_priority,
+    random_subset_mask,
+)

@@ -1,0 +1,128 @@
+"""Second-stage (head) target assignment — device-side, fixed-shape.
+
+Capability parity with reference ``ProposalTargetCreator``
+(`utils/utils.py:207-276`), redesigned to run inside the jitted train step
+(the reference syncs rois to host numpy per image, `utils/utils.py:230`,
+`train.py:91-104`):
+
+  * gt boxes join the roi pool ("add the true boxes to the rois",
+    `utils/utils.py:229-230`)
+  * positives: IoU >= pos_iou_thresh, capped at round(n_sample * pos_ratio)
+    by uniform subsampling                          (`utils/utils.py:248-251`)
+  * negatives: neg_low <= IoU < neg_high, fill to n_sample
+                                                    (`utils/utils.py:253-258`)
+  * sampled negative labels are background 0        (`utils/utils.py:275`)
+  * regression targets encode(sample_roi, matched gt), normalized by
+    (mean, std)                                     (`utils/utils.py:269-272`)
+
+Deliberate fix (SURVEY.md §2.1 #5): the reference's output length is
+whatever the sampling produced, while its trainer assumes exactly n_sample
+(`train.py:102`) — a latent shape bug. Here the output is always exactly
+``n_sample`` slots, packed positives-first, negatives next, and any deficit
+filled with label -1 (ignored by the loss) and zero rois.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from replication_faster_rcnn_tpu.config import ROITargetConfig
+from replication_faster_rcnn_tpu.ops import boxes as box_ops
+from replication_faster_rcnn_tpu.targets.sampling import (
+    pack_by_priority,
+    random_subset_mask,
+)
+
+Array = jnp.ndarray
+
+
+def proposal_targets(
+    rng: Array,
+    rois: Array,
+    roi_valid: Array,
+    gt_boxes: Array,
+    gt_labels: Array,
+    gt_mask: Array,
+    cfg: ROITargetConfig,
+) -> Tuple[Array, Array, Array]:
+    """Per-image head targets.
+
+    Args:
+      rois: [R, 4] proposals (padded); roi_valid: [R] bool.
+      gt_boxes: [G, 4]; gt_labels: [G] int (1..C-1, 0/-1 pad); gt_mask: [G].
+
+    Returns:
+      sample_rois [n_sample, 4], reg_targets [n_sample, 4] (normalized),
+      labels [n_sample] int32 — gt class for positives, 0 for sampled
+      negatives, -1 for filler slots (loss-ignored).
+    """
+    n_sample = cfg.n_sample
+
+    cand = jnp.concatenate([rois, gt_boxes], axis=0)  # [R+G, 4]
+    cand_valid = jnp.concatenate([roi_valid, gt_mask], axis=0)
+
+    ious = box_ops.iou(cand, gt_boxes)  # [R+G, G]
+    ious = jnp.where(gt_mask[None, :], ious, -1.0)
+    assignment = jnp.argmax(ious, axis=1)
+    max_iou = jnp.max(jnp.maximum(ious, 0.0), axis=1)
+    max_iou = jnp.where(cand_valid, max_iou, -1.0)  # padded rois match nothing
+
+    is_pos = cand_valid & (max_iou >= cfg.pos_iou_thresh)
+    is_neg = (
+        cand_valid
+        & (max_iou < cfg.neg_iou_thresh_high)
+        & (max_iou >= cfg.neg_iou_thresh_low)
+    )
+
+    rng_pos, rng_neg, rng_pack = jax.random.split(rng, 3)
+    pos_keep = random_subset_mask(rng_pos, is_pos, cfg.n_pos_max)
+    n_pos = jnp.sum(pos_keep)
+    neg_keep = random_subset_mask(rng_neg, is_neg, n_sample - n_pos)
+
+    # Pack kept positives (priority 0), kept negatives (1), filler (2) into
+    # exactly n_sample slots.
+    priority = jnp.where(pos_keep, 0, jnp.where(neg_keep, 1, 2))
+    idx = pack_by_priority(rng_pack, priority, n_sample)  # [n_sample]
+
+    slot_pos = pos_keep[idx]
+    slot_neg = neg_keep[idx]
+    sample_rois = cand[idx] * (slot_pos | slot_neg)[:, None]
+
+    matched_gt = gt_boxes[assignment[idx]]
+    reg = box_ops.encode(sample_rois, matched_gt)
+    mean = jnp.asarray(cfg.reg_mean, jnp.float32)
+    std = jnp.asarray(cfg.reg_std, jnp.float32)
+    reg = (reg - mean) / std
+    reg = jnp.where(slot_pos[:, None], reg, 0.0)
+
+    gt_cls = gt_labels[assignment[idx]].astype(jnp.int32)
+    labels = jnp.where(slot_pos, gt_cls, jnp.where(slot_neg, 0, -1))
+    return sample_rois.astype(jnp.float32), reg.astype(jnp.float32), labels
+
+
+def batched_proposal_targets(
+    rng: Array,
+    rois: Array,
+    roi_valid: Array,
+    gt_boxes: Array,
+    gt_labels: Array,
+    gt_mask: Array,
+    cfg: ROITargetConfig,
+    positions: Array = None,
+) -> Tuple[Array, Array, Array]:
+    """vmap over the batch: rois [N, R, 4] -> (sample_rois [N, S, 4],
+    reg [N, S, 4], labels [N, S]).
+
+    ``positions`` makes per-image keys sharding-invariant (global
+    fold_in instead of local split — see batched_anchor_targets).
+    """
+    if positions is None:
+        keys = jax.random.split(rng, rois.shape[0])
+    else:
+        keys = jax.vmap(lambda p: jax.random.fold_in(rng, p))(positions)
+    return jax.vmap(
+        lambda k, r, v, b, l, m: proposal_targets(k, r, v, b, l, m, cfg)
+    )(keys, rois, roi_valid, gt_boxes, gt_labels, gt_mask)

@@ -1,0 +1,51 @@
+"""Jit-able masked random subsampling.
+
+The reference subsamples with ``np.random.choice(index, size, replace=False)``
+on host (`utils/utils.py:192-202,248-258`) — dynamic-size, host-side, and
+unjittable. The XLA-native equivalent: draw a uniform priority per element,
+and keep an element iff it is a member AND its priority ranks inside the
+budget. The budget may be a traced scalar (e.g. "n_sample minus however many
+positives were kept"), which a fixed-size sort handles where ``top_k`` with a
+dynamic k could not.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+
+def random_subset_mask(rng: Array, member: Array, k: Array) -> Array:
+    """Uniformly choose min(k, member.sum()) elements of a masked set.
+
+    Args:
+      rng: PRNG key.
+      member: [N] bool — the candidate set.
+      k: scalar int (python or traced) — max elements to keep.
+
+    Returns: [N] bool mask, a uniform random subset of ``member`` with
+    ``min(k, member.sum())`` True entries.
+    """
+    r = jax.random.uniform(rng, member.shape)
+    score = jnp.where(member, r, -jnp.inf)
+    order = jnp.sort(score)[::-1]  # descending
+    n_member = jnp.sum(member)
+    kk = jnp.minimum(jnp.asarray(k, jnp.int32), n_member.astype(jnp.int32))
+    # kk-th largest score is the cut; kk == 0 keeps nothing.
+    cut = order[jnp.maximum(kk - 1, 0)]
+    return member & (score >= cut) & (kk > 0)
+
+
+def pack_by_priority(rng: Array, priority: Array, n_out: int) -> Array:
+    """Order indices by (priority, random tiebreak) and take the first n_out.
+
+    priority: [N] small non-negative ints; lower packs first. Returns
+    [n_out] int32 indices. Used to lay out "positives first, then negatives,
+    then filler" into a fixed-size sample block.
+    """
+    r = jax.random.uniform(rng, priority.shape)
+    key = priority.astype(jnp.float32) + r  # r < 1 preserves class ordering
+    order = jnp.argsort(key)
+    return order[:n_out].astype(jnp.int32)

@@ -1,0 +1,9 @@
+from replication_faster_rcnn_tpu.train import losses  # noqa: F401
+from replication_faster_rcnn_tpu.train.train_step import (  # noqa: F401
+    TrainState,
+    compute_losses,
+    create_train_state,
+    make_optimizer,
+    make_train_step,
+)
+from replication_faster_rcnn_tpu.train.trainer import Trainer  # noqa: F401

@@ -1,0 +1,114 @@
+"""Backbone classifier pretraining — capability parity with the reference's
+CIFAR pretraining path (`nets/resnet.py:163-292` ``__main__``: a ResNet18
+trained on CIFAR10 to ~0.93 top-1, `readme.md:15`, whose trunk/tail split
+then seeds the detector).
+
+A jitted softmax-CE classification step over any (images [N,H,W,3],
+labels [N]) arrays. The trained `trunk`/`tail` params drop directly into
+FasterRCNN variables (same module names) via :func:`graft_classifier`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from replication_faster_rcnn_tpu.models.resnet import ResNetClassifier
+
+Array = jnp.ndarray
+
+
+def make_classifier(
+    arch: str = "resnet18",
+    num_classes: int = 10,
+    stem: str = "cifar",
+    dtype: str = "bfloat16",
+):
+    return ResNetClassifier(
+        arch=arch, num_classes=num_classes, dtype=jnp.dtype(dtype), stem=stem
+    )
+
+
+def make_pretrain_step(model: ResNetClassifier, tx: optax.GradientTransformation):
+    """(variables, opt_state, images, labels) -> (variables, opt_state, metrics)."""
+
+    def step(variables, opt_state, images, labels):
+        def loss_fn(params):
+            logits, mut = model.apply(
+                {"params": params, "batch_stats": variables["batch_stats"]},
+                images,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            ).mean()
+            acc = (jnp.argmax(logits, -1) == labels).mean()
+            return ce, (acc, mut["batch_stats"])
+
+        (loss, (acc, stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            variables["params"]
+        )
+        updates, opt_state = tx.update(grads, opt_state, variables["params"])
+        params = optax.apply_updates(variables["params"], updates)
+        return (
+            {"params": params, "batch_stats": stats},
+            opt_state,
+            {"loss": loss, "accuracy": acc},
+        )
+
+    return step
+
+
+def pretrain(
+    model: ResNetClassifier,
+    batches: Iterable[Tuple[Any, Any]],
+    lr: float = 1e-3,
+    weight_decay: float = 5e-4,
+    rng: Any = None,
+) -> Dict[str, Any]:
+    """Train over an iterable of (images, labels) batches; returns final
+    variables. Small-scale utility (the reference's CIFAR script analog) —
+    full-dataset pretraining would go through Trainer-style sharding."""
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    first = None
+    it = iter(batches)
+    first_batch = next(it)
+    images0 = jnp.asarray(first_batch[0])
+    variables = model.init({"params": rng}, images0, train=False)
+    variables = {
+        "params": variables["params"],
+        "batch_stats": variables.get("batch_stats", {}),
+    }
+    tx = optax.adamw(lr, weight_decay=weight_decay)
+    opt_state = tx.init(variables["params"])
+    step = jax.jit(make_pretrain_step(model, tx))
+
+    metrics = {}
+    for images, labels in [first_batch] + list(it):
+        variables, opt_state, metrics = step(
+            variables, opt_state, jnp.asarray(images), jnp.asarray(labels)
+        )
+    del first
+    return {"variables": variables, "metrics": jax.device_get(metrics)}
+
+
+def graft_classifier(detector_variables: Dict[str, Any], classifier_variables: Dict[str, Any]):
+    """Copy a pretrained classifier's trunk/tail into FasterRCNN variables
+    (single-scale layout: trunk -> `trunk`, tail -> `head.tail`)."""
+    out_p = dict(detector_variables["params"])
+    out_s = dict(detector_variables.get("batch_stats", {}))
+    cp = classifier_variables["params"]
+    cs = classifier_variables.get("batch_stats", {})
+    out_p["trunk"] = cp["trunk"]
+    out_s["trunk"] = cs.get("trunk", {})
+    head = dict(out_p["head"])
+    head["tail"] = cp["tail"]
+    out_p["head"] = head
+    hstats = dict(out_s.get("head", {}))
+    hstats["tail"] = cs.get("tail", {})
+    out_s["head"] = hstats
+    return {"params": out_p, "batch_stats": out_s}

@@ -1,0 +1,217 @@
+"""The jitted train step — the whole reference training iteration
+(`train.py:59-127`) as ONE XLA program.
+
+Where the reference crosses the device boundary four times per step (host
+anchor generation `nets/rpn.py:127`, per-image NMS loop `nets/rpn.py:131-136`,
+host numpy RPN targets `train.py:71-79`, roi.cpu() head targets
+`train.py:91-104`), here the entire pipeline — trunk -> RPN -> proposals ->
+both target creators -> head -> 4 losses -> grad -> update — is traced once
+and compiled. Sharding the batch over the mesh's data axis turns the loss's
+global reductions and the gradient sums into XLA allreduces automatically.
+
+Loss structure (reference `train.py:81-123`): rpn_reg (smooth-L1 on anchor
+positives), rpn_cls (binary CE, ignore -1), head_reg (smooth-L1 on sampled
+positives, class-specific deltas via `train.py:112-117` gather semantics),
+head_cls (21-way CE, ignore -1); total is their weighted sum (reference:
+unweighted, `train.py:123`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from replication_faster_rcnn_tpu.config import FasterRCNNConfig
+from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
+from replication_faster_rcnn_tpu.models.head import select_class_deltas
+from replication_faster_rcnn_tpu.targets import (
+    batched_anchor_targets,
+    batched_proposal_targets,
+)
+from replication_faster_rcnn_tpu.train import losses
+
+Array = jnp.ndarray
+
+
+class TrainState(struct.PyTreeNode):
+    """Carried training state (params + BN stats + optimizer + step + rng)."""
+
+    step: Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    rng: Array
+
+
+def create_train_state(
+    config: FasterRCNNConfig, rng: Array, tx: optax.GradientTransformation
+) -> Tuple[FasterRCNN, TrainState]:
+    model = FasterRCNN(config)
+    h, w = config.data.image_size
+    init_rng, state_rng = jax.random.split(rng)
+    variables = model.init(
+        {"params": init_rng}, jnp.zeros((1, h, w, 3), jnp.float32), train=False
+    )
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return model, TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        rng=state_rng,
+    )
+
+
+def compute_losses(
+    model: FasterRCNN,
+    config: FasterRCNNConfig,
+    params: Any,
+    batch_stats: Any,
+    batch: Dict[str, Array],
+    rng: Array,
+    train: bool = True,
+    axis_name: str = None,
+    positions: Array = None,
+) -> Tuple[Array, Tuple[Dict[str, Array], Any]]:
+    """Forward + 4 losses. Returns (total, (metrics, new_batch_stats)).
+
+    ``axis_name``/``positions`` support the explicit shard_map backend
+    (`parallel/spmd.py`): loss normalizers psum over the axis, per-image
+    sampling keys fold in the global batch position so the objective and
+    randomness match the jit auto-partitioned path exactly.
+    """
+    images = batch["image"]
+    gt_boxes = batch["boxes"]
+    gt_labels = batch["labels"]
+    gt_mask = batch["mask"]
+    img_h, img_w = float(images.shape[1]), float(images.shape[2])
+    variables = {"params": params, "batch_stats": batch_stats}
+    sigma = config.train.smooth_l1_sigma
+    if positions is None:
+        positions = jnp.arange(images.shape[0], dtype=jnp.int32)
+
+    rng_at, rng_pt, rng_do = jax.random.split(rng, 3)
+    if axis_name is not None:
+        # decorrelate dropout across shards (rng is replicated; without this
+        # every shard would draw the same mask). Sampling rngs stay
+        # shard-invariant — their per-image keys fold in global positions.
+        rng_do = jax.random.fold_in(rng_do, jax.lax.axis_index(axis_name))
+
+    # trunk + RPN (train mode: BN batch stats update)
+    feat, mut = model.apply(
+        variables, images, train, method="extract_features", mutable=["batch_stats"]
+    )
+    logits, deltas, anchors = model.apply(variables, feat, method="rpn_forward")
+
+    # first-stage targets, on device
+    reg_t, lab_t = batched_anchor_targets(
+        rng_at, gt_boxes, gt_mask, anchors, config.rpn_targets, positions
+    )
+    rpn_reg_loss = losses.loc_loss(deltas, reg_t, lab_t, sigma, axis_name)
+    rpn_cls_loss = losses.ignore_cross_entropy(logits, lab_t, axis_name)
+
+    # proposals (stop-grad, reference detach semantics) + second-stage targets
+    rois, roi_valid = model.apply(
+        variables, logits, deltas, anchors, img_h, img_w, train, method="propose"
+    )
+    sample_rois, reg_t2, lab_t2 = batched_proposal_targets(
+        rng_pt, rois, roi_valid, gt_boxes, gt_labels, gt_mask, config.roi_targets,
+        positions,
+    )
+
+    # head on the sampled rois (BN in the tail also updates; the VGG16
+    # tail's dropout draws from the 'dropout' rng in train mode)
+    (cls_out, reg_out), mut2 = model.apply(
+        {"params": params, "batch_stats": mut["batch_stats"]},
+        feat,
+        sample_rois,
+        img_h,
+        img_w,
+        train,
+        method="head_forward",
+        mutable=["batch_stats"],
+        rngs={"dropout": rng_do} if train else None,
+    )
+    reg_sel = select_class_deltas(reg_out, lab_t2)
+    head_reg_loss = losses.loc_loss(reg_sel, reg_t2, lab_t2, sigma, axis_name)
+    head_cls_loss = losses.ignore_cross_entropy(cls_out, lab_t2, axis_name)
+
+    w1, w2, w3, w4 = config.train.loss_weights
+    total = (
+        w1 * rpn_cls_loss + w2 * rpn_reg_loss + w3 * head_cls_loss + w4 * head_reg_loss
+    )
+    metrics = {
+        "loss": total,
+        "rpn_cls_loss": rpn_cls_loss,
+        "rpn_reg_loss": rpn_reg_loss,
+        "head_cls_loss": head_cls_loss,
+        "head_reg_loss": head_reg_loss,
+        "n_pos_rpn": (lab_t == 1).sum().astype(jnp.float32),
+        "n_pos_head": (lab_t2 > 0).sum().astype(jnp.float32),
+    }
+    return total, (metrics, mut2["batch_stats"])
+
+
+def make_train_step(
+    model: FasterRCNN,
+    config: FasterRCNNConfig,
+    tx: optax.GradientTransformation,
+):
+    """Build the jittable (state, batch) -> (state, metrics) function.
+
+    Jit it with donate_argnums=(0,) and sharded batch inputs; parameters
+    stay replicated and gradients allreduce via XLA.
+    """
+
+    def train_step(state: TrainState, batch: Dict[str, Array]):
+        step_rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            return compute_losses(
+                model, config, params, state.batch_stats, batch, step_rng, True
+            )
+
+        (_, (metrics, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt,
+        )
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return new_state, metrics
+
+    return train_step
+
+
+def make_optimizer(config: FasterRCNNConfig, steps_per_epoch: int):
+    """Adam + per-epoch cosine annealing (reference `train.py:139-140`:
+    Adam(lr, weight_decay=5e-6) + CosineAnnealingLR(T_max=n_epoch)).
+
+    The schedule is evaluated per step but changes value once per epoch,
+    matching the reference's epoch-granular scheduler.step() (`train.py:148`).
+    """
+    tc = config.train
+
+    def schedule(step):
+        epoch = jnp.minimum(step // max(steps_per_epoch, 1), tc.n_epoch)
+        return tc.lr * 0.5 * (1.0 + jnp.cos(jnp.pi * epoch / tc.n_epoch))
+
+    # torch Adam's weight_decay is L2-added-to-grad, not decoupled AdamW.
+    tx = optax.chain(
+        optax.add_decayed_weights(tc.weight_decay),
+        optax.scale_by_adam(),
+        optax.scale_by_learning_rate(schedule),
+    )
+    return tx, schedule

@@ -1,0 +1,2 @@
+from replication_faster_rcnn_tpu.utils import debug, profiling  # noqa: F401
+from replication_faster_rcnn_tpu.utils.logging import MetricLogger  # noqa: F401

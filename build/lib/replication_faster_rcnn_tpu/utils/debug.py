@@ -1,0 +1,44 @@
+"""Numeric-health guards — SURVEY.md §5 "race detection / sanitizers" (the
+reference's only debug relics are a commented detect_anomaly and a stray
+pdb.set_trace, `nets/resnet.py:190,283`).
+
+* :func:`enable_nan_checks` — turn on jax's global NaN debugging (every jit
+  output checked; errors pinpoint the emitting op).
+* :func:`assert_tree_finite` — explicit pytree check for use at loss/grad
+  boundaries when the global mode's recompilation cost is unwanted.
+* :func:`finite_or_raise` — trainer hook: validate a metrics dict once per
+  log interval and fail fast with context instead of training on NaNs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import jax
+import numpy as np
+
+
+def enable_nan_checks(enable: bool = True) -> None:
+    jax.config.update("jax_debug_nans", enable)
+
+
+def assert_tree_finite(tree: Any, name: str = "tree") -> None:
+    flat, _ = jax.tree_util.tree_flatten(tree)
+    for i, leaf in enumerate(flat):
+        arr = np.asarray(jax.device_get(leaf))
+        if not np.all(np.isfinite(arr)):
+            bad = int(np.sum(~np.isfinite(arr)))
+            raise FloatingPointError(
+                f"{name}: leaf {i} has {bad} non-finite values "
+                f"(shape {arr.shape}, dtype {arr.dtype})"
+            )
+
+
+def finite_or_raise(metrics: Mapping[str, Any], step: int) -> Dict[str, float]:
+    vals = {k: float(v) for k, v in metrics.items()}
+    bad = [k for k, v in vals.items() if not np.isfinite(v)]
+    if bad:
+        raise FloatingPointError(
+            f"non-finite metrics at step {step}: {bad} (all: {vals})"
+        )
+    return vals

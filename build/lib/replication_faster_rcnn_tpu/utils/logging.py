@@ -1,0 +1,46 @@
+"""Structured scalar logging — replaces the reference's bare prints
+(`train.py:124,143`; SURVEY.md §5 metrics/observability).
+
+Plain-text structured lines by default; optional JSONL sink for machine
+consumption. Keeps zero third-party deps (no tensorboard in this image).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Dict, Optional, TextIO
+
+
+class MetricLogger:
+    def __init__(
+        self, stream: Optional[TextIO] = None, jsonl_path: Optional[str] = None
+    ):
+        # None = resolve sys.stdout at write time: a default bound at import
+        # time pins whatever stdout was then (stale under redirection)
+        self._stream = stream
+        self.jsonl_path = jsonl_path
+        self._t0 = time.time()
+
+    @property
+    def stream(self) -> TextIO:
+        return self._stream if self._stream is not None else sys.stdout
+
+    def _write_jsonl(self, record: Dict) -> None:
+        if self.jsonl_path:
+            with open(self.jsonl_path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+
+    def log(self, step: int, metrics: Dict[str, float]) -> None:
+        parts = " ".join(f"{k}={v:.4g}" for k, v in sorted(metrics.items()))
+        self.stream.write(f"[step {step:>6}] {parts}\n")
+        self.stream.flush()
+        self._write_jsonl({"step": step, "t": time.time() - self._t0, **metrics})
+
+    def log_epoch(self, epoch: int, images_per_sec: float) -> None:
+        self.stream.write(
+            f"[epoch {epoch:>3}] throughput={images_per_sec:.2f} images/sec\n"
+        )
+        self.stream.flush()
+        self._write_jsonl({"epoch": epoch, "images_per_sec": images_per_sec})

@@ -1,0 +1,114 @@
+"""Visual sanity artifacts — the reference's two matplotlib ``__main__``
+checks, as a real API (PIL, no matplotlib in this image):
+
+  * anchor-center scatter (reference `utils/anchors.py:64-77`, which saves
+    ``anchor_points.png``): one dot per anchor grid center over the image
+    extent — a transposed-center bug (the reference had one, fixed in
+    `ops/anchors.py`) shows up instantly as a rotated/clipped lattice.
+  * ground-truth box overlay (reference `utils/data_loader.py:119-134`):
+    draws a dataset sample's un-normalized image with its gt boxes +
+    class names — the first thing to look at when labels seem wrong.
+
+Both return the PIL image and optionally save it; `cli viz` is the
+command-line surface.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def draw_labeled_boxes(draw, items, color: Tuple[int, int, int]) -> None:
+    """Shared box-annotation loop (used by this module's gt overlay and
+    `eval/predict.py::draw_detections`): ``items`` is an iterable of
+    (row-major box [r1, c1, r2, c2], label text)."""
+    for (r1, c1, r2, c2), text in items:
+        draw.rectangle([c1, r1, c2, r2], outline=color, width=2)
+        draw.text((c1 + 2, max(r1 - 12, 0)), text, fill=color)
+
+
+def draw_anchor_centers(config, out_path: Optional[str] = None):
+    """Anchor grid centers as a scatter over the configured image extent.
+
+    Derived from the REAL anchor pipeline (``ops/anchors.make_anchors``)
+    rather than stride arithmetic, so a center bug upstream shows here;
+    the K same-cell anchors share a midpoint, so centers are deduplicated
+    before drawing."""
+    from PIL import Image, ImageDraw
+
+    from replication_faster_rcnn_tpu.ops import anchors as anchor_ops
+
+    h, w = config.data.image_size
+    fh, fw = config.feature_size()
+    all_anchors = anchor_ops.make_anchors(config.anchors, (fh, fw))
+    centers = np.unique(
+        np.stack(
+            [
+                (all_anchors[:, 0] + all_anchors[:, 2]) / 2.0,
+                (all_anchors[:, 1] + all_anchors[:, 3]) / 2.0,
+            ],
+            axis=1,
+        ),
+        axis=0,
+    )
+
+    im = Image.new("RGB", (w, h), (255, 255, 255))
+    draw = ImageDraw.Draw(im)
+    for r, c in centers:
+        if 0 <= r < h and 0 <= c < w:
+            draw.ellipse([c - 1, r - 1, c + 1, r + 1], fill=(200, 30, 30))
+    if out_path:
+        im.save(out_path)
+    return im
+
+
+def _unnormalize(image: np.ndarray, mean, std) -> np.ndarray:
+    """normalized float32 HWC -> uint8 RGB."""
+    arr = (image * np.asarray(std, np.float32) + np.asarray(mean, np.float32))
+    return (np.clip(arr, 0.0, 1.0) * 255.0).astype(np.uint8)
+
+
+def draw_gt_overlay(
+    sample,
+    config,
+    out_path: Optional[str] = None,
+    class_names: Optional[Sequence[str]] = None,
+):
+    """Dataset sample dict ({'image','boxes','labels','mask'}) -> PIL image
+    with its ground-truth boxes drawn (row-major [r1, c1, r2, c2])."""
+    from PIL import Image, ImageDraw
+
+    from replication_faster_rcnn_tpu.config import VOC_CLASSES
+
+    if class_names is None:
+        class_names = (
+            VOC_CLASSES
+            if config.model.num_classes == len(VOC_CLASSES)
+            else [str(i) for i in range(config.model.num_classes)]
+        )
+    rgb = _unnormalize(
+        np.asarray(sample["image"]), config.data.pixel_mean, config.data.pixel_std
+    )
+    im = Image.fromarray(rgb)
+    draw = ImageDraw.Draw(im)
+    boxes = np.asarray(sample["boxes"])
+    labels = np.asarray(sample["labels"])
+    mask = np.asarray(sample["mask"])
+
+    def _name(cls: int) -> str:
+        return class_names[cls] if 0 <= cls < len(class_names) else str(cls)
+
+    draw_labeled_boxes(
+        draw,
+        (
+            (boxes[i], _name(int(labels[i])))
+            for i in range(len(boxes))
+            if bool(mask[i])
+        ),
+        (40, 220, 40),
+    )
+    if out_path:
+        im.save(out_path)
+    return im
