@@ -211,6 +211,71 @@ class TestVGG16:
         )
         assert all(jax.tree_util.tree_leaves(same))
 
+    def test_convert_vgg16_numeric_forward_parity(self):
+        """End-to-end converter numerics (the resnet18 equivalent of this
+        test exists in TestConvertNumerics): a torchvision-layout VGG16
+        state_dict pushed through convert_vgg16 must make the flax trunk
+        and tail reproduce the torch forward. torchvision isn't installed,
+        so the oracle is the same Sequential layout built from torch.nn
+        (feature indices match convert._VGG16_FEATURE_IDX by
+        construction)."""
+        import torch
+        from replication_faster_rcnn_tpu.models.vgg import VGG16Tail, VGG16Trunk
+
+        torch.manual_seed(0)
+        plan = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                512, 512, 512, "M", 512, 512, 512]
+        layers, in_c = [], 3
+        for v in plan:
+            if v == "M":
+                layers.append(torch.nn.MaxPool2d(2, 2))
+            else:
+                layers.append(torch.nn.Conv2d(in_c, v, 3, padding=1))
+                layers.append(torch.nn.ReLU())
+                in_c = v
+        features = torch.nn.Sequential(*layers)
+        rs, width = 2, 4096  # fc widths are fixed by VGG16Tail's Dense decl
+        classifier = torch.nn.Sequential(
+            torch.nn.Linear(512 * rs * rs, width),
+            torch.nn.ReLU(),
+            torch.nn.Dropout(),
+            torch.nn.Linear(width, width),
+            torch.nn.ReLU(),  # torchvision classifier.4; no params
+        )
+        state = {f"features.{k}": v for k, v in features.state_dict().items()}
+        state.update(
+            {f"classifier.{k}": v for k, v in classifier.state_dict().items()}
+        )
+        tp, lp = convert.convert_vgg16(state, roi_size=rs)
+
+        # trunk: 64x64 input (multiple of 16 -> ceil pooling == torch floor)
+        x = torch.randn(2, 3, 64, 64)
+        with torch.no_grad():
+            ref_feat = features(x).numpy()  # [2, 512, 4, 4]
+        trunk = VGG16Trunk(jnp.float32)
+        got_feat = np.asarray(
+            trunk.apply({"params": tp}, jnp.asarray(x.numpy().transpose(0, 2, 3, 1)))
+        )
+        np.testing.assert_allclose(
+            got_feat, ref_feat.transpose(0, 2, 3, 1), rtol=1e-4, atol=1e-4
+        )
+
+        # tail: torch flattens CHW, ours flattens HWC — the converted fc6
+        # kernel must absorb the layout difference
+        crop = torch.randn(3, 512, rs, rs)
+        classifier.eval()  # torch Dropout is active by default
+        with torch.no_grad():
+            ref_emb = classifier(crop.flatten(1)).numpy()
+        tail = VGG16Tail(jnp.float32)
+        got_emb = np.asarray(
+            tail.apply(
+                {"params": lp},
+                jnp.asarray(crop.numpy().transpose(0, 2, 3, 1)),
+                train=False,
+            )
+        )
+        np.testing.assert_allclose(got_emb, ref_emb, rtol=1e-4, atol=1e-4)
+
 
 class TestFasterRCNNAssembly:
     def test_forward_shapes_fixed(self):
