@@ -11,6 +11,13 @@ dicts of stacked numpy arrays; the trainer moves them to device (sharded
 
 Epoch semantics mirror the reference trainer: sequential or seeded-shuffle
 order, drop_last (the fixed-shape train step wants full batches).
+
+Threads (not processes) are enough to scale ingest across cores: the
+sample hot path — JPEG decode + fused resize/normalize — is one ctypes
+call into native/frcnn_native.cpp, and ctypes releases the GIL for the
+call's duration, so ``num_workers`` decode threads genuinely run in
+parallel (the torch DataLoader needs worker *processes* because its
+Python-side transforms hold the GIL).
 """
 
 from __future__ import annotations
