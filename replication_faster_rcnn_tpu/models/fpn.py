@@ -13,9 +13,10 @@ standard Faster-R-CNN-FPN wiring, built fixed-shape for XLA:
   * anchors use one scale per level (AnchorConfig.scales=(8,)) over
     per-level strides (4, 8, 16, 32, 64);
   * ROIs are assigned to levels by the paper's k = k0 + log2(sqrt(area)/224)
-    rule. On TPU the per-level gather is computed for ALL rois on every
-    level and blended by a one-hot level mask — 4x the (cheap) ROIAlign
-    gathers in exchange for fully static shapes, no sorting/regrouping.
+    rule. On TPU the pyramid is flattened into one [N, sum(Hl*Wl), C]
+    buffer and each roi does a single 4-corner gather at level-offset flat
+    indices — fully static shapes, no sorting/regrouping, one backward
+    scatter (see multilevel_roi_align).
 
 All spatial tensors are NHWC; levels are a list ordered fine -> coarse.
 """
@@ -117,36 +118,123 @@ def multilevel_roi_align(
     img_w: float,
     out_size: int = 7,
     sampling_ratio: int = 2,
+    method: str = "flat",
 ) -> Array:
     """ROIAlign across P2..P5 with level assignment, fixed-shape.
 
     feats: 4 arrays [N, Hl, Wl, C]; rois: [N, R, 4] image coords.
-    Returns [N, R, out, out, C]. Every roi is aligned on every level and the
-    results blended with a one-hot mask — static shapes, no partitioning.
+    Returns [N, R, out, out, C].
 
-    Uses the gather roi_align method: the einsum (MXU) formulation's dense
-    [R, P, H] weight matmul is a win on the stride-16 single-scale map but
-    scales with H*W, which at P2 (stride 4, e.g. 150x150 for 600 input)
-    costs ~10x the whole backbone — random gathers are the right tool on
-    the fine levels.
+    ``method="flat"`` (default): all four levels are flattened into ONE
+    [N, sum(Hl*Wl), C] buffer and every roi does a single 4-corner
+    bilinear gather with level-offset flat indices (index = level_offset +
+    r * Wl + c, computed from the roi's assigned level). One gather pass
+    and one backward scatter for the whole pyramid — measured 3.4x the
+    blend path on v5e (50.3 -> 14.6 ms at b8, 128 rois; see
+    benchmarks/bench_v5e_round2.json).
+
+    ``method="blend"``: the original formulation — every roi is aligned on
+    EVERY level (gather roi_align per level) and the results combined with
+    a one-hot level mask. 4x the gathers and a 4x backward scatter; kept
+    as the oracle for the flat path's parity test. The two are the same
+    math (the blended sum adds exact zeros) but not bitwise: the sample
+    coordinate r1 + pts*bin feeds floor(), and XLA's FMA choice can shift
+    the fractional part (the bilinear weight) by ~eps(coordinate).
+
+    The einsum (MXU) roi_align formulation is deliberately not used here:
+    its dense [R, P, H] weight matmul is a win on the stride-16
+    single-scale map but scales with H*W, which at P2 (stride 4, 150x150
+    for 600 input) costs ~10x the whole backbone.
     """
     levels = roi_levels(rois)  # [N, R]
-    out = None
-    for li, feat in enumerate(feats[:4]):
-        scale_r = feat.shape[1] / img_h
-        scale_c = feat.shape[2] / img_w
-        scale = jnp.asarray([scale_r, scale_c, scale_r, scale_c], rois.dtype)
+    if method == "blend":
+        out = None
+        for li, feat in enumerate(feats[:4]):
+            scale_r = feat.shape[1] / img_h
+            scale_c = feat.shape[2] / img_w
+            scale = jnp.asarray([scale_r, scale_c, scale_r, scale_c], rois.dtype)
 
-        def align_one(f: Array, rb: Array) -> Array:
-            return roi_ops.roi_align(
-                f,
-                rb * scale,
-                out_size=out_size,
-                sampling_ratio=sampling_ratio,
-                method="gather",
-            )
+            def align_one(f: Array, rb: Array) -> Array:
+                return roi_ops.roi_align(
+                    f,
+                    rb * scale,
+                    out_size=out_size,
+                    sampling_ratio=sampling_ratio,
+                    method="gather",
+                )
 
-        crops = jax.vmap(align_one)(feat, rois)  # [N, R, s, s, C]
-        mask = (levels == li).astype(crops.dtype)[..., None, None, None]
-        out = crops * mask if out is None else out + crops * mask
-    return out
+            crops = jax.vmap(align_one)(feat, rois)  # [N, R, s, s, C]
+            mask = (levels == li).astype(crops.dtype)[..., None, None, None]
+            out = crops * mask if out is None else out + crops * mask
+        return out
+    if method != "flat":
+        raise ValueError(f"unknown multilevel_roi_align method {method!r}")
+
+    import numpy as np
+
+    n, r_cnt = rois.shape[0], rois.shape[1]
+    c_dim = feats[0].shape[-1]
+    hs = [int(f.shape[1]) for f in feats[:4]]
+    ws = [int(f.shape[2]) for f in feats[:4]]
+    offs = np.concatenate([[0], np.cumsum([h * w for h, w in zip(hs, ws)])[:-1]])
+    flat = jnp.concatenate([f.reshape(n, -1, c_dim) for f in feats[:4]], axis=1)
+
+    dt = rois.dtype
+    h_l = jnp.asarray(hs, dt)[levels]  # [N, R] assigned-level extents
+    w_l = jnp.asarray(ws, dt)[levels]
+    w_li = jnp.asarray(ws, jnp.int32)[levels]
+    off_l = jnp.asarray(offs, jnp.int32)[levels]
+
+    # roi coords scaled into assigned-level units (blend path: rb * scale)
+    sr = h_l / img_h
+    sc = w_l / img_w
+    r1, c1 = rois[..., 0] * sr, rois[..., 1] * sc
+    r2, c2 = rois[..., 2] * sr, rois[..., 3] * sc
+
+    # sample grid (roi_ops._sample_grid semantics: 1px minimum extent,
+    # sample centers at (p + .5)/s bin units)
+    s = sampling_ratio
+    bin_h = jnp.maximum(r2 - r1, 1.0) / out_size  # [N, R]
+    bin_w = jnp.maximum(c2 - c1, 1.0) / out_size
+    pts = (jnp.arange(out_size * s, dtype=dt) + 0.5) / s  # [S]
+    rr = r1[..., None] + pts * bin_h[..., None]  # [N, R, S]
+    cc = c1[..., None] + pts * bin_w[..., None]
+
+    # 4-corner bilinear on the [N, R, S, S] grid, extents per assigned
+    # level (roi_ops._bilinear_gather border rule: outside [-1, H]x[-1, W]
+    # contributes zero; in-range clamps to the valid window)
+    rg = rr[..., :, None] * jnp.ones_like(cc)[..., None, :]
+    cg = cc[..., None, :] * jnp.ones_like(rr)[..., :, None]
+    hb = h_l[..., None, None]
+    wb = w_l[..., None, None]
+    in_range = (rg >= -1.0) & (rg <= hb) & (cg >= -1.0) & (cg <= wb)
+    rg = jnp.clip(rg, 0.0, hb - 1.0)
+    cg = jnp.clip(cg, 0.0, wb - 1.0)
+    r0 = jnp.floor(rg)
+    c0 = jnp.floor(cg)
+    r0i = r0.astype(jnp.int32)
+    c0i = c0.astype(jnp.int32)
+    r1i = jnp.minimum(r0i + 1, hb.astype(jnp.int32) - 1)
+    c1i = jnp.minimum(c0i + 1, wb.astype(jnp.int32) - 1)
+    ar = rg - r0
+    ac = cg - c0
+
+    base = off_l[..., None, None]
+    wrow = w_li[..., None, None]
+
+    def corner(ri: Array, ci: Array) -> Array:
+        idx = (base + ri * wrow + ci).reshape(n, -1)  # [N, R*S*S]
+        return jnp.take_along_axis(flat, idx[..., None], axis=1)  # [N, K, C]
+
+    def w3(w: Array) -> Array:
+        return w.reshape(n, -1, 1)
+
+    sampled = (
+        corner(r0i, c0i) * w3((1 - ar) * (1 - ac))
+        + corner(r0i, c1i) * w3((1 - ar) * ac)
+        + corner(r1i, c0i) * w3(ar * (1 - ac))
+        + corner(r1i, c1i) * w3(ar * ac)
+    )
+    sampled = sampled * w3(in_range.astype(sampled.dtype))
+    sampled = sampled.reshape(n, r_cnt, out_size, s, out_size, s, c_dim)
+    return sampled.mean(axis=(3, 5))

@@ -98,6 +98,69 @@ class TestLevelAssignment:
         assert vals[0] == 1.0  # small roi -> P2
         assert vals[1] == 3.0  # canonical roi -> P4
 
+    def test_flat_matches_blend_oracle(self):
+        # the flat level-offset gather must reproduce the per-level blend
+        # formulation (same math; FP tolerance explained at the assertion)
+        key = jax.random.PRNGKey(7)
+        n, r = 2, 64
+        shapes = [(64, 48), (32, 24), (16, 12), (8, 6)]
+        keys = jax.random.split(key, 6)
+        feats = [
+            jax.random.normal(k, (n, h, w, 8), jnp.float32)
+            for k, (h, w) in zip(keys[:4], shapes)
+        ]
+        # rois spanning every level, some degenerate/outside the image
+        r1 = jax.random.uniform(keys[4], (n, r, 2), minval=-20.0, maxval=200.0)
+        sz = jax.random.uniform(keys[5], (n, r, 2), minval=0.0, maxval=400.0)
+        rois = jnp.concatenate([r1, r1 + sz], axis=-1)
+        flat = multilevel_roi_align(feats, rois, 256.0, 192.0, method="flat")
+        blend = multilevel_roi_align(feats, rois, 256.0, 192.0, method="blend")
+        # not bitwise: the sample coordinate r1 + pts*bin feeds floor(), and
+        # XLA may FMA it in one program and not the other — the fractional
+        # part (bilinear weight) then differs by ~eps(coord), i.e. ~1e-5
+        # absolute on O(100) coordinates
+        np.testing.assert_allclose(
+            np.asarray(flat), np.asarray(blend), atol=1e-4, rtol=1e-5
+        )
+
+    def test_flat_matches_blend_bf16_features(self):
+        # the in-model dtype: bf16 features, f32 rois
+        key = jax.random.PRNGKey(3)
+        shapes = [(40, 40), (20, 20), (10, 10), (5, 5)]
+        feats = [
+            jax.random.normal(k, (1, h, w, 4), jnp.float32).astype(jnp.bfloat16)
+            for k, (h, w) in zip(jax.random.split(key, 4), shapes)
+        ]
+        rois = jnp.asarray(
+            [[[5, 5, 50, 70], [0, 0, 150, 150], [10, 10, 11, 11]]], jnp.float32
+        )
+        flat = multilevel_roi_align(feats, rois, 160.0, 160.0, method="flat")
+        blend = multilevel_roi_align(feats, rois, 160.0, 160.0, method="blend")
+        np.testing.assert_allclose(
+            np.asarray(flat, np.float32),
+            np.asarray(blend, np.float32),
+            atol=1e-2,
+            rtol=1e-2,
+        )
+
+    def test_flat_align_gradients_flow(self):
+        # backward: the flat gather's scatter must route gradients into
+        # every pyramid level that owns a roi
+        shapes = [(32, 32), (16, 16), (8, 8), (4, 4)]
+        feats = [jnp.ones((1, h, w, 2), jnp.float32) for h, w in shapes]
+        rois = jnp.asarray(
+            [[[0, 0, 20, 20], [0, 0, 120, 120], [0, 0, 500, 500]]], jnp.float32
+        )
+
+        def loss(fs):
+            return multilevel_roi_align(fs, rois, 512.0, 512.0).sum()
+
+        grads = jax.grad(loss)(feats)
+        # rois land on P2 (20px), P3/P4 (120px ~ k=3.1 -> P3), P5 (500px)
+        touched = [bool(np.any(np.asarray(g) != 0)) for g in grads]
+        assert touched[0] and touched[3]
+        assert any(touched[1:3])
+
 
 class TestFPNModel:
     def test_forward_shapes(self):
