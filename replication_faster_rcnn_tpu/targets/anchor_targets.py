@@ -77,10 +77,10 @@ def anchor_targets(
     # negatives fill to n_sample.
     n_pos = int(cfg.pos_ratio * cfg.n_sample)
     rng_pos, rng_neg = jax.random.split(rng)
-    pos_keep = random_subset_mask(rng_pos, labels == 1, n_pos)
+    pos_keep = random_subset_mask(rng_pos, labels == 1, n_pos, k_max=n_pos)
     labels = jnp.where((labels == 1) & ~pos_keep, -1, labels)
     n_neg = cfg.n_sample - jnp.sum(labels == 1)
-    neg_keep = random_subset_mask(rng_neg, labels == 0, n_neg)
+    neg_keep = random_subset_mask(rng_neg, labels == 0, n_neg, k_max=cfg.n_sample)
     labels = jnp.where((labels == 0) & ~neg_keep, -1, labels)
 
     reg = box_ops.encode(anchors, gt_boxes[argmax])

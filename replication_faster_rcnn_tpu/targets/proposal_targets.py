@@ -78,9 +78,9 @@ def proposal_targets(
     )
 
     rng_pos, rng_neg, rng_pack = jax.random.split(rng, 3)
-    pos_keep = random_subset_mask(rng_pos, is_pos, cfg.n_pos_max)
+    pos_keep = random_subset_mask(rng_pos, is_pos, cfg.n_pos_max, k_max=cfg.n_pos_max)
     n_pos = jnp.sum(pos_keep)
-    neg_keep = random_subset_mask(rng_neg, is_neg, n_sample - n_pos)
+    neg_keep = random_subset_mask(rng_neg, is_neg, n_sample - n_pos, k_max=n_sample)
 
     # Pack kept positives (priority 0), kept negatives (1), filler (2) into
     # exactly n_sample slots.

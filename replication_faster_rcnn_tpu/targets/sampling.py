@@ -17,24 +17,42 @@ import jax.numpy as jnp
 Array = jnp.ndarray
 
 
-def random_subset_mask(rng: Array, member: Array, k: Array) -> Array:
+def random_subset_mask(
+    rng: Array, member: Array, k: Array, k_max: int | None = None
+) -> Array:
     """Uniformly choose min(k, member.sum()) elements of a masked set.
 
     Args:
       rng: PRNG key.
       member: [N] bool — the candidate set.
       k: scalar int (python or traced) — max elements to keep.
+      k_max: optional STATIC upper bound on ``k``. When given, the cut
+        point comes from ``lax.top_k(score, k_max)`` instead of a full
+        descending sort — on TPU a top-256 over 90k anchors is far
+        cheaper than sorting all 90k (the two full sorts were the bulk
+        of anchor_targets' 10.4 ms at the FPN anchor count). Same
+        selection: both find the kk-th largest score. A concrete
+        ``k > k_max`` raises; a traced ``k`` is clamped to ``k_max``
+        (the bound is the caller's contract).
 
     Returns: [N] bool mask, a uniform random subset of ``member`` with
     ``min(k, member.sum())`` True entries.
     """
     r = jax.random.uniform(rng, member.shape)
     score = jnp.where(member, r, -jnp.inf)
-    order = jnp.sort(score)[::-1]  # descending
     n_member = jnp.sum(member)
     kk = jnp.minimum(jnp.asarray(k, jnp.int32), n_member.astype(jnp.int32))
+    if k_max is not None:
+        if isinstance(k, int) and k > k_max:
+            raise ValueError(f"k={k} exceeds the static bound k_max={k_max}")
+        if k_max <= 0:
+            return jnp.zeros_like(member)
+        kk = jnp.minimum(kk, k_max)
+        top = jax.lax.top_k(score, min(int(k_max), member.shape[-1]))[0]
+    else:
+        top = jnp.sort(score)[::-1]  # descending
     # kk-th largest score is the cut; kk == 0 keeps nothing.
-    cut = order[jnp.maximum(kk - 1, 0)]
+    cut = top[jnp.maximum(kk - 1, 0)]
     return member & (score >= cut) & (kk > 0)
 
 

@@ -66,6 +66,39 @@ class TestRandomSubset:
         keep = f(jax.random.PRNGKey(1), jnp.ones(50, bool), jnp.asarray(7))
         assert int(keep.sum()) == 7
 
+    def test_k_max_matches_full_sort(self):
+        # the static-bound top_k cut must select the identical subset the
+        # full-sort cut does (same kk-th-largest value, same rng draw)
+        for seed in range(20):
+            rng = jax.random.PRNGKey(seed)
+            member = jax.random.bernoulli(jax.random.fold_in(rng, 1), 0.3, (500,))
+            k = int(jax.random.randint(jax.random.fold_in(rng, 2), (), 0, 40))
+            a = random_subset_mask(rng, member, k)
+            b = random_subset_mask(rng, member, k, k_max=64)
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_k_max_with_traced_budget(self):
+        @jax.jit
+        def f(rng, member, budget):
+            return random_subset_mask(rng, member, budget, k_max=16)
+
+        keep = f(jax.random.PRNGKey(1), jnp.ones(50, bool), jnp.asarray(7))
+        assert int(keep.sum()) == 7
+
+    def test_k_max_zero_keeps_nothing(self):
+        keep = random_subset_mask(
+            jax.random.PRNGKey(0), jnp.ones(16, bool), 0, k_max=0
+        )
+        assert int(keep.sum()) == 0
+
+    def test_k_max_exceeded_raises(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            random_subset_mask(
+                jax.random.PRNGKey(0), jnp.ones(16, bool), 10, k_max=4
+            )
+
     def test_uniform_coverage(self):
         member = jnp.ones(20, bool)
         counts = np.zeros(20)
