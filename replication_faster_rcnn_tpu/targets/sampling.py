@@ -43,8 +43,8 @@ def random_subset_mask(
     n_member = jnp.sum(member)
     kk = jnp.minimum(jnp.asarray(k, jnp.int32), n_member.astype(jnp.int32))
     if k_max is not None:
-        if isinstance(k, int) and k > k_max:
-            raise ValueError(f"k={k} exceeds the static bound k_max={k_max}")
+        if not isinstance(k, jax.core.Tracer) and int(k) > k_max:
+            raise ValueError(f"k={int(k)} exceeds the static bound k_max={k_max}")
         if k_max <= 0:
             return jnp.zeros_like(member)
         kk = jnp.minimum(kk, k_max)
