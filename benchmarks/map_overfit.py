@@ -50,6 +50,13 @@ def main() -> None:
                     help="compute dtype: float32 on CPU, bfloat16 on TPU")
     ap.add_argument("--workdir", default="/tmp/map_overfit_ckpts")
     ap.add_argument(
+        "--config", default="voc_resnet18",
+        choices=["voc_resnet18", "voc_resnet50_fpn"],
+        help="preset to train: the flagship, or the FPN config (#3 in "
+        "BASELINE) — FPN keeps its per-level single anchor scale, so "
+        "--anchor-scales should be ONE value (e.g. 2 -> 8..128 px over "
+        "strides 4..64, matching small planted objects)")
+    ap.add_argument(
         "--anchor-scales", type=float, nargs="+", default=[1.0, 2.0, 4.0],
         help="anchor scales x base 16 px. The VOC default (8,16,32) targets "
         "600x600 objects; at this script's small image sizes those anchors "
@@ -59,10 +66,8 @@ def main() -> None:
     args = ap.parse_args()
 
     from replication_faster_rcnn_tpu.config import (
-        AnchorConfig,
         DataConfig,
         MeshConfig,
-        ModelConfig,
         TrainConfig,
         get_config,
     )
@@ -70,11 +75,25 @@ def main() -> None:
     from replication_faster_rcnn_tpu.eval import Evaluator
     from replication_faster_rcnn_tpu.train.trainer import Trainer
 
+    import dataclasses
+
     size = (args.image_size, args.image_size)
-    cfg = get_config("voc_resnet18").replace(
-        anchors=AnchorConfig(scales=tuple(args.anchor_scales)),
-        model=ModelConfig(
-            backbone="resnet18", roi_op="align", compute_dtype=args.dtype
+    base = get_config(args.config)
+    if base.model.fpn and len(args.anchor_scales) != 1:
+        ap.error(
+            "FPN uses one anchor scale per level (the preset's "
+            f"scales={base.anchors.scales}); pass exactly one "
+            f"--anchor-scales value, got {args.anchor_scales}"
+        )
+    # replace() so every preset field not explicitly overridden is kept —
+    # rebuilding the config dataclasses from scratch would silently reset
+    # preset-specific fields (num_classes, fpn_channels, ...) to defaults
+    cfg = base.replace(
+        anchors=dataclasses.replace(
+            base.anchors, scales=tuple(args.anchor_scales)
+        ),
+        model=dataclasses.replace(
+            base.model, roi_op="align", compute_dtype=args.dtype
         ),
         data=DataConfig(dataset="synthetic", image_size=size, max_boxes=8),
         train=TrainConfig(
@@ -99,7 +118,10 @@ def main() -> None:
 
     train_ds = SyntheticDataset(cfg.data, "train", length=args.images)
     trainer = Trainer(cfg, workdir=args.workdir, dataset=train_ds)
-    curve_path = os.path.join(REPO, "benchmarks", "map_overfit_curve.jsonl")
+    suffix = "" if args.config == "voc_resnet18" else "_fpn"
+    curve_path = os.path.join(
+        REPO, "benchmarks", f"map_overfit_curve{suffix}.jsonl"
+    )
     if os.path.exists(curve_path):
         os.remove(curve_path)
     trainer.logger.jsonl_path = curve_path
@@ -142,6 +164,7 @@ def main() -> None:
         "train_set_mAP": train_map,
         "restored_step": restored_step,
         "restored_val_mAP": restored_map,
+        "config": args.config,
         "epochs": args.epochs,
         "images": args.images,
         "image_size": args.image_size,
@@ -151,7 +174,9 @@ def main() -> None:
         "train_seconds": round(train_s, 1),
         "backend": __import__("jax").default_backend(),
     }
-    out_path = os.path.join(REPO, "benchmarks", "map_overfit_result.json")
+    out_path = os.path.join(
+        REPO, "benchmarks", f"map_overfit_result{suffix}.json"
+    )
     with open(out_path, "w") as f:
         json.dump(result, f, indent=2)
     print(json.dumps(result))

@@ -119,20 +119,23 @@ def _cpu_fallback(reason: str, config=None) -> None:
             raise RuntimeError(f"fallback produced no throughput: {obj}")
         obj["fallback_backend"] = "cpu"
         obj["fallback_reason"] = reason
-        obj["last_recorded_tpu"] = _last_recorded_tpu()
+        obj["last_recorded_tpu"] = _last_recorded_tpu(obj.get("metric", _METRIC))
         print(json.dumps(obj), flush=True)
         os._exit(0)
     except Exception as e:  # noqa: BLE001 — any failure -> the 0.0 record
         _wedge_exit(f"{reason}; cpu fallback failed: {e!r}")
 
 
-def _last_recorded_tpu():
-    """Most recent committed on-chip measurement matching the current
-    metric (benchmarks/bench_v5e_round2.json) — latest by its "measured"
-    ISO timestamp; the record's "config" says which model it was. A
-    CPU-fallback line carries this so the reader still sees the real
-    hardware number. Returns None when no matching record exists — the
-    field is informational only."""
+def _last_recorded_tpu(metric=None):
+    """Most recent committed on-chip measurement matching ``metric``
+    (default: the current _METRIC) from benchmarks/bench_v5e_round2.json
+    — latest by its "measured" ISO timestamp; the record's "config" says
+    which model it was. A CPU-fallback line carries this (keyed on the
+    metric the fallback child actually measured) so the reader still
+    sees the real hardware number. Returns None when no matching record
+    exists — the field is informational only."""
+    if metric is None:
+        metric = _METRIC
     try:
         path = os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -143,7 +146,7 @@ def _last_recorded_tpu():
             data = json.load(f)
         best = None
         for rec in data.get("records", []):
-            if rec.get("metric", data.get("metric")) != _METRIC:
+            if rec.get("metric", data.get("metric")) != metric:
                 continue
             if best is None or rec.get("measured", "") > best.get("measured", ""):
                 best = rec

@@ -190,10 +190,12 @@ class TestBenchWatchdog:
         assert line["value"] > 0
         assert line["metric"] == "train_images_per_sec_64x64"
         assert "error" not in line
-        # informational pointer to the committed on-chip record (None for
-        # this 64x64 metric — no such record exists; the key must still be
-        # present so the driver line documents the lookup happened)
+        # informational pointer, keyed on the metric the fallback child
+        # actually measured: 64x64 has no committed on-chip record, so the
+        # key must be present AND null (a 600x600 record here would be a
+        # hardware number attached to the wrong shape)
         assert "last_recorded_tpu" in line
+        assert line["last_recorded_tpu"] is None
 
     def test_last_recorded_tpu_lookup(self):
         """The fallback line's pointer resolves the LATEST committed v5e
