@@ -90,10 +90,12 @@ def test_shard_batch_placement():
     assert len(arr.sharding.device_set) == 8
 
 
-def test_dp8_matches_single_device():
-    """Same batch, same init: one step on a 1-device mesh and on an 8-device
-    data-parallel mesh must produce the same loss and the same updated
-    params (the jit auto-partitioned psum must be semantics-preserving)."""
+def _assert_dp8_matches_single_device(cfg_for, npos_key):
+    """Shared scaffold: same batch, same init, one step on a 1-device mesh
+    and on an 8-device data-parallel mesh must produce the same loss and
+    the same updated params (the jit auto-partitioned psum must be
+    semantics-preserving). ``cfg_for(n_data)`` builds the config;
+    ``npos_key`` picks which sampling-count metric to compare."""
     ds = SyntheticDataset(
         DataConfig(dataset="synthetic", image_size=(64, 64), max_boxes=8), length=8
     )
@@ -101,7 +103,7 @@ def test_dp8_matches_single_device():
 
     results = {}
     for n in (1, 8):
-        cfg = _cfg(n)
+        cfg = cfg_for(n)
         mesh = make_mesh(cfg.mesh)
         tx, _ = make_optimizer(cfg, steps_per_epoch=10)
         model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
@@ -112,7 +114,7 @@ def test_dp8_matches_single_device():
         results[n] = (
             float(metrics["loss"]),
             np.asarray(jax.device_get(jax.tree_util.tree_leaves(new_state.params)[0])),
-            float(metrics["n_pos_rpn"]),
+            float(metrics[npos_key]),
         )
 
     loss1, p1, npos1 = results[1]
@@ -120,6 +122,32 @@ def test_dp8_matches_single_device():
     assert npos1 == npos8  # identical RNG -> identical target sampling
     np.testing.assert_allclose(loss1, loss8, rtol=1e-5)
     np.testing.assert_allclose(p1, p8, rtol=1e-4, atol=1e-6)
+
+
+def test_dp8_matches_single_device():
+    _assert_dp8_matches_single_device(_cfg, "n_pos_rpn")
+
+
+def test_fpn_dp8_matches_single_device():
+    """FPN variant of the DP equivalence check: the multi-level proposal
+    path and the flat level-offset ROIAlign gather (models/fpn.py) must be
+    semantics-preserving under batch sharding — each image's flat indices
+    only address its own [sum(Hl*Wl), C] row block, so the gather never
+    crosses the sharded batch axis."""
+    from replication_faster_rcnn_tpu.config import AnchorConfig
+
+    def cfg_for(n):
+        return FasterRCNNConfig(
+            model=ModelConfig(
+                backbone="resnet18", fpn=True, compute_dtype="float32"
+            ),
+            anchors=AnchorConfig(scales=(8.0,)),
+            data=DataConfig(dataset="synthetic", image_size=(64, 64), max_boxes=8),
+            train=TrainConfig(batch_size=8),
+            mesh=MeshConfig(num_data=n),
+        )
+
+    _assert_dp8_matches_single_device(cfg_for, "n_pos_head")
 
 
 def test_spatial_partition_matches_single_device():
