@@ -94,11 +94,11 @@ def _assert_dp8_matches_single_device(cfg_for, npos_key):
     """Shared scaffold: same batch, same init, one step on a 1-device mesh
     and on an 8-device data-parallel mesh must produce the same loss and
     the same updated params (the jit auto-partitioned psum must be
-    semantics-preserving). ``cfg_for(n_data)`` builds the config;
-    ``npos_key`` picks which sampling-count metric to compare."""
-    ds = SyntheticDataset(
-        DataConfig(dataset="synthetic", image_size=(64, 64), max_boxes=8), length=8
-    )
+    semantics-preserving). ``cfg_for(n_data)`` builds the config (its
+    DataConfig also drives the synthetic batch, so variants can change
+    shapes freely); ``npos_key`` picks which sampling-count metric to
+    compare."""
+    ds = SyntheticDataset(cfg_for(1).data, length=8)
     batch = collate([ds[i] for i in range(8)])
 
     results = {}
