@@ -119,21 +119,45 @@ def _cpu_fallback(reason: str, config=None) -> None:
             raise RuntimeError(f"fallback produced no throughput: {obj}")
         obj["fallback_backend"] = "cpu"
         obj["fallback_reason"] = reason
-        obj["last_recorded_tpu"] = _last_recorded_tpu(obj.get("metric", _METRIC))
+        obj["last_recorded_tpu"] = _last_recorded_tpu(
+            obj.get("metric", _METRIC), _config_token(config)
+        )
         print(json.dumps(obj), flush=True)
         os._exit(0)
     except Exception as e:  # noqa: BLE001 — any failure -> the 0.0 record
         _wedge_exit(f"{reason}; cpu fallback failed: {e!r}")
 
 
-def _last_recorded_tpu(metric=None):
+def _config_token(config):
+    """Identity token for the benched model, used to match committed
+    on-chip records (whose "config" strings start with the preset name,
+    e.g. "voc_resnet50_fpn 600x600 batch 8 ..."). Resolves the preset by
+    comparing model sections; falls back to a backbone-derived token for
+    non-preset configs. None config means the flagship bench default."""
+    if config is None:
+        return "voc_resnet18"
+    try:
+        from replication_faster_rcnn_tpu.config import CONFIGS
+
+        for name, preset in CONFIGS.items():
+            if preset.model == config.model:
+                return name
+        return config.model.backbone + ("_fpn" if config.model.fpn else "")
+    except Exception:  # noqa: BLE001 — informational only
+        return None
+
+
+def _last_recorded_tpu(metric=None, config_token=None):
     """Most recent committed on-chip measurement matching ``metric``
-    (default: the current _METRIC) from benchmarks/bench_v5e_round2.json
-    — latest by its "measured" ISO timestamp; the record's "config" says
-    which model it was. A CPU-fallback line carries this (keyed on the
-    metric the fallback child actually measured) so the reader still
-    sees the real hardware number. Returns None when no matching record
-    exists — the field is informational only."""
+    (default: the current _METRIC) from benchmarks/bench_v5e_round2.json.
+    Prefers a record for the same model (``config_token`` == first word
+    of the record's "config" string); only if none exists does it fall
+    back to the latest record for the metric regardless of model, with
+    "same_config": false so a hardware number can't be silently
+    misattributed to a different config. A CPU-fallback line carries
+    this (keyed on the metric the fallback child actually measured) so
+    the reader still sees the real hardware number. Returns None when no
+    matching record exists — the field is informational only."""
     if metric is None:
         metric = _METRIC
     try:
@@ -144,27 +168,50 @@ def _last_recorded_tpu(metric=None):
         )
         with open(path) as f:
             data = json.load(f)
-        best = None
+        best = best_same = None
         for rec in data.get("records", []):
             if rec.get("metric", data.get("metric")) != metric:
                 continue
             if best is None or rec.get("measured", "") > best.get("measured", ""):
                 best = rec
-        if best is not None:
-            return {
-                "value": best.get("value"),
-                "vs_baseline": best.get("vs_baseline"),
-                "config": best.get("config"),
-                "measured": best.get("measured"),
+            rec_token = (rec.get("config") or "").split(" ")[0]
+            if config_token is not None and rec_token == config_token:
+                if best_same is None or rec.get("measured", "") > best_same.get(
+                    "measured", ""
+                ):
+                    best_same = rec
+        chosen = best_same if best_same is not None else best
+        if chosen is not None:
+            out = {
+                "value": chosen.get("value"),
+                "vs_baseline": chosen.get("vs_baseline"),
+                "config": chosen.get("config"),
+                "measured": chosen.get("measured"),
+                "same_config": chosen is best_same,
             }
+            if chosen.get("provenance"):
+                out["provenance"] = chosen["provenance"]
+            return out
     except Exception:  # noqa: BLE001 — informational; never break the line
         return None
     return None
 
 
+_fallback_lock = threading.Lock()
+_fallback_started = False
+
+
 def _maybe_fallback(reason: str, config=None) -> None:
     """Wedge handler: CPU-subprocess fallback unless this process IS the
-    fallback child (BENCH_NO_FALLBACK=1 — then report the 0.0)."""
+    fallback child (BENCH_NO_FALLBACK=1 — then report the 0.0). Runs at
+    most once per process: the probe-retry path and the watchdog can
+    both reach it, and a second concurrent fallback child would race the
+    first to stdout."""
+    global _fallback_started
+    with _fallback_lock:
+        if _fallback_started:
+            return
+        _fallback_started = True
     if os.environ.get("BENCH_NO_FALLBACK") == "1":
         _wedge_exit(reason)
     _cpu_fallback(reason, config)
@@ -193,23 +240,103 @@ def _arm_watchdog(config=None) -> threading.Timer:
     return t
 
 
-def _probe_device(config=None) -> None:
-    """Fail fast if the device tunnel is already wedged.
+def _relay_alive():
+    """Liveness of this image's remote-TPU relay process — cheap (no RPC
+    traffic against the fragile tunnel). Returns None when undeterminable
+    (no pgrep, or a host without the relay script at all — there a dead
+    "relay" must not suppress re-probing, since no orchestrator will ever
+    start one), True/False otherwise."""
+    import subprocess
 
-    A wedged remote-TPU service blocks even a trivial op forever, and a
-    blocked device call cannot be interrupted from Python — so a short
-    side watchdog reports the wedge (or launches the CPU fallback) in
-    minutes instead of burning the full measurement budget before saying
-    anything.
+    if not os.path.exists("/root/.relay.py"):
+        return None
+    try:
+        r = subprocess.run(
+            ["pgrep", "-f", "[r]elay.py"], capture_output=True, timeout=10
+        )
+        return r.returncode == 0
+    except Exception:  # noqa: BLE001 — treat as unknown
+        return None
+
+
+def _probe_subprocess(timeout_s: float) -> bool:
+    """Run one trivial device op in a fresh subprocess under the caller's
+    environment. A healthy tunnel answers in seconds; a dead one errors
+    fast (connection refused) or blocks until the timeout. Probing in a
+    subprocess keeps this process's backend un-poisoned: an in-process op
+    against a wedged tunnel blocks forever and cannot be interrupted."""
+    import subprocess
+    import sys
+
+    code = "import jax, jax.numpy as jnp; jax.device_get(jnp.ones((8, 128)).sum())"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s,
+            capture_output=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _probe_device(config=None) -> None:
+    """Fail fast if the device tunnel is already wedged — but give a
+    *recovering* relay a chance first.
+
+    Stage 1: a subprocess probe (budget BENCH_PROBE_S, default 180s).
+    Success means the tunnel answers; proceed to warm this process's
+    backend (still under a side watchdog — the tunnel can die between
+    the probe and the op).
+
+    Stage 2 (new, VERDICT r2 item 3): if the probe fails, re-probe for up
+    to BENCH_PROBE_RETRIES_S (default 420s, 0 disables) every
+    BENCH_PROBE_RETRY_INTERVAL_S (default 30s) — two earlier rounds lost
+    the official number to a relay that was dead at bench time but could
+    have been restored minutes later by the orchestrator. Device probes
+    are only issued while the relay process exists (`pgrep`), so a
+    relay-less wait adds no RPC load; when relay liveness is
+    undeterminable the probe itself is the check.
+
+    Only then fall back to the CPU measurement.
     """
+    import time
+
     import jax.numpy as jnp
 
     budget = float(os.environ.get("BENCH_PROBE_S", "180"))
+    if not _probe_subprocess(budget):
+        window = float(os.environ.get("BENCH_PROBE_RETRIES_S", "420"))
+        interval = float(os.environ.get("BENCH_PROBE_RETRY_INTERVAL_S", "30"))
+        deadline = time.monotonic() + window
+        recovered = False
+        while time.monotonic() < deadline:
+            time.sleep(max(1.0, interval))
+            alive = _relay_alive()
+            if alive is False:
+                continue  # no relay process — don't load the tunnel
+            if _probe_subprocess(budget):
+                recovered = True
+                break
+        if not recovered:
+            _maybe_fallback(
+                f"probe: device unresponsive >{budget:.0f}s and no recovery "
+                f"within the {window:.0f}s retry window (tunnel wedged/dead "
+                "at start)",
+                config,
+            )
+            # _maybe_fallback returning means another thread (watchdog) is
+            # already measuring the fallback; park until it exits the
+            # process rather than poisoning this one on a dead backend.
+            threading.Event().wait()
+    # warm the in-process backend under a side timer: the tunnel can wedge
+    # between the subprocess probe succeeding and this eager op
     t = threading.Timer(
         budget,
         lambda: _maybe_fallback(
-            f"probe: device unresponsive >{budget:.0f}s before compile "
-            "(tunnel wedged at start)",
+            f"probe: in-process device op blocked >{budget:.0f}s after a "
+            "successful subprocess probe (tunnel wedged mid-start)",
             config,
         ),
     )

@@ -228,6 +228,30 @@ class TestBenchWatchdog:
         finally:
             benchmark._METRIC = old
 
+    def test_last_recorded_tpu_prefers_same_config(self):
+        """A record for the benched model wins over a newer record for a
+        different model; off-preset tokens degrade to the latest record
+        with same_config=False (ADVICE r2: a CPU-fallback line must not
+        attribute another config's hardware number to this one)."""
+        from replication_faster_rcnn_tpu import benchmark
+
+        metric = "train_images_per_sec_600x600"
+        rec = benchmark._last_recorded_tpu(metric, "coco_vgg16")
+        assert rec["same_config"] is True
+        assert rec["config"].split(" ")[0] == "coco_vgg16"
+        rec2 = benchmark._last_recorded_tpu(metric, "no_such_preset")
+        assert rec2 is not None and rec2["same_config"] is False
+
+    def test_config_token(self):
+        """Preset resolution for the record-matching token."""
+        from replication_faster_rcnn_tpu import benchmark
+        from replication_faster_rcnn_tpu.config import get_config
+
+        assert benchmark._config_token(None) == "voc_resnet18"
+        assert benchmark._config_token(get_config("coco_vgg16")) == "coco_vgg16"
+        fpn = benchmark._config_token(get_config("voc_resnet50_fpn"))
+        assert fpn == "voc_resnet50_fpn"
+
 
 class TestTrainSmoke:
     def test_bounded_steps(self, tmp_path, capsys):
