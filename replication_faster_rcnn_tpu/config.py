@@ -188,6 +188,13 @@ class DataConfig:
     # ImageNet normalization (reference utils/data_loader.py:38)
     pixel_mean: Tuple[float, float, float] = (0.485, 0.456, 0.406)
     pixel_std: Tuple[float, float, float] = (0.229, 0.224, 0.225)
+    # host input pipeline (replaces the reference's torch DataLoader,
+    # frcnn.py:19-23): worker count and kind. "thread" scales the
+    # GIL-releasing native decode; "process" (fork) scales GIL-bound
+    # Python sample work across cores
+    loader_workers: int = 4
+    loader_mode: str = "thread"  # thread | process
+    loader_prefetch: int = 2
 
 
 @dataclasses.dataclass(frozen=True)

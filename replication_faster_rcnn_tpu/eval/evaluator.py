@@ -88,7 +88,9 @@ class Evaluator:
             variables = jax.device_put(variables, rep_sharding)
         loader = DataLoader(
             dataset, batch_size=batch_size, shuffle=False, drop_last=False,
-            prefetch=2,
+            prefetch=self.config.data.loader_prefetch,
+            num_workers=self.config.data.loader_workers,
+            worker_mode=self.config.data.loader_mode,
         )
         detections: List[Dict[str, np.ndarray]] = []
         gts: List[Dict[str, np.ndarray]] = []

@@ -57,6 +57,9 @@ class FRCNN:
         return DataLoader(
             dataset, batch_size=batch_size, shuffle=shuffle,
             seed=cfg.train.seed,
+            prefetch=cfg.data.loader_prefetch,
+            num_workers=cfg.data.loader_workers,
+            worker_mode=cfg.data.loader_mode,
         )
 
     def get_network(self) -> Tuple[object, dict]:

@@ -107,6 +107,9 @@ class Trainer:
             batch_size=config.train.batch_size,
             shuffle=True,
             seed=config.train.seed,
+            prefetch=config.data.loader_prefetch,
+            num_workers=config.data.loader_workers,
+            worker_mode=config.data.loader_mode,
         )
         steps_per_epoch = max(len(self.loader), 1)
         self.tx, self.schedule = make_optimizer(config, steps_per_epoch)

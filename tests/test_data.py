@@ -90,6 +90,40 @@ class TestLoader:
         b = collate([ds[0], ds[1]])
         assert b["labels"].shape == (2, 8)
 
+    def test_process_mode_matches_thread_mode(self):
+        """Fork-worker batches must be bit-identical AND in the same
+        deterministic order as the in-process path (resume reproducibility
+        cannot depend on which worker finishes first)."""
+        ds = SyntheticDataset(_cfg(), length=12)
+        kw = dict(batch_size=4, shuffle=True, seed=3, prefetch=2)
+        ref = list(DataLoader(ds, **kw))
+        got = list(
+            DataLoader(ds, num_workers=2, worker_mode="process", **kw)
+        )
+        assert len(got) == len(ref) == 3
+        for a, b in zip(ref, got):
+            for k in a:
+                np.testing.assert_array_equal(a[k], b[k])
+
+    def test_process_mode_error_propagates(self):
+        class Bad:
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                raise ValueError("kaboom")
+
+        loader = DataLoader(
+            Bad(), batch_size=2, shuffle=False, num_workers=2,
+            worker_mode="process",
+        )
+        with pytest.raises(RuntimeError, match="kaboom"):
+            list(loader)
+
+    def test_worker_mode_validated(self):
+        with pytest.raises(ValueError, match="worker_mode"):
+            DataLoader(SyntheticDataset(_cfg(), length=2), 2, worker_mode="x")
+
 
 def _write_voc(root, ids, difficult_flags=None):
     from PIL import Image
