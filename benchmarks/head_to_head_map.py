@@ -1,0 +1,347 @@
+"""Head-to-head mAP: the PyTorch reference vs this framework, same data.
+
+VERDICT r2 missing item #1: until now the mAP parity case was
+ingredient-parity plus our-model-only overfits — nobody had ever scored
+the reference's own trained output. This script closes that: it trains
+the REFERENCE trainer (`/root/reference/train.py:153-161`, run verbatim
+through `benchmarks/reference_baseline.py`'s dependency stand-ins) on the
+exact planted-rectangle synthetic dataset `benchmarks/map_overfit.py`
+uses, decodes its head outputs with the reference's own `reg2bbox`
+semantics, and scores BOTH models' detections with the same evaluator
+(`eval/voc_eval.voc_ap`).
+
+Fairness provisions for the reference:
+  * identical images/boxes/labels, identical train/val splits (our
+    `SyntheticDataset` streams, converted to the reference's sample
+    format: CHW tensors, (y1,x1,y2,x2) boxes padded with -1 — the same
+    layout its own `utils/data_loader.py:56-117` emits);
+  * the same small-object anchor scales our overfit run uses (its
+    default 128-512 px anchors dwarf every planted object at 128 px
+    images; `RPN.base_anchor` is rebuilt with the reference's own
+    `generate_anchor_base`);
+  * its own hyperparameters where it has them (Adam + weight_decay 5e-6,
+    cosine schedule per `train.py:139-140`) with the lr chosen by a
+    short sweep rather than its VOC default (0.01 diverges here);
+  * decode uses its train-mode proposal budget (600 rois) — more
+    proposals than our eval path keeps, never fewer.
+
+The reference has no decode/eval path of its own (`test_eval.py` is
+empty), so the decode glue below is written in THIS repo's style against
+the reference's conventions: class-c deltas un-normalized by the
+ProposalTargetCreator std (0.1, 0.1, 0.2, 0.2) (`utils/utils.py:216`),
+boxes via its `reg2bbox`, per-class NMS at 0.3, score > 0.05.
+
+Writes benchmarks/head_to_head_map.json with {ours, reference} blocks.
+
+Run: python benchmarks/head_to_head_map.py [--epochs N] [--images N]
+     (add --skip-ours to reuse a committed map_overfit result)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = "/root/reference"
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _reference_samples(ds):
+    """Convert our SyntheticDataset samples to the reference's format.
+
+    Ours: image HWC float32 normalized (same ImageNet mean/std the
+    reference's transform applies), boxes (y1,x1,y2,x2) float padded -1,
+    labels int padded -1 — semantically identical content, so the
+    conversion is a transpose plus dtype casts.
+    """
+    import numpy as np
+    import torch
+
+    out = []
+    for i in range(len(ds)):
+        s = ds[i]
+        image = torch.as_tensor(s["image"].transpose(2, 0, 1))[None]  # [1,C,H,W]
+        boxes = np.full((1, s["boxes"].shape[0], 4), -1.0, np.float32)
+        labels = np.full((1, s["labels"].shape[0]), -1.0, np.float32)
+        m = s["labels"] >= 0
+        boxes[0, m] = s["boxes"][m]
+        labels[0, m] = s["labels"][m].astype(np.float32)
+        out.append((image, boxes, labels))
+    return out
+
+
+def _gt_list(ds):
+    import numpy as np
+
+    gts = []
+    for i in range(len(ds)):
+        s = ds[i]
+        m = s["labels"] >= 0
+        gts.append(
+            {
+                "boxes": np.asarray(s["boxes"][m], np.float32),
+                "labels": np.asarray(s["labels"][m], np.int32),
+            }
+        )
+    return gts
+
+
+def _decode_reference(net, image, score_thresh=0.05, nms_iou=0.3, max_det=100):
+    """Detections from the reference net on one image, its conventions.
+
+    Returns {'boxes' [D,4] (y1,x1,y2,x2), 'scores' [D], 'classes' [D]}.
+    """
+    import numpy as np
+    import torch
+
+    from replication_faster_rcnn_tpu.data import native_ops
+    from utils.utils import reg2bbox  # the reference's own decode
+
+    _, _, img_h, img_w = image.shape
+    with torch.no_grad():
+        features = net.backbone(image.float())
+        # rpn takes (width, height) per train.py:65
+        _, _, rois, roi_inds, _ = net.rpn(features, img_w, img_h)
+        cls_out, reg_out = net.head(features, rois, roi_inds, img_h, img_w)
+        # cls_out [1, 21, R], reg_out [1, R, 21*4]
+        probs = torch.softmax(cls_out[0], dim=0).numpy()  # [21, R]
+        reg = reg_out[0].numpy()  # [R, 84]
+        rois_np = rois.numpy()  # [R, 4]
+
+    # ProposalTargetCreator normalizes reg targets by this std
+    # (utils/utils.py:216); invert it before reg2bbox
+    std = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    boxes_all, scores_all, classes_all = [], [], []
+    n_classes = probs.shape[0]
+    for c in range(1, n_classes):
+        deltas = torch.as_tensor(reg[:, 4 * c : 4 * c + 4] * std)
+        bbox = reg2bbox(torch.as_tensor(rois_np), deltas).numpy()
+        bbox[:, 0::2] = np.clip(bbox[:, 0::2], 0, img_h)
+        bbox[:, 1::2] = np.clip(bbox[:, 1::2], 0, img_w)
+        score = probs[c]
+        keep = score > score_thresh
+        if not keep.any():
+            continue
+        b, s = bbox[keep], score[keep]
+        order = native_ops.nms(b, s, float(nms_iou))
+        boxes_all.append(b[order])
+        scores_all.append(s[order])
+        classes_all.append(np.full(len(order), c, np.int32))
+    if not boxes_all:
+        return {
+            "boxes": np.zeros((0, 4), np.float32),
+            "scores": np.zeros((0,), np.float32),
+            "classes": np.zeros((0,), np.int32),
+        }
+    boxes = np.concatenate(boxes_all)
+    scores = np.concatenate(scores_all)
+    classes = np.concatenate(classes_all)
+    order = np.argsort(-scores)[:max_det]
+    return {"boxes": boxes[order], "scores": scores[order], "classes": classes[order]}
+
+
+def _batch(samples, batch_size):
+    """Group per-image reference samples into train_step batches (the
+    reference's own DataLoader default is batch 2, frcnn.py:19)."""
+    import numpy as np
+    import torch
+
+    out = []
+    for i in range(0, len(samples), batch_size):
+        chunk = samples[i : i + batch_size]
+        out.append(
+            (
+                torch.cat([c[0] for c in chunk], dim=0),
+                np.concatenate([c[1] for c in chunk], axis=0),
+                np.concatenate([c[2] for c in chunk], axis=0),
+            )
+        )
+    return out
+
+
+def _train_reference(samples, epochs, lr, anchor_scales, log_every=20):
+    """Build the reference trainer and run its own train_step over the
+    sample list for `epochs` passes, with its published optimizer recipe
+    (train.py:139-140: Adam + wd 5e-6 + cosine)."""
+    import numpy as np
+    import torch
+
+    from benchmarks.reference_baseline import _install_stubs, _prepare_workdir
+
+    _install_stubs()
+    tmp = "/tmp/head_to_head_ref_workdir"
+    os.makedirs(tmp, exist_ok=True)
+    cwd = os.getcwd()
+    os.chdir(tmp)
+    try:
+        _prepare_workdir(tmp)
+        from train import trainer  # the reference trainer
+
+        torch.manual_seed(0)
+        np.random.seed(0)
+        t = trainer()
+        # small-object anchors, built with the reference's own generator
+        # (its VOC default 128-512 px anchors cannot match 16-64 px
+        # planted objects at these image sizes — same adjustment our
+        # overfit run makes via --anchor-scales)
+        from utils.anchors import generate_anchor_base
+
+        t.model.net.rpn.base_anchor = generate_anchor_base(
+            ratios=[0.5, 1.0, 2.0], anchor_scales=list(anchor_scales)
+        )
+        t.optimizer = torch.optim.Adam(
+            t.model.net.parameters(), lr=lr, weight_decay=5e-6
+        )
+        scheduler = torch.optim.lr_scheduler.CosineAnnealingLR(t.optimizer, epochs)
+
+        import contextlib
+        import io
+
+        t.model.net.train()
+        step = 0
+        for ep in range(epochs):
+            for image, boxes, labels in samples:
+                # train_step prints five loss lines per call; keep the log
+                # readable by sampling them
+                buf = io.StringIO()
+                with contextlib.redirect_stdout(buf):
+                    t.train_step(image, boxes, labels)
+                if step % log_every == 0:
+                    first = buf.getvalue().splitlines()[:1]
+                    print(f"ref epoch {ep} step {step}: {first[0] if first else ''}")
+                    sys.stdout.flush()
+                step += 1
+            scheduler.step()
+        t.model.net.eval()
+        return t
+    finally:
+        os.chdir(cwd)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=60)
+    ap.add_argument("--images", type=int, default=48)
+    ap.add_argument("--val-images", type=int, default=256)
+    ap.add_argument("--image-size", type=int, default=128)
+    ap.add_argument("--ref-lr", type=float, default=3e-4)
+    ap.add_argument("--ref-batch", type=int, default=2)
+    ap.add_argument("--anchor-scales", type=float, nargs="+", default=[1.0, 2.0, 4.0])
+    ap.add_argument(
+        "--skip-ours",
+        action="store_true",
+        help="reuse benchmarks/map_overfit_result.json for our side "
+        "(same dataset parameters) instead of retraining",
+    )
+    ap.add_argument("--ref-only", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    from replication_faster_rcnn_tpu.config import DataConfig
+    from replication_faster_rcnn_tpu.data import SyntheticDataset
+    from replication_faster_rcnn_tpu.eval.voc_eval import voc_ap
+
+    size = (args.image_size, args.image_size)
+    dcfg = DataConfig(dataset="synthetic", image_size=size, max_boxes=8)
+    train_ds = SyntheticDataset(dcfg, "train", length=args.images)
+    val_ds = SyntheticDataset(dcfg, "val", length=args.val_images)
+
+    # ---- reference: train + decode + score
+    ref_samples = _batch(_reference_samples(train_ds), args.ref_batch)
+    t0 = time.time()
+    t = _train_reference(ref_samples, args.epochs, args.ref_lr, args.anchor_scales)
+    ref_train_s = time.time() - t0
+
+    import torch
+
+    def ref_score(ds):
+        dets = [
+            _decode_reference(
+                t.model.net,
+                torch.as_tensor(ds[i]["image"].transpose(2, 0, 1))[None],
+            )
+            for i in range(len(ds))
+        ]
+        return float(voc_ap(dets, _gt_list(ds), num_classes=21)["mAP"])
+
+    ref_train_map = ref_score(train_ds)
+    ref_val_map = ref_score(val_ds)
+
+    result = {
+        "data": {
+            "images": args.images,
+            "val_images": args.val_images,
+            "image_size": args.image_size,
+            "epochs": args.epochs,
+            "dataset": "planted-rectangle synthetic (data/synthetic.py), "
+            "identical streams for both frameworks",
+        },
+        "reference": {
+            "train_set_mAP@0.5": ref_train_map,
+            "val_mAP@0.5": ref_val_map,
+            "lr": args.ref_lr,
+            "batch": args.ref_batch,
+            "optimizer": "Adam wd=5e-6 + cosine (reference train.py:139-140)",
+            "anchor_scales": args.anchor_scales,
+            "train_seconds": round(ref_train_s, 1),
+            "decode": "train-mode proposals (600), reference reg2bbox, "
+            "per-class NMS 0.3, score>0.05",
+        },
+    }
+
+    if not args.ref_only:
+        if args.skip_ours:
+            with open(os.path.join(REPO, "benchmarks", "map_overfit_result.json")) as f:
+                ours = json.load(f)
+            assert ours["images"] == args.images and ours["image_size"] == args.image_size, (
+                "committed map_overfit_result.json used different dataset "
+                "parameters; rerun without --skip-ours"
+            )
+            result["ours"] = {
+                "train_set_mAP@0.5": ours["train_set_mAP"],
+                "val_mAP@0.5": ours["final_val_mAP"],
+                "source": "benchmarks/map_overfit_result.json (same dataset params)",
+            }
+        else:
+            # run our side fresh through the same entry point map_overfit uses
+            import subprocess
+
+            env = dict(os.environ)
+            env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(REPO, "benchmarks", "map_overfit.py"),
+                    "--epochs",
+                    str(args.epochs),
+                    "--images",
+                    str(args.images),
+                    "--image-size",
+                    str(args.image_size),
+                ],
+                env=env,
+                cwd=REPO,
+                capture_output=True,
+                text=True,
+            )
+            if r.returncode != 0:
+                raise RuntimeError(f"our-side training failed:\n{r.stderr[-2000:]}")
+            ours = json.loads(r.stdout.strip().splitlines()[-1])
+            result["ours"] = {
+                "train_set_mAP@0.5": ours["train_set_mAP"],
+                "val_mAP@0.5": ours["final_val_mAP"],
+                "source": "fresh map_overfit.py run (same epochs/images/size)",
+            }
+
+    out = os.path.join(REPO, "benchmarks", "head_to_head_map.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

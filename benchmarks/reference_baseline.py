@@ -67,39 +67,53 @@ def _install_stubs() -> None:
         )
         return torch.as_tensor(np.asarray(keep), dtype=torch.long)
 
+    import torch.nn.functional as F
+
     def roi_pool(features, boxes, output_size, spatial_scale=1.0):
+        # torchvision.ops.roi_pool semantics: round the scaled roi, then
+        # max-pool over floor/ceil bin boundaries over rh=r2-r1+1 rows
+        # (computed from the UNclamped corners) — which is
+        # adaptive_max_pool2d over the (inclusive) region, with rows/cols
+        # outside the feature map treated as absent (bins that fall
+        # entirely outside stay 0). Out-of-range margins on any side are
+        # modeled by -inf padding to the full rh x rw extent, then
+        # zeroing any all-padding bins. One fused pool per roi instead of
+        # oh*ow Python-level bins: the original triple loop took
+        # ~20s/step at 128px images (it dominated any small-shape run of
+        # the reference; at 600x600 the conv stacks dominate either way).
         if isinstance(output_size, int):
             output_size = (output_size, output_size)
         oh, ow = output_size
         n, c, h, w = features.shape
         out = features.new_zeros(len(boxes), c, oh, ow)
-        for k, row in enumerate(boxes):
-            b = int(row[0].item())
-            r1, c1, r2, c2 = [v.item() * spatial_scale for v in row[1:]]
-            r1, c1, r2, c2 = round(r1), round(c1), round(r2), round(c2)
+        neg_inf = float("-inf")
+        for k in range(len(boxes)):
+            b = int(boxes[k, 0])
+            r1, c1, r2, c2 = [
+                int(round(float(v) * spatial_scale)) for v in boxes[k, 1:]
+            ]
             rh = max(r2 - r1 + 1, 1)
             rw = max(c2 - c1 + 1, 1)
-            for i in range(oh):
-                hs = int(max(min(np_floor(i * rh / oh) + r1, h), 0))
-                he = int(max(min(np_ceil((i + 1) * rh / oh) + r1, h), 0))
-                for j in range(ow):
-                    ws = int(max(min(np_floor(j * rw / ow) + c1, w), 0))
-                    we = int(max(min(np_ceil((j + 1) * rw / ow) + c1, w), 0))
-                    if he > hs and we > ws:
-                        out[k, :, i, j] = (
-                            features[b, :, hs:he, ws:we].amax(dim=(1, 2))
-                        )
+            rs, cs = max(r1, 0), max(c1, 0)
+            region = features[b, :, rs : max(min(r1 + rh, h), rs), cs : max(min(c1 + rw, w), cs)]
+            pad_top = rs - r1
+            pad_left = cs - c1
+            pad_bottom = rh - pad_top - region.shape[1]
+            pad_right = rw - pad_left - region.shape[2]
+            padded = pad_top or pad_left or pad_bottom or pad_right
+            if padded:
+                region = F.pad(
+                    region,
+                    (pad_left, pad_right, pad_top, pad_bottom),
+                    value=neg_inf,
+                )
+            pooled = F.adaptive_max_pool2d(region, (oh, ow))
+            if padded:
+                pooled = torch.where(
+                    pooled == neg_inf, torch.zeros_like(pooled), pooled
+                )
+            out[k] = pooled
         return out
-
-    def np_floor(x):
-        import math
-
-        return math.floor(x)
-
-    def np_ceil(x):
-        import math
-
-        return math.ceil(x)
 
     torchvision = types.ModuleType("torchvision")
     tv_ops = types.ModuleType("torchvision.ops")
