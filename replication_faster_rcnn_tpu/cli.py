@@ -42,6 +42,13 @@ def _build_config(args):
                 cfg.data, image_size=(args.image_size, args.image_size)
             )
         )
+    data_kw = {}
+    if getattr(args, "loader_workers", None) is not None:
+        data_kw["loader_workers"] = args.loader_workers
+    if getattr(args, "loader_mode", None):
+        data_kw["loader_mode"] = args.loader_mode
+    if data_kw:
+        cfg = cfg.replace(data=dataclasses.replace(cfg.data, **data_kw))
     train_kw = {}
     if args.lr is not None:
         train_kw["lr"] = args.lr
@@ -57,6 +64,8 @@ def _build_config(args):
         train_kw["shard_opt_state"] = True
     if getattr(args, "eval_every", None) is not None:
         train_kw["eval_every_epochs"] = args.eval_every
+    if getattr(args, "mu_dtype", None):
+        train_kw["adam_mu_dtype"] = args.mu_dtype
     if train_kw:
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **train_kw))
     if args.backbone or args.roi_op or getattr(args, "remat", False):
@@ -113,6 +122,16 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each trunk block (recompute "
                         "activations in backward; saves HBM)")
+    p.add_argument("--mu-dtype", default=None,
+                   choices=[None, "float32", "bfloat16"],
+                   help="dtype for Adam's first moment (bfloat16 halves "
+                        "its HBM traffic in the update)")
+    p.add_argument("--loader-workers", type=int, default=None,
+                   help="host input-pipeline worker count")
+    p.add_argument("--loader-mode", default=None,
+                   choices=[None, "thread", "process"],
+                   help="input workers as GIL-releasing threads (native "
+                        "decode) or forked processes (Python-bound work)")
     p.add_argument("--num-model", type=int, default=None,
                    help="size of the mesh's model axis")
     p.add_argument("--spatial", action="store_true",
@@ -204,7 +223,8 @@ def cmd_bench(args) -> int:
         for v in (
             args.dataset, args.data_root, args.image_size, args.backbone,
             args.roi_op, args.batch_size, args.lr, args.epochs, args.seed,
-            args.num_model, args.backend,
+            args.num_model, args.backend, args.mu_dtype, args.loader_workers,
+            args.loader_mode,
         )
     ) or args.spatial or args.remat or args.shard_opt or args.config != "voc_resnet18"
     bench_main(_build_config(args) if flagged else None, profile_dir=args.profile)

@@ -203,5 +203,25 @@ def nms_fixed_auto(
     if choice == "tiled":
         from replication_faster_rcnn_tpu.ops.nms_tiled import nms_fixed_tiled
 
-        return nms_fixed_tiled(boxes, scores, iou_thresh, max_out, mask=mask)
+        # FRCNN_NMS_TILE tunes the candidates-per-sequential-step tile
+        # (default 512). Larger tiles mean fewer sequential steps but a
+        # bigger in-tile fixpoint matrix; the optimum is hardware- and
+        # budget-dependent (bench experiment: benchmarks/mfu_experiments.py).
+        # Bad values warn and fall back — a typo in a sweep must not
+        # crash a training run at trace time
+        try:
+            tile = int(os.environ.get("FRCNN_NMS_TILE", "512"))
+            if tile < 1:
+                raise ValueError(tile)
+        except ValueError:
+            import warnings
+
+            warnings.warn(
+                f"invalid FRCNN_NMS_TILE={os.environ['FRCNN_NMS_TILE']!r} "
+                "(want a positive int); using 512"
+            )
+            tile = 512
+        return nms_fixed_tiled(
+            boxes, scores, iou_thresh, max_out, mask=mask, tile=tile
+        )
     return nms_xla.nms_fixed(boxes, scores, iou_thresh, max_out, mask=mask)

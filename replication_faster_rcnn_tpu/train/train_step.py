@@ -211,7 +211,7 @@ def make_optimizer(config: FasterRCNNConfig, steps_per_epoch: int):
     # torch Adam's weight_decay is L2-added-to-grad, not decoupled AdamW.
     tx = optax.chain(
         optax.add_decayed_weights(tc.weight_decay),
-        optax.scale_by_adam(),
+        optax.scale_by_adam(mu_dtype=jnp.dtype(tc.adam_mu_dtype)),
         optax.scale_by_learning_rate(schedule),
     )
     return tx, schedule
