@@ -108,7 +108,9 @@ def main() -> None:
     # container processes timeshare one core, so the row records overhead,
     # not scaling — the scaling claim is the per-core rate x worker count)
     loader_rate = _loader_rate(num_workers=4)
-    mp_workers = int(os.environ.get("LOADER_BENCH_MP_WORKERS", "2"))
+    # the process path needs >= 2 workers (the loader runs num_workers<=1
+    # serially in-process — a "process mode" label on that would lie)
+    mp_workers = max(2, int(os.environ.get("LOADER_BENCH_MP_WORKERS", "2")))
     loader_rate_mp = _loader_rate(num_workers=mp_workers, worker_mode="process")
 
     # the fused resize+normalize kernel alone: native C++ vs numpy fallback
@@ -135,17 +137,53 @@ def main() -> None:
         ),
     }
 
+    # write the loader rows NOW — the trainer leg below may touch a
+    # wedged TPU tunnel, and a hang there must not lose these
+    demand = PER_CHIP_IMG_S * N_CHIPS
+    path = os.path.join(REPO, "benchmarks", "loader_throughput.json")
+
+    def _emit(extra):
+        out = {
+            "single_thread_images_per_sec": round(single_rate, 2),
+            "loader_images_per_sec": round(loader_rate, 2),
+            "loader_process_mode_images_per_sec": round(loader_rate_mp, 2),
+            "loader_process_mode_workers": mp_workers,
+            "resize_normalize_native_per_sec": (
+                round(kernel["native"], 2) if kernel.get("native") else None
+            ),
+            "resize_normalize_numpy_per_sec": round(kernel["numpy"], 2),
+            "demand_v5e8_images_per_sec": demand,
+            "per_chip_images_per_sec": PER_CHIP_IMG_S,
+            "workers_needed_for_v5e8": round(demand / max(single_rate, 1e-9), 1),
+            "host_cpu_count": os.cpu_count(),
+            "n_images": n_images,
+            "keeps_up": max(loader_rate, loader_rate_mp) >= demand,
+            "keeps_up_one_chip": max(loader_rate, loader_rate_mp)
+            >= PER_CHIP_IMG_S,
+            "notes": "1-core container; neither threads nor fork workers "
+            "can exceed the single-core decode rate here — "
+            "workers_needed_for_v5e8 is the per-host worker budget "
+            "(threads for the GIL-releasing native decode, processes for "
+            "Python-bound work) a real v5e-8 host needs",
+            **extra,
+        }
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+        return out
+
+    _emit({"trainer_loop": "pending"})
+
     # trainer-loop throughput: real Trainer epochs through the
     # loader + shard_batch/device_put path (NOT pre-staged tensors like
     # bench.py) on the synthetic dataset. Shape adapts to the backend:
     # full 600x600 on TPU, the CPU-feasible 128px otherwise — the JSON
-    # records which one ran.
+    # records which one ran. TPU liveness is probed in a subprocess first
+    # (a wedged tunnel blocks device ops forever); dead -> CPU leg.
     trainer_rec = None
     if os.environ.get("LOADER_BENCH_TRAINER", "1") == "1":
-        import dataclasses as _dc
-
         import jax
 
+        from replication_faster_rcnn_tpu.benchmark import _probe_subprocess
         from replication_faster_rcnn_tpu.config import (
             MeshConfig,
             TrainConfig,
@@ -154,6 +192,11 @@ def main() -> None:
         from replication_faster_rcnn_tpu.data import SyntheticDataset
         from replication_faster_rcnn_tpu.train.trainer import Trainer
 
+        if not _probe_subprocess(120.0):
+            # wedged/dead tunnel: no jax backend has been initialized in
+            # this process yet (the loader legs are pure numpy), so the
+            # CPU switch still takes effect
+            jax.config.update("jax_platforms", "cpu")
         on_tpu = jax.default_backend() == "tpu"
         size = (600, 600) if on_tpu else (128, 128)
         batch = 16 if on_tpu else 4
@@ -186,33 +229,7 @@ def main() -> None:
             "shard_batch (host->device each step)",
         }
 
-    demand = PER_CHIP_IMG_S * N_CHIPS
-    out = {
-        "single_thread_images_per_sec": round(single_rate, 2),
-        "loader_images_per_sec": round(loader_rate, 2),
-        "loader_process_mode_images_per_sec": round(loader_rate_mp, 2),
-        "loader_process_mode_workers": mp_workers,
-        "trainer_loop": trainer_rec,
-        "resize_normalize_native_per_sec": (
-            round(kernel["native"], 2) if kernel.get("native") else None
-        ),
-        "resize_normalize_numpy_per_sec": round(kernel["numpy"], 2),
-        "demand_v5e8_images_per_sec": demand,
-        "per_chip_images_per_sec": PER_CHIP_IMG_S,
-        "cores_needed_at_measured_rate": round(demand / max(single_rate, 1e-9), 1),
-        "host_cpu_count": os.cpu_count(),
-        "n_images": n_images,
-        "keeps_up": max(loader_rate, loader_rate_mp) >= demand,
-        "keeps_up_one_chip": max(loader_rate, loader_rate_mp) >= PER_CHIP_IMG_S,
-        "workers_needed_for_v5e8": round(demand / max(single_rate, 1e-9), 1),
-        "notes": "1-core container; neither threads nor fork workers can "
-        "exceed the single-core decode rate here — workers_needed is the "
-        "per-host worker budget (threads for the GIL-releasing native "
-        "decode, processes for Python-bound work) a real v5e-8 host needs",
-    }
-    path = os.path.join(REPO, "benchmarks", "loader_throughput.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=2)
+    out = _emit({"trainer_loop": trainer_rec})
     print(json.dumps(out))
 
 
