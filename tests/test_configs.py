@@ -25,7 +25,16 @@ from replication_faster_rcnn_tpu.train.train_step import (
 )
 
 
-@pytest.mark.parametrize("name", sorted(CONFIGS))
+@pytest.mark.parametrize(
+    "name",
+    [
+        # each preset costs a full train-step compile (1-3 min on one CPU
+        # core): the flagship stays in the fast tier as the smoke preset,
+        # the rest are slow-tier (pytest -m slow runs them all)
+        n if n == "voc_resnet18" else pytest.param(n, marks=pytest.mark.slow)
+        for n in sorted(CONFIGS)
+    ],
+)
 def test_preset_one_train_step(name):
     cfg = get_config(name)
     # shrink to CPU-tractable shapes; everything config-specific (backbone,

@@ -4,6 +4,7 @@ blending, shared-RPN anchor alignment, and a jitted FPN train step."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from replication_faster_rcnn_tpu.config import (
     AnchorConfig,
@@ -190,6 +191,7 @@ class TestFPNModel:
         assert 20 <= np.median(heights[:n2]) <= 48
         assert heights[-1] > 300
 
+    @pytest.mark.slow
     def test_fpn_train_step(self):
         from replication_faster_rcnn_tpu.data import SyntheticDataset
         from replication_faster_rcnn_tpu.data.loader import collate

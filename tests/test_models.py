@@ -211,6 +211,7 @@ class TestVGG16:
         )
         assert all(jax.tree_util.tree_leaves(same))
 
+    @pytest.mark.slow
     def test_convert_vgg16_numeric_forward_parity(self):
         """End-to-end converter numerics (the resnet18 equivalent of this
         test exists in TestConvertNumerics): a torchvision-layout VGG16

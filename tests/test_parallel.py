@@ -4,6 +4,11 @@ single-chip vs 8-chip data-parallel equivalence check (SURVEY.md §4e)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+# every test compiles full train steps over the 8-device mesh — minutes
+# each on one CPU core; the fast tier (pytest -m "not slow") skips them
+pytestmark = pytest.mark.slow
 
 from replication_faster_rcnn_tpu.config import (
     DataConfig,

@@ -4,6 +4,10 @@ step, and grafting classifier weights into the detector."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+# trains the CIFAR classifier stem — slow tier
+pytestmark = pytest.mark.slow
 
 from replication_faster_rcnn_tpu.models.resnet import ResNetClassifier, ResNetTrunk
 from replication_faster_rcnn_tpu.train import pretrain

@@ -100,6 +100,7 @@ def step_setup():
 
 
 class TestTrainStep:
+    @pytest.mark.slow
     def test_vgg16_step_with_dropout_rng(self):
         # the VGG16 tail's dropout draws a 'dropout' rng inside the jitted
         # step; trimmed budgets keep the fc6 matmul small on CPU
@@ -144,6 +145,7 @@ class TestTrainStep:
         _, m2 = step(state, batch)
         assert float(m1["loss"]) == float(m2["loss"])
 
+    @pytest.mark.slow
     def test_remat_preserves_step_semantics(self, step_setup):
         """model.remat=True (per-block jax.checkpoint) must leave the
         parameter tree and the computed update unchanged — it only trades
@@ -172,6 +174,7 @@ class TestTrainStep:
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
             )
 
+    @pytest.mark.slow
     def test_bf16_mu_matches_f32_update_approximately(self, step_setup):
         """train.adam_mu_dtype=bfloat16 stores Adam's first moment in
         bf16 (half the moment traffic in the update phase); the computed
@@ -208,6 +211,7 @@ class TestTrainStep:
             np.testing.assert_allclose(dbf, d32, rtol=2e-2, atol=2e-6)
         assert moved > 1e-5, f"f32 step barely moved params ({moved})"
 
+    @pytest.mark.slow
     def test_overfit_two_images(self, step_setup):
         """Loss must drop substantially when repeating one tiny batch
         (SURVEY.md §4f overfit integration check, shortened for CI)."""

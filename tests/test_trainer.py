@@ -5,6 +5,9 @@ import jax
 import numpy as np
 import pytest
 
+# full Trainer epochs + orbax round-trips — slow tier
+pytestmark = pytest.mark.slow
+
 from replication_faster_rcnn_tpu import cli
 from replication_faster_rcnn_tpu.config import (
     DataConfig,
