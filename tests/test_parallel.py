@@ -155,13 +155,12 @@ def test_fpn_dp8_matches_single_device():
     _assert_dp8_matches_single_device(cfg_for, "n_pos_head")
 
 
-def test_spatial_partition_matches_single_device():
-    """Spatial partitioning (image rows sharded over the model axis — the
-    vision analogue of sequence parallelism) must be semantics-preserving:
-    a 2-data x 4-model mesh with GSPMD halo exchanges computes the same
-    step as one device."""
-    import dataclasses
-
+def _assert_spatial_matches_single(cfg_factory, spatial_mesh, shard_shape):
+    """Shared single-device vs dp x spatial equivalence harness: run one
+    jitted train step under both layouts on the same batch and require
+    identical targets/loss and matching first-leaf params. GSPMD inserts
+    the conv halo exchanges / gather collectives; the numbers must not
+    move."""
     ds = SyntheticDataset(
         DataConfig(dataset="synthetic", image_size=(64, 64), max_boxes=8), length=4
     )
@@ -170,10 +169,9 @@ def test_spatial_partition_matches_single_device():
     results = {}
     for name, mesh_cfg in {
         "single": MeshConfig(num_data=1),
-        "spatial": MeshConfig(num_data=2, num_model=4, spatial=True),
+        "spatial": spatial_mesh,
     }.items():
-        cfg = _cfg(mesh_cfg.num_data).replace(mesh=mesh_cfg)
-        cfg = cfg.replace(train=dataclasses.replace(cfg.train, batch_size=4))
+        cfg = cfg_factory(mesh_cfg)
         mesh = make_mesh(cfg.mesh)
         tx, _ = make_optimizer(cfg, steps_per_epoch=10)
         model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
@@ -181,15 +179,18 @@ def test_spatial_partition_matches_single_device():
         db = shard_batch(batch, mesh, cfg.mesh)
         if name == "spatial":
             # the image must actually be laid out over both axes
-            assert len(db["image"].sharding.device_set) == 8
+            n_dev = spatial_mesh.num_data * spatial_mesh.num_model
+            assert len(db["image"].sharding.device_set) == n_dev
             shard_shapes = {s.data.shape for s in db["image"].addressable_shards}
-            assert shard_shapes == {(2, 16, 64, 3)}
+            assert shard_shapes == {shard_shape}
         step = jax.jit(make_train_step(model, cfg, tx))
         new_state, metrics = step(state, db)
         results[name] = (
             float(metrics["loss"]),
             float(metrics["n_pos_rpn"]),
-            np.asarray(jax.device_get(jax.tree_util.tree_leaves(new_state.params)[0])),
+            np.asarray(
+                jax.device_get(jax.tree_util.tree_leaves(new_state.params)[0])
+            ),
         )
 
     loss1, npos1, p1 = results["single"]
@@ -197,6 +198,49 @@ def test_spatial_partition_matches_single_device():
     assert npos1 == npos2
     np.testing.assert_allclose(loss1, loss2, rtol=1e-5)
     np.testing.assert_allclose(p1, p2, rtol=1e-4, atol=1e-6)
+
+
+def test_spatial_partition_matches_single_device():
+    """Spatial partitioning (image rows sharded over the model axis — the
+    vision analogue of sequence parallelism) must be semantics-preserving:
+    a 2-data x 4-model mesh with GSPMD halo exchanges computes the same
+    step as one device."""
+    import dataclasses
+
+    def cfg_factory(mesh_cfg):
+        cfg = _cfg(mesh_cfg.num_data).replace(mesh=mesh_cfg)
+        return cfg.replace(train=dataclasses.replace(cfg.train, batch_size=4))
+
+    _assert_spatial_matches_single(
+        cfg_factory,
+        MeshConfig(num_data=2, num_model=4, spatial=True),
+        (2, 16, 64, 3),
+    )
+
+
+def test_fpn_spatial_partition_matches_single_device():
+    """The FPN path (multi-level neck + flat level-offset ROIAlign gather)
+    must also compose with dp x spatial sharding: the neck's top-down
+    upsampling and the pyramid gather run under GSPMD halo/collective
+    insertion, and the step computes the same result as one device."""
+    from replication_faster_rcnn_tpu.config import AnchorConfig
+
+    def cfg_factory(mesh_cfg):
+        return FasterRCNNConfig(
+            model=ModelConfig(
+                backbone="resnet18", fpn=True, compute_dtype="float32"
+            ),
+            anchors=AnchorConfig(scales=(8.0,)),
+            data=DataConfig(dataset="synthetic", image_size=(64, 64), max_boxes=8),
+            train=TrainConfig(batch_size=4),
+            mesh=mesh_cfg,
+        )
+
+    _assert_spatial_matches_single(
+        cfg_factory,
+        MeshConfig(num_data=2, num_model=2, spatial=True),
+        (2, 32, 64, 3),
+    )
 
 
 def test_trainer_rejects_spatial_spmd_backend():
