@@ -40,6 +40,22 @@ def _cfg(n_data):
     )
 
 
+def _fpn_cfg(n_data, batch_size=8):
+    """The canonical FPN variant for the equivalence suites: resnet18
+    neck with the per-level single anchor scale. One definition so the
+    dp8 / spatial / shard_map FPN checks all test the same graph."""
+    import dataclasses
+
+    from replication_faster_rcnn_tpu.config import AnchorConfig
+
+    cfg = _cfg(n_data)
+    return cfg.replace(
+        model=dataclasses.replace(cfg.model, fpn=True),
+        anchors=AnchorConfig(scales=(8.0,)),
+        train=TrainConfig(batch_size=batch_size),
+    )
+
+
 def test_mesh_shapes():
     cfg = _cfg(8)
     mesh = make_mesh(cfg.mesh)
@@ -139,20 +155,7 @@ def test_fpn_dp8_matches_single_device():
     semantics-preserving under batch sharding — each image's flat indices
     only address its own [sum(Hl*Wl), C] row block, so the gather never
     crosses the sharded batch axis."""
-    from replication_faster_rcnn_tpu.config import AnchorConfig
-
-    def cfg_for(n):
-        return FasterRCNNConfig(
-            model=ModelConfig(
-                backbone="resnet18", fpn=True, compute_dtype="float32"
-            ),
-            anchors=AnchorConfig(scales=(8.0,)),
-            data=DataConfig(dataset="synthetic", image_size=(64, 64), max_boxes=8),
-            train=TrainConfig(batch_size=8),
-            mesh=MeshConfig(num_data=n),
-        )
-
-    _assert_dp8_matches_single_device(cfg_for, "n_pos_head")
+    _assert_dp8_matches_single_device(_fpn_cfg, "n_pos_head")
 
 
 def _assert_spatial_matches_single(cfg_factory, spatial_mesh, shard_shape):
@@ -223,21 +226,8 @@ def test_fpn_spatial_partition_matches_single_device():
     must also compose with dp x spatial sharding: the neck's top-down
     upsampling and the pyramid gather run under GSPMD halo/collective
     insertion, and the step computes the same result as one device."""
-    from replication_faster_rcnn_tpu.config import AnchorConfig
-
-    def cfg_factory(mesh_cfg):
-        return FasterRCNNConfig(
-            model=ModelConfig(
-                backbone="resnet18", fpn=True, compute_dtype="float32"
-            ),
-            anchors=AnchorConfig(scales=(8.0,)),
-            data=DataConfig(dataset="synthetic", image_size=(64, 64), max_boxes=8),
-            train=TrainConfig(batch_size=4),
-            mesh=mesh_cfg,
-        )
-
     _assert_spatial_matches_single(
-        cfg_factory,
+        lambda mesh_cfg: _fpn_cfg(1, batch_size=4).replace(mesh=mesh_cfg),
         MeshConfig(num_data=2, num_model=2, spatial=True),
         (2, 32, 64, 3),
     )
@@ -353,13 +343,16 @@ def test_trainer_spmd_backend(tmp_path):
     assert np.isfinite(float(jax.device_get(metrics["loss"])))
 
 
-def test_shard_map_step_matches_jit_auto():
+@pytest.mark.parametrize("path", ["c4", "fpn"])
+def test_shard_map_step_matches_jit_auto(path):
     """The explicit-collective shard_map backend (hand-placed psums,
     sync-BN, global-position sampling keys) must compute the same update
-    as jit auto-partitioning on the same sharded batch."""
+    as jit auto-partitioning on the same sharded batch — on the C4
+    flagship AND the FPN graph (multi-level neck + pyramid gather under
+    hand-placed collectives)."""
     from replication_faster_rcnn_tpu.parallel import make_shard_map_train_step
 
-    cfg = _cfg(8)
+    cfg = _fpn_cfg(8) if path == "fpn" else _cfg(8)
     mesh = make_mesh(cfg.mesh)
     tx, _ = make_optimizer(cfg, steps_per_epoch=10)
     model, state0 = create_train_state(cfg, jax.random.PRNGKey(0), tx)
