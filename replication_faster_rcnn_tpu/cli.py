@@ -47,6 +47,8 @@ def _build_config(args):
         data_kw["loader_workers"] = args.loader_workers
     if getattr(args, "loader_mode", None):
         data_kw["loader_mode"] = args.loader_mode
+    if getattr(args, "augment_hflip", False):
+        data_kw["augment_hflip"] = True
     if data_kw:
         cfg = cfg.replace(data=dataclasses.replace(cfg.data, **data_kw))
     train_kw = {}
@@ -132,6 +134,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    choices=[None, "thread", "process"],
                    help="input workers as GIL-releasing threads (native "
                         "decode) or forked processes (Python-bound work)")
+    p.add_argument("--augment-hflip", action="store_true",
+                   help="50%% horizontal-flip train augmentation "
+                        "(deterministic per seed/epoch/index)")
     p.add_argument("--num-model", type=int, default=None,
                    help="size of the mesh's model axis")
     p.add_argument("--spatial", action="store_true",
@@ -226,7 +231,10 @@ def cmd_bench(args) -> int:
             args.num_model, args.backend, args.mu_dtype, args.loader_workers,
             args.loader_mode,
         )
-    ) or args.spatial or args.remat or args.shard_opt or args.config != "voc_resnet18"
+    ) or (
+        args.spatial or args.remat or args.shard_opt or args.augment_hflip
+        or args.config != "voc_resnet18"
+    )
     bench_main(_build_config(args) if flagged else None, profile_dir=args.profile)
     return 0
 

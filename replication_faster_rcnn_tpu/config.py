@@ -195,6 +195,11 @@ class DataConfig:
     loader_workers: int = 4
     loader_mode: str = "thread"  # thread | process
     loader_prefetch: int = 2
+    # 50% horizontal-flip train augmentation (the original Faster R-CNN
+    # recipe's only augmentation; the reference trains with none —
+    # utils/data_loader.py:56-79 resizes+normalizes only). Deterministic
+    # per (seed, epoch, index): resume replays the same flips.
+    augment_hflip: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

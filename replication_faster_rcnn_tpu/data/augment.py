@@ -1,0 +1,72 @@
+"""Train-time augmentation — deterministic, resume-reproducible.
+
+The reference trains with NO augmentation (`utils/data_loader.py:56-79`
+resizes and normalizes only); the original Faster R-CNN recipe uses
+horizontal flips as its sole augmentation, so VOC-parity training wants
+it available. Everything here is pure numpy on host samples (the fixed
+sample dict of `data/voc.py`), decided by a counter-based per-(seed,
+epoch, index) RNG — no global state, so the same epoch re-yields the
+same flips after a checkpoint resume, identical under thread and
+fork-process loader workers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+def hflip_sample(sample: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Mirror a sample left-right: image columns reversed, each valid
+    box's x-span reflected ((y1,x1,y2,x2) -> (y1, W-x2, y2, W-x1));
+    padded (-1) rows stay untouched."""
+    image = sample["image"][:, ::-1, :]
+    w = float(image.shape[1])
+    boxes = sample["boxes"].copy()
+    valid = np.asarray(sample["mask"], bool)
+    flipped = boxes[valid]
+    boxes[valid] = np.stack(
+        [flipped[:, 0], w - flipped[:, 3], flipped[:, 2], w - flipped[:, 1]],
+        axis=1,
+    )
+    out = dict(sample)
+    # negative-stride view, no copy: collate's np.stack materializes it
+    out["image"] = image
+    out["boxes"] = boxes
+    return out
+
+
+class AugmentedView:
+    """Map-style view applying a 50% per-sample horizontal flip.
+
+    The coin for (seed, epoch, idx) is a small counter-based mix — not
+    Python ``hash`` (salted for some types) and not a shared RNG stream
+    (order-dependent) — so any worker, process or thread, computes the
+    same decision for the same sample.
+    """
+
+    def __init__(self, dataset, seed: int, epoch: int) -> None:
+        self.dataset = dataset
+        self.seed = int(seed)
+        self.epoch = int(epoch)
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    def __getitem__(self, idx: int):
+        sample = self.dataset[idx]
+        # splitmix64 finalizer on the (seed, epoch, idx) mix; one output
+        # bit is the coin — no per-sample Mersenne Twister construction
+        # on the ingest hot path
+        z = (
+            self.seed * 0x9E3779B97F4A7C15
+            + self.epoch * 0xBF58476D1CE4E5B9
+            + idx * 0x94D049BB133111EB
+        ) & 0xFFFFFFFFFFFFFFFF
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+        z ^= z >> 31
+        if z & 1:
+            return hflip_sample(sample)
+        return sample

@@ -89,9 +89,11 @@ class DataLoader:
         num_workers: int = 4,
         seed: int = 0,
         worker_mode: str = "thread",
+        augment_hflip: bool = False,
     ) -> None:
         if worker_mode not in ("thread", "process"):
             raise ValueError(f"worker_mode must be thread|process, got {worker_mode!r}")
+        self.augment_hflip = augment_hflip
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -123,12 +125,23 @@ class DataLoader:
         for i in range(0, end, bs):
             yield order[i : i + bs]
 
+    def _epoch_dataset(self):
+        """The dataset view for the current epoch: identity, or the
+        deterministic hflip augmentation keyed on (seed, epoch, idx) —
+        computed per-iteration so set_epoch() re-rolls the flips while
+        resume replays them exactly."""
+        if not self.augment_hflip:
+            return self.dataset
+        from replication_faster_rcnn_tpu.data.augment import AugmentedView
+
+        return AugmentedView(self.dataset, self.seed, self.epoch)
+
     def _build(
-        self, idxs: np.ndarray, pool: Optional[futures.ThreadPoolExecutor]
+        self, idxs: np.ndarray, pool: Optional[futures.ThreadPoolExecutor], ds
     ) -> Dict[str, np.ndarray]:
         if pool is None or len(idxs) == 1:
-            return collate([self.dataset[int(i)] for i in idxs])
-        return collate(list(pool.map(lambda i: self.dataset[int(i)], idxs)))
+            return collate([ds[int(i)] for i in idxs])
+        return collate(list(pool.map(lambda i: ds[int(i)], idxs)))
 
     def _iter_processes(self) -> Iterator[Dict[str, np.ndarray]]:
         """Process-worker iteration: whole batches farmed to forked
@@ -139,10 +152,11 @@ class DataLoader:
         ctx = multiprocessing.get_context("fork")
         task_q = ctx.Queue()
         result_q = ctx.Queue()
+        ds = self._epoch_dataset()
         procs = [
             ctx.Process(
                 target=_mp_worker,
-                args=(self.dataset, task_q, result_q),
+                args=(ds, task_q, result_q),
                 daemon=True,
             )
             for _ in range(self.num_workers)
@@ -203,11 +217,12 @@ class DataLoader:
         pool: Optional[futures.ThreadPoolExecutor] = None
         if self.num_workers > 1:
             pool = futures.ThreadPoolExecutor(self.num_workers)
+        ds = self._epoch_dataset()
 
         if self.prefetch <= 0:
             try:
                 for idxs in self._batches():
-                    yield self._build(idxs, pool)
+                    yield self._build(idxs, pool, ds)
             finally:
                 if pool is not None:
                     pool.shutdown(wait=False)
@@ -233,7 +248,7 @@ class DataLoader:
                 for idxs in self._batches():
                     if stop.is_set():
                         return
-                    if not put_unless_stopped(self._build(idxs, pool)):
+                    if not put_unless_stopped(self._build(idxs, pool, ds)):
                         return
             except BaseException as e:  # surface worker errors to the consumer
                 err.append(e)

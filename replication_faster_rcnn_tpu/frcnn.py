@@ -60,6 +60,7 @@ class FRCNN:
             prefetch=cfg.data.loader_prefetch,
             num_workers=cfg.data.loader_workers,
             worker_mode=cfg.data.loader_mode,
+            augment_hflip=cfg.data.augment_hflip and self.mode == "train",
         )
 
     def get_network(self) -> Tuple[object, dict]:

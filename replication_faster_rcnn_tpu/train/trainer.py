@@ -110,6 +110,7 @@ class Trainer:
             prefetch=config.data.loader_prefetch,
             num_workers=config.data.loader_workers,
             worker_mode=config.data.loader_mode,
+            augment_hflip=config.data.augment_hflip,
         )
         steps_per_epoch = max(len(self.loader), 1)
         self.tx, self.schedule = make_optimizer(config, steps_per_epoch)

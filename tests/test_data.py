@@ -125,6 +125,69 @@ class TestLoader:
             DataLoader(SyntheticDataset(_cfg(), length=2), 2, worker_mode="x")
 
 
+class TestAugment:
+    def test_hflip_sample_geometry(self):
+        from replication_faster_rcnn_tpu.data.augment import hflip_sample
+
+        ds = SyntheticDataset(_cfg(), length=1)
+        s = ds[0]
+        f = hflip_sample(s)
+        w = s["image"].shape[1]
+        # image mirrored
+        np.testing.assert_array_equal(f["image"], s["image"][:, ::-1, :])
+        # valid boxes reflected in x, y untouched; padding rows untouched
+        m = s["mask"]
+        np.testing.assert_allclose(f["boxes"][m][:, 0], s["boxes"][m][:, 0])
+        np.testing.assert_allclose(f["boxes"][m][:, 2], s["boxes"][m][:, 2])
+        np.testing.assert_allclose(f["boxes"][m][:, 1], w - s["boxes"][m][:, 3])
+        np.testing.assert_allclose(f["boxes"][m][:, 3], w - s["boxes"][m][:, 1])
+        np.testing.assert_array_equal(f["boxes"][~m], s["boxes"][~m])
+        # double flip is identity
+        ff = hflip_sample(f)
+        np.testing.assert_array_equal(ff["image"], s["image"])
+        np.testing.assert_allclose(ff["boxes"][m], s["boxes"][m])
+
+    def test_hflip_pixels_follow_boxes(self):
+        """The painted object must still be under its (flipped) box."""
+        from replication_faster_rcnn_tpu.data.augment import hflip_sample
+
+        ds = SyntheticDataset(_cfg(), length=1)
+        s = ds[0]
+        f = hflip_sample(s)
+        r1, c1, r2, c2 = (int(v) for v in f["boxes"][0])
+        inside = f["image"][r1:r2, c1:c2].mean()
+        outside = f["image"].mean()
+        assert inside > outside  # painted block is brighter than noise
+
+    def test_loader_hflip_deterministic_and_epoch_varying(self):
+        ds = SyntheticDataset(_cfg(), length=8)
+        kw = dict(batch_size=4, shuffle=False, prefetch=0, seed=5,
+                  augment_hflip=True)
+        l1, l2 = DataLoader(ds, **kw), DataLoader(ds, **kw)
+        l1.set_epoch(2)
+        l2.set_epoch(2)
+        for a, b in zip(l1, l2):
+            np.testing.assert_array_equal(a["image"], b["image"])
+            np.testing.assert_array_equal(a["boxes"], b["boxes"])
+        # a different epoch re-rolls at least one flip over 8 samples
+        l2.set_epoch(3)
+        diff = any(
+            not np.array_equal(a["image"], b["image"])
+            for a, b in zip(l1, l2)
+        )
+        assert diff
+
+    def test_process_mode_hflip_matches_thread_mode(self):
+        ds = SyntheticDataset(_cfg(), length=8)
+        kw = dict(batch_size=4, shuffle=True, seed=7, prefetch=2,
+                  augment_hflip=True)
+        ref = list(DataLoader(ds, **kw))
+        got = list(DataLoader(ds, num_workers=2, worker_mode="process", **kw))
+        for a, b in zip(ref, got):
+            for k in a:
+                np.testing.assert_array_equal(a[k], b[k])
+
+
 def _write_voc(root, ids, difficult_flags=None):
     from PIL import Image
 
