@@ -18,13 +18,17 @@ import numpy as np
 
 
 def hflip_sample(sample: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-    """Mirror a sample left-right: image columns reversed, each valid
+    """Mirror a sample left-right: image columns reversed, each real
     box's x-span reflected ((y1,x1,y2,x2) -> (y1, W-x2, y2, W-x1));
-    padded (-1) rows stay untouched."""
+    padded (-1) rows stay untouched.
+
+    Keyed on ``labels >= 0``, not the training ``mask``: difficult
+    objects keep their geometry consistent with the mirrored pixels even
+    when masked out of training (they are ignore-regions at eval time)."""
     image = sample["image"][:, ::-1, :]
     w = float(image.shape[1])
     boxes = sample["boxes"].copy()
-    valid = np.asarray(sample["mask"], bool)
+    valid = np.asarray(sample["labels"] >= 0, bool)
     flipped = boxes[valid]
     boxes[valid] = np.stack(
         [flipped[:, 0], w - flipped[:, 3], flipped[:, 2], w - flipped[:, 1]],

@@ -25,6 +25,7 @@ from __future__ import annotations
 import multiprocessing
 import queue
 import threading
+import time
 import traceback
 from concurrent import futures
 from typing import Dict, Iterator, Optional, Sequence
@@ -90,9 +91,11 @@ class DataLoader:
         seed: int = 0,
         worker_mode: str = "thread",
         augment_hflip: bool = False,
+        stall_timeout: float = 120.0,
     ) -> None:
         if worker_mode not in ("thread", "process"):
             raise ValueError(f"worker_mode must be thread|process, got {worker_mode!r}")
+        self.stall_timeout = float(stall_timeout)
         self.augment_hflip = augment_hflip
         self.dataset = dataset
         self.batch_size = batch_size
@@ -172,14 +175,19 @@ class DataLoader:
                 while next_submit < len(batches) and next_submit - next_yield < cap:
                     task_q.put((next_submit, batches[next_submit]))
                     next_submit += 1
+                # per-wait clock: time spent *waiting on this batch*, not
+                # time since the last receipt — consumer time at yield
+                # (train steps, compiles) must not count toward the
+                # deadline; a truly deadlocked worker still never delivers
+                last_progress = time.monotonic()
                 while next_yield not in buf:
                     try:
-                        seq, payload = result_q.get(timeout=5.0)
+                        seq, payload = result_q.get(
+                            timeout=min(5.0, self.stall_timeout)
+                        )
                     except queue.Empty:
                         # a forked worker can die without reporting (OOM
-                        # kill, native-decode segfault, fork-inherited
-                        # lock deadlock — forking a multithreaded JAX
-                        # parent is exactly that risk); fail loudly
+                        # kill, native-decode segfault) — fail loudly
                         # instead of blocking forever on a batch that
                         # will never arrive
                         dead = [p for p in procs if not p.is_alive()]
@@ -190,8 +198,23 @@ class DataLoader:
                                 f"(exitcodes {codes}) before batch "
                                 f"{next_yield} arrived"
                             )
+                        # liveness isn't progress: a fork-inherited lock
+                        # deadlock (the primary risk of forking a
+                        # multithreaded JAX parent) leaves workers alive
+                        # but forever silent — an overall no-progress
+                        # deadline turns that silent hang into an error
+                        if time.monotonic() - last_progress > self.stall_timeout:
+                            raise RuntimeError(
+                                f"loader made no progress for "
+                                f"{self.stall_timeout:.0f}s waiting on batch "
+                                f"{next_yield} with all {len(procs)} workers "
+                                f"alive — likely a fork-inherited lock "
+                                f"deadlock; use worker_mode='thread' or "
+                                f"raise stall_timeout"
+                            )
                         continue
                     buf[seq] = payload
+                    last_progress = time.monotonic()
                 payload = buf.pop(next_yield)
                 next_yield += 1
                 if isinstance(payload, tuple) and payload and payload[0] == "__error__":

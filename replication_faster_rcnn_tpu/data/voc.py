@@ -15,7 +15,10 @@ feeding:
 
 Deliberate fixes vs the reference (SURVEY.md §5 "failure detection"): XML
 parse errors raise instead of being silently converted to -1 labels by a
-broad ``except``; and the split file defaults to the full ``{split}.txt``
+broad ``except``; 1-based inclusive XML coords are converted to the
+package-wide 0-based continuous convention (mins - 1; the reference keeps
+them raw at `data_loader.py:105`, leaving a latent 1px skew under any
+geometric transform); and the split file defaults to the full ``{split}.txt``
 imageset rather than the aeroplane-only file hard-coded at
 `data_loader.py:48` (whose per-class ±1 flags the reference ignores anyway
 — it reads only the id column; pass ``image_set='aeroplane'`` for strict
@@ -67,7 +70,10 @@ class VOCDataset:
     """Map-style dataset yielding fixed-shape numpy samples.
 
     __getitem__ -> {'image' [H,W,3] f32 normalized, 'boxes' [M,4] f32,
-                    'labels' [M] i32 (class 1..20, -1 pad/difficult),
+                    'labels' [M] i32 (class id, -1 pad; difficult objects
+                    KEEP their class label — 'difficult'/'mask' carry the
+                    distinction, and augmentation keys geometry on
+                    labels >= 0),
                     'mask' [M] bool}
     """
 
@@ -114,9 +120,15 @@ class VOCDataset:
             if name not in self.class_to_id:
                 raise ValueError(f"unknown class {name!r} in {xml_path}")
             bnd = obj.find("bndbox")
+            # VOC XML coords are 1-based inclusive pixel indices; convert
+            # to the 0-based continuous convention used everywhere else in
+            # this package (a pixel span [i..j] inclusive is [i-1, j) + 1
+            # = [i-1, j] continuous): subtract 1 from the mins, keep the
+            # maxes. This makes hflip's x' = W - x reflection exact and
+            # keeps width = xmax - xmin equal to the inclusive pixel count.
             boxes[i] = [
-                float(bnd.findtext("ymin")),
-                float(bnd.findtext("xmin")),
+                float(bnd.findtext("ymin")) - 1.0,
+                float(bnd.findtext("xmin")) - 1.0,
                 float(bnd.findtext("ymax")),
                 float(bnd.findtext("xmax")),
             ]

@@ -86,11 +86,19 @@ class Evaluator:
         if rep_sharding is not None:
             # device-side reshard (no host round-trip of the weights)
             variables = jax.device_put(variables, rep_sharding)
+        # always thread workers here, even when training runs with
+        # --loader-mode process: eval happens inside a TPU-attached,
+        # multithreaded parent, and forking that process mid-training is
+        # exactly the deadlock risk data/loader.py warns about. Cost: with
+        # the native decode lib present threads lose nothing (the hot path
+        # releases the GIL); on the PIL/numpy fallback path eval ingest is
+        # GIL-bound at ~1 worker — accepted, eval is a small fraction of
+        # a training run and a hung eval would stall the whole run.
         loader = DataLoader(
             dataset, batch_size=batch_size, shuffle=False, drop_last=False,
             prefetch=self.config.data.loader_prefetch,
             num_workers=self.config.data.loader_workers,
-            worker_mode=self.config.data.loader_mode,
+            worker_mode="thread",
         )
         detections: List[Dict[str, np.ndarray]] = []
         gts: List[Dict[str, np.ndarray]] = []

@@ -124,6 +124,26 @@ class TestLoader:
         with pytest.raises(ValueError, match="worker_mode"):
             DataLoader(SyntheticDataset(_cfg(), length=2), 2, worker_mode="x")
 
+    def test_process_mode_stall_deadline(self):
+        """Workers that stay alive but never produce (the fork-inherited
+        deadlock shape) must raise, not hang."""
+
+        class Hang:
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                import time as _t
+
+                _t.sleep(3600)
+
+        loader = DataLoader(
+            Hang(), batch_size=2, shuffle=False, num_workers=2,
+            worker_mode="process", stall_timeout=1.5,
+        )
+        with pytest.raises(RuntimeError, match="no progress"):
+            list(loader)
+
 
 class TestAugment:
     def test_hflip_sample_geometry(self):
@@ -146,6 +166,26 @@ class TestAugment:
         ff = hflip_sample(f)
         np.testing.assert_array_equal(ff["image"], s["image"])
         np.testing.assert_allclose(ff["boxes"][m], s["boxes"][m])
+
+    def test_hflip_flips_difficult_rows_too(self):
+        """Geometry is keyed on labels >= 0, not the training mask —
+        difficult objects (masked from training) must still track the
+        mirrored pixels."""
+        from replication_faster_rcnn_tpu.data.augment import hflip_sample
+
+        ds = SyntheticDataset(_cfg(), length=1)
+        s = dict(ds[0])
+        m = np.asarray(s["mask"], bool).copy()
+        i = int(np.flatnonzero(m)[0])
+        m[i] = False  # pretend row i is a difficult object
+        s["mask"] = m
+        f = hflip_sample(s)
+        w = s["image"].shape[1]
+        np.testing.assert_allclose(f["boxes"][i, 1], w - s["boxes"][i, 3])
+        np.testing.assert_allclose(f["boxes"][i, 3], w - s["boxes"][i, 1])
+        # padded rows (label -1) still untouched
+        pad = s["labels"] < 0
+        np.testing.assert_array_equal(f["boxes"][pad], s["boxes"][pad])
 
     def test_hflip_pixels_follow_boxes(self):
         """The painted object must still be under its (flipped) box."""
@@ -227,10 +267,11 @@ class TestVOC:
         assert s["image"].shape == (64, 64, 3)
         assert int(s["mask"].sum()) == 2
         # original 100x50 (w x h) -> 64x64: row scale 64/50, col scale 64/100
-        # xml (xmin=10, ymin=5, xmax=60, ymax=45) -> rows [5,45], cols [10,60]
+        # xml (xmin=10, ymin=5, xmax=60, ymax=45), 1-based inclusive ->
+        # 0-based continuous rows [4,45], cols [9,60], then scaled
         np.testing.assert_allclose(
             s["boxes"][0],
-            np.round([5 * 64 / 50, 10 * 64 / 100, 45 * 64 / 50, 60 * 64 / 100]),
+            np.round([4 * 64 / 50, 9 * 64 / 100, 45 * 64 / 50, 60 * 64 / 100]),
         )
         from replication_faster_rcnn_tpu.config import VOC_CLASSES
 
