@@ -53,6 +53,10 @@ def main() -> None:
     ap.add_argument("--dtype", default="float32",
                     help="compute dtype: float32 on CPU, bfloat16 on TPU")
     ap.add_argument("--workdir", default="/tmp/map_overfit_ckpts")
+    ap.add_argument("--augment-hflip", action="store_true",
+                    help="50%% horizontal-flip train augmentation; results "
+                    "go to map_overfit_result*_aug.json so the aug-off "
+                    "baseline row is kept for comparison (VERDICT r3 #5)")
     ap.add_argument(
         "--config", default="voc_resnet18",
         choices=["voc_resnet18", "voc_resnet50_fpn"],
@@ -99,7 +103,8 @@ def main() -> None:
         model=dataclasses.replace(
             base.model, roi_op="align", compute_dtype=args.dtype
         ),
-        data=DataConfig(dataset="synthetic", image_size=size, max_boxes=8),
+        data=DataConfig(dataset="synthetic", image_size=size, max_boxes=8,
+                        augment_hflip=args.augment_hflip),
         train=TrainConfig(
             batch_size=args.batch,
             n_epoch=args.epochs,
@@ -123,6 +128,8 @@ def main() -> None:
     train_ds = SyntheticDataset(cfg.data, "train", length=args.images)
     trainer = Trainer(cfg, workdir=args.workdir, dataset=train_ds)
     suffix = "" if args.config == "voc_resnet18" else "_fpn"
+    if args.augment_hflip:
+        suffix += "_aug"
     curve_path = os.path.join(
         REPO, "benchmarks", f"map_overfit_curve{suffix}.jsonl"
     )
@@ -184,6 +191,7 @@ def main() -> None:
         "batch": args.batch,
         "lr": args.lr,
         "dtype": args.dtype,
+        "augment_hflip": args.augment_hflip,
         "train_seconds": round(train_s, 1),
         "backend": __import__("jax").default_backend(),
     }

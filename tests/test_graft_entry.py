@@ -39,6 +39,7 @@ class TestDryrunIsolation:
         # simulate the poisoned driver env that killed round 1
         monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
         monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setenv("PYTHONOPTIMIZE", "2")  # would strip child asserts
 
         g.dryrun_multichip(8)
 
@@ -46,6 +47,7 @@ class TestDryrunIsolation:
         assert env["PALLAS_AXON_POOL_IPS"] == ""  # sitecustomize skips axon
         assert env["JAX_PLATFORMS"] == "cpu"
         assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+        assert "PYTHONOPTIMIZE" not in env  # child asserts must survive -O
         # child must run from the repo dir so `import __graft_entry__` works
         assert captured["cwd"] == os.path.dirname(
             os.path.abspath(g.__file__)
