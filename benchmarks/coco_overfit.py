@@ -152,8 +152,18 @@ def main() -> None:
                    "ok": True}
         print(f"cli-train-on-coco leg ok ({cli_leg['seconds']}s)")
 
-    # leg 2 — full Trainer to convergence + COCO metric sweep
+    # leg 2 — full Trainer to convergence + COCO metric sweep.
+    # CPU by design (resnet18@128 exists for CPU tractability): force the
+    # CPU backend before any device op so running this script in the
+    # TPU-driver env can neither hang on a wedged relay nor push a
+    # multi-epoch compile at the fragile tunnel (verify SKILL.md). Safe
+    # here: no backend has been initialized in-process yet (leg 1 is a
+    # subprocess).
     import dataclasses
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
     from replication_faster_rcnn_tpu.config import (
         DataConfig, EvalConfig, MeshConfig, TrainConfig, get_config,

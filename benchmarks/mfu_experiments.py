@@ -18,6 +18,8 @@ Experiment order (value-first, so an early death still pays):
   4. adam mu bfloat16 at b16 (halves first-moment update traffic)
   5. voc_resnet50_fpn b16 (queued item b)
   6. eval-mode re-record (queued item c)
+  7. profiler trace of the b16 loop (op-level attribution, VERDICT r3 #2)
+  8. loader-fed Trainer throughput at 600x600 (VERDICT r3 #4)
 
 Run (relay must be alive — the script refuses otherwise):
   python benchmarks/mfu_experiments.py [--only N,M] [--deadline 1800]
@@ -88,6 +90,31 @@ EXPERIMENTS = [
         "why": "queued item c: re-record eval throughput post-top_k (was 328.1)",
     },
     {
+        # same compiled program as experiment 1 (cache-warm) + a profiler
+        # trace of the timed loop for op-level attribution of the
+        # backward/update split the breakdown reports (VERDICT r3 #2)
+        "name": "profile_trace_b16",
+        "env": {"BENCH_BATCH": "16"},
+        "args": ["--profile", "/tmp/trace_b16"],
+        "why": "op-level trace behind the backward_ms/opt_update_ms split",
+    },
+    {
+        # VERDICT r3 #4: the real loader-fed Trainer throughput at
+        # 600x600 — the end-to-end counterpart of the synthetic-tensor
+        # 210 img/s record. The script self-probes the backend and its
+        # trainer leg runs full-shape only on TPU.
+        "name": "loader_trainer_600",
+        "env": {},
+        "cmd": [sys.executable, "benchmarks/loader_throughput.py"],
+        "success_key": "trainer_loop",
+        # loader_throughput self-probes and falls back to a 128px CPU
+        # trainer leg; for THIS queue that fallback means the relay died
+        # mid-suite and must stop the runner, not be recorded as success
+        "require_backend": "tpu",
+        "why": "loader-fed trainer img/s at 600x600 vs the 210 synthetic",
+        "deadline": 2400,
+    },
+    {
         # LAST on purpose: compiling this kernel inside the full train-step
         # module wedged the remote service in round 1, taking the tunnel
         # down. Running it after everything else means a wedge costs no
@@ -126,8 +153,10 @@ def run_one(exp, deadline: float) -> bool:
     env = dict(os.environ)
     env.update(exp.get("env", {}))
     env["BENCH_NO_FALLBACK"] = "1"  # an experiment wants TPU or nothing
-    cmd = [sys.executable, "-m", "replication_faster_rcnn_tpu.cli", "bench"]
-    cmd += exp.get("args", [])
+    cmd = exp.get("cmd")
+    if cmd is None:
+        cmd = [sys.executable, "-m", "replication_faster_rcnn_tpu.cli", "bench"]
+        cmd += exp.get("args", [])
     with open(log, "w") as lf:
         proc = subprocess.Popen(
             cmd, stdout=lf, stderr=subprocess.STDOUT, env=env, cwd=REPO,
@@ -144,7 +173,26 @@ def run_one(exp, deadline: float) -> bool:
                 rec = json.loads(lines[-1])
             except json.JSONDecodeError:
                 rec = None
-            if rec is not None and rec.get("value"):
+            key = exp.get("success_key", "value")
+            got = rec.get(key) if rec is not None else None
+            if got and got != "pending":
+                want = exp.get("require_backend")
+                if want and (
+                    not isinstance(got, dict) or got.get("backend") != want
+                ):
+                    _append(
+                        {
+                            "name": exp["name"],
+                            "why": exp["why"],
+                            "error": f"measured on backend "
+                            f"{got.get('backend') if isinstance(got, dict) else got!r}"
+                            f", required {want} — relay likely died mid-suite",
+                            "result": rec,
+                            "log": log,
+                        }
+                    )
+                    print(f"[{exp['name']}] WRONG BACKEND (wanted {want})")
+                    return False
                 _append(
                     {
                         "name": exp["name"],
@@ -158,7 +206,7 @@ def run_one(exp, deadline: float) -> bool:
                         ),
                     }
                 )
-                print(f"[{exp['name']}] {rec.get('value')} {rec.get('unit', '')}")
+                print(f"[{exp['name']}] {rec.get(key)} {rec.get('unit', '')}")
                 return True
         if rc is not None:
             _append(

@@ -91,8 +91,13 @@ class TestBenchSuccess:
         assert bd["trunk_ms"] > 0 and bd["step_ms"] > 0
         assert set(bd) == {
             "trunk_ms", "rpn_heads_ms", "proposal_nms_ms",
-            "targets_head_loss_ms", "backward_update_ms", "step_ms",
+            "targets_head_loss_ms", "backward_ms", "opt_update_ms",
+            "backward_update_ms", "step_ms",
         }
+        # the split must account for the lump it replaces
+        assert bd["backward_update_ms"] == pytest.approx(
+            bd["backward_ms"] + bd["opt_update_ms"], abs=0.05
+        )
 
     @pytest.mark.slow
     def test_bench_eval_mode(self, capsys, monkeypatch):
