@@ -40,32 +40,22 @@ V5E_PEAK_BF16_FLOPS = 197e12
 V5E_HBM_GBPS = 819e9
 
 
-def _analyze(fn, *abstract_args):
-    import jax
-
-    lowered = jax.jit(fn).lower(*abstract_args)
-    ca = lowered.cost_analysis()
-    if isinstance(ca, (list, tuple)):
-        ca = ca[0] if ca else {}
-    return {
-        "flops": float(ca.get("flops", 0.0)),
-        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
-    }
-
-
 def main() -> None:
+    import dataclasses
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
-    import numpy as np  # noqa: F401
+    import numpy as np
+    import optax
 
+    from replication_faster_rcnn_tpu.benchmark import (
+        abstract_step_inputs,
+        lowered_cost,
+    )
     from replication_faster_rcnn_tpu.config import get_config
-    from replication_faster_rcnn_tpu.data import SyntheticDataset
-    from replication_faster_rcnn_tpu.data.loader import collate
-    from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
     from replication_faster_rcnn_tpu.train import (
-        create_train_state,
         make_optimizer,
         make_train_step,
     )
@@ -73,24 +63,14 @@ def main() -> None:
 
     batch_size = int(os.environ.get("BA_BATCH", "16"))
     cfg = get_config(os.environ.get("BA_CONFIG", "voc_resnet18"))
+    # the same abstract fixture the bench's FLOPs counter uses, at the
+    # requested batch (dataset field irrelevant: only shapes are read)
+    cfg = cfg.replace(
+        train=dataclasses.replace(cfg.train, batch_size=batch_size)
+    )
 
     tx, _ = make_optimizer(cfg, steps_per_epoch=100)
-    model = FasterRCNN(cfg)
-    state_abs = jax.eval_shape(
-        lambda rng: create_train_state(cfg, rng, tx)[1], jax.random.PRNGKey(0)
-    )
-    import dataclasses
-
-    sample = collate(
-        [SyntheticDataset(dataclasses.replace(cfg.data, dataset="synthetic"),
-                          length=1)[0]]
-    )
-    batch_abs = {
-        k: jax.ShapeDtypeStruct((batch_size,) + v.shape[1:], v.dtype)
-        for k, v in sample.items()
-    }
-
-    import optax
+    model, state_abs, batch_abs = abstract_step_inputs(cfg, tx)
 
     def forward(state, batch):
         rng = jax.random.fold_in(state.rng, state.step)
@@ -114,9 +94,9 @@ def main() -> None:
 
     step = make_train_step(model, cfg, tx)
 
-    fwd = _analyze(forward, state_abs, batch_abs)
-    grd = _analyze(grad, state_abs, batch_abs)
-    stp = _analyze(step, state_abs, batch_abs)
+    fwd = lowered_cost(forward, state_abs, batch_abs)
+    grd = lowered_cost(grad, state_abs, batch_abs)
+    stp = lowered_cost(step, state_abs, batch_abs)
 
     n_params = sum(
         int(np.prod(l.shape))

@@ -717,23 +717,18 @@ def _step_flops(cfg, batch_size):
         return None
 
 
-def _flops_of_config(cfg) -> float:
-    """HloCostAnalysis FLOPs of one train step of ``cfg`` (abstract
-    lowering — no batch arrays, no compile). Only safe on a non-plugin
-    backend; callers guard (see :func:`_step_flops`)."""
+def abstract_step_inputs(cfg, tx):
+    """(model, state_abs, batch_abs): abstract fixtures of one train step
+    — shapes/dtypes only, no arrays allocated, no param-init programs run
+    (a pure trace). Shared by the bench's FLOPs counter and the static
+    cost-attribution script (`benchmarks/backward_analysis.py`) so the
+    two can never analyze different shapes."""
     from replication_faster_rcnn_tpu.data import SyntheticDataset
     from replication_faster_rcnn_tpu.data.loader import collate
     from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
-    from replication_faster_rcnn_tpu.train import (
-        create_train_state,
-        make_optimizer,
-        make_train_step,
-    )
+    from replication_faster_rcnn_tpu.train import create_train_state
 
-    tx, _ = make_optimizer(cfg, steps_per_epoch=100)
     model = FasterRCNN(cfg)
-    # abstract init: shapes/dtypes of the train state without ever running
-    # the (compiled) param-init programs — keeps this a pure trace
     state_abs = jax.eval_shape(
         lambda rng: create_train_state(cfg, rng, tx)[1], jax.random.PRNGKey(0)
     )
@@ -743,11 +738,33 @@ def _flops_of_config(cfg) -> float:
         k: jax.ShapeDtypeStruct((b,) + v.shape[1:], v.dtype)
         for k, v in sample.items()
     }
-    step = jax.jit(make_train_step(model, cfg, tx))
-    ca = step.lower(state_abs, batch_abs).cost_analysis()
+    return model, state_abs, batch_abs
+
+
+def lowered_cost(fn, *abstract_args):
+    """{flops, bytes_accessed} of ``fn`` from HloCostAnalysis of its
+    abstract lowering (no compile). Only safe on a non-plugin backend;
+    callers guard (see :func:`_step_flops`)."""
+    ca = jax.jit(fn).lower(*abstract_args).cost_analysis()
     if isinstance(ca, (list, tuple)):
         ca = ca[0] if ca else None
-    return float(ca.get("flops", 0.0)) if ca else 0.0
+    return {
+        "flops": float(ca.get("flops", 0.0)) if ca else 0.0,
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)) if ca else 0.0,
+    }
+
+
+def _flops_of_config(cfg) -> float:
+    """HloCostAnalysis FLOPs of one train step of ``cfg`` (abstract
+    lowering — no batch arrays, no compile). Only safe on a non-plugin
+    backend; callers guard (see :func:`_step_flops`)."""
+    from replication_faster_rcnn_tpu.train import make_optimizer, make_train_step
+
+    tx, _ = make_optimizer(cfg, steps_per_epoch=100)
+    model, state_abs, batch_abs = abstract_step_inputs(cfg, tx)
+    return lowered_cost(
+        make_train_step(model, cfg, tx), state_abs, batch_abs
+    )["flops"]
 
 
 def _flops_child():

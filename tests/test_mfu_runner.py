@@ -1,0 +1,140 @@
+"""The on-chip experiment runner (`benchmarks/mfu_experiments.py`) must
+work FIRST TRY when the relay revives — its success path had never
+executed before these tests (every session since it was written found
+the relay dead). Each test drives `run_one` with a fake command instead
+of a real bench, so the polling / success-key / require-backend /
+failure logic is pinned without hardware.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+
+@pytest.fixture()
+def runner(tmp_path, monkeypatch):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "mfu_experiments", os.path.join(repo, "benchmarks", "mfu_experiments.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # results land in a scratch file, and the 10s poll becomes a short
+    # REAL sleep (a no-op would busy-wait and starve the child)
+    monkeypatch.setattr(mod, "OUT", str(tmp_path / "out.json"))
+    real_sleep = mod.time.sleep
+    monkeypatch.setattr(mod.time, "sleep", lambda s: real_sleep(0.05))
+    return mod
+
+
+def _records(mod):
+    with open(mod.OUT) as f:
+        return json.load(f)["experiments"]
+
+
+def _echo_exp(payload, **kw):
+    """An experiment whose 'bench' just prints one JSON line.
+
+    ``-S`` skips sitecustomize (which imports jax and costs ~7s of
+    interpreter startup per child on this image); the fakes only need
+    builtins."""
+    return {
+        "name": "fake",
+        "why": "test",
+        "cmd": [sys.executable, "-S", "-c", f"print('{json.dumps(payload)}')"],
+        **kw,
+    }
+
+
+class TestRunOne:
+    def test_success_records_measurement(self, runner):
+        exp = _echo_exp({"value": 123.4, "unit": "images/sec"})
+        assert runner.run_one(exp, deadline=30) is True
+        (rec,) = _records(runner)
+        assert rec["name"] == "fake"
+        assert rec["result"]["value"] == 123.4
+        assert "recorded_utc" in rec and "wall_s" in rec
+
+    def test_custom_success_key(self, runner):
+        exp = _echo_exp(
+            {"trainer_loop": {"images_per_sec": 55.0, "backend": "tpu"}},
+            success_key="trainer_loop",
+        )
+        assert runner.run_one(exp, deadline=30) is True
+        (rec,) = _records(runner)
+        assert rec["result"]["trainer_loop"]["images_per_sec"] == 55.0
+
+    def test_require_backend_rejects_cpu_fallback(self, runner):
+        """A CPU-fallback measurement mid-suite means the relay died —
+        it must STOP the runner, not masquerade as a success."""
+        exp = _echo_exp(
+            {"trainer_loop": {"images_per_sec": 1.0, "backend": "cpu"}},
+            success_key="trainer_loop",
+            require_backend="tpu",
+        )
+        assert runner.run_one(exp, deadline=30) is False
+        (rec,) = _records(runner)
+        assert "error" in rec and "cpu" in rec["error"]
+
+    def test_pending_value_is_not_success(self, runner):
+        """loader_throughput emits trainer_loop='pending' before the
+        real record; the poll must wait through the sentinel (which it
+        genuinely observes: the child flushes it, then sleeps past
+        several poll intervals) and record the FINAL line."""
+        code = (
+            "import json, sys, time;"
+            "print(json.dumps({'trainer_loop': 'pending'}));"
+            "sys.stdout.flush();"
+            "time.sleep(1.0);"
+            "print(json.dumps({'trainer_loop':"
+            " {'images_per_sec': 9.0, 'backend': 'tpu'}}))"
+        )
+        exp = {
+            "name": "fake",
+            "why": "test",
+            "cmd": [sys.executable, "-S", "-c", code],
+            "success_key": "trainer_loop",
+            "require_backend": "tpu",
+        }
+        assert runner.run_one(exp, deadline=30) is True
+        (rec,) = _records(runner)
+        # the recorded result must be the real record, not the sentinel
+        assert rec["result"]["trainer_loop"]["images_per_sec"] == 9.0
+
+    def test_exit_without_measurement_fails(self, runner):
+        exp = {
+            "name": "fake",
+            "why": "test",
+            "cmd": [sys.executable, "-S", "-c", "import sys; sys.exit(7)"],
+        }
+        assert runner.run_one(exp, deadline=30) is False
+        (rec,) = _records(runner)
+        assert "rc=7" in rec["error"]
+
+    def test_default_cmd_is_cli_bench(self, runner, monkeypatch):
+        """Without a cmd override the runner launches the real CLI bench
+        with the experiment's args appended."""
+        captured = {}
+
+        class FakeProc:
+            pid = 1
+
+            def poll(self):
+                return 0
+
+        def fake_popen(cmd, **kw):
+            captured["cmd"] = cmd
+            # write a valid measurement into the log the runner polls
+            kw["stdout"].write('{"value": 1.0}\n')
+            kw["stdout"].flush()
+            return FakeProc()
+
+        monkeypatch.setattr(runner.subprocess, "Popen", fake_popen)
+        exp = {"name": "fake", "why": "t", "args": ["--batch-size", "16"]}
+        assert runner.run_one(exp, deadline=30) is True
+        assert captured["cmd"][-4:] == [
+            "replication_faster_rcnn_tpu.cli", "bench", "--batch-size", "16",
+        ]
