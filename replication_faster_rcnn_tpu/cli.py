@@ -277,6 +277,30 @@ def cmd_viz(args) -> int:
     return 0
 
 
+def cmd_trace_summary(args) -> int:
+    """Op-level time table from a captured profiler trace (the dir passed
+    to --profile). Pure host-side parsing — no jax import, safe with a
+    dead TPU tunnel."""
+    import json
+
+    from replication_faster_rcnn_tpu.utils.xplane import (
+        find_xplane_files,
+        format_table,
+        op_table,
+    )
+
+    if not find_xplane_files(args.trace_dir):
+        print(f"no *.xplane.pb under {args.trace_dir}", file=sys.stderr)
+        return 1
+    rows = op_table(args.trace_dir, plane_filter=args.plane, top=args.top)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"trace_dir": args.trace_dir, "ops": rows}, f, indent=2)
+        print(f"op table written to {args.json}")
+    print(format_table(rows))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(prog="replication_faster_rcnn_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -341,6 +365,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_viz.add_argument("--index", type=int, default=0,
                        help="dataset sample index (what=sample)")
     p_viz.set_defaults(fn=cmd_viz)
+
+    p_trace = sub.add_parser(
+        "trace-summary",
+        help="per-op time table from a --profile trace dir (no TF needed)",
+    )
+    p_trace.add_argument("trace_dir")
+    p_trace.add_argument("--top", type=int, default=25)
+    p_trace.add_argument("--plane", default=None,
+                         help="substring filter on the plane name "
+                              "(default: device planes, else all)")
+    p_trace.add_argument("--json", default=None, metavar="PATH",
+                         help="also write the table as JSON")
+    p_trace.set_defaults(fn=cmd_trace_summary)
 
     args = parser.parse_args(argv)
     return args.fn(args)
