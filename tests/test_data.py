@@ -289,6 +289,52 @@ class TestVOC:
         )
         assert int(ds2[0]["mask"].sum()) == 2
 
+    def test_hflip_tracks_pixels_end_to_end(self, tmp_path):
+        """0-based parse + hflip must keep the box on the painted object
+        through the real JPEG->parse->flip path (the ADVICE r3 coordinate
+        finding: with raw 1-based coords the flipped box shifts ~1px off
+        the mirrored pixels; with mins-1 it is exact)."""
+        from PIL import Image
+
+        from replication_faster_rcnn_tpu.data.augment import hflip_sample
+
+        root = str(tmp_path / "VOC2007")
+        os.makedirs(os.path.join(root, "ImageSets/Main"), exist_ok=True)
+        os.makedirs(os.path.join(root, "JPEGImages"), exist_ok=True)
+        os.makedirs(os.path.join(root, "Annotations"), exist_ok=True)
+        with open(os.path.join(root, "ImageSets/Main/train.txt"), "w") as f:
+            f.write("img0\n")
+        # 64x64 dark image, bright block on pixel columns 8..23 rows
+        # 16..39 (0-based inclusive). VOC XML is 1-based inclusive.
+        arr = np.zeros((64, 64, 3), np.uint8)
+        arr[16:40, 8:24] = 255
+        Image.fromarray(arr).save(
+            os.path.join(root, "JPEGImages", "img0.jpg"), quality=95
+        )
+        ann = ET.Element("annotation")
+        obj = ET.SubElement(ann, "object")
+        ET.SubElement(obj, "name").text = "dog"
+        bnd = ET.SubElement(obj, "bndbox")
+        ET.SubElement(bnd, "xmin").text = "9"    # 1-based: col 8
+        ET.SubElement(bnd, "ymin").text = "17"   # 1-based: row 16
+        ET.SubElement(bnd, "xmax").text = "24"   # 1-based: col 23
+        ET.SubElement(bnd, "ymax").text = "40"   # 1-based: row 39
+        ET.ElementTree(ann).write(
+            os.path.join(root, "Annotations", "img0.xml")
+        )
+
+        ds = VOCDataset(_cfg(dataset="voc", root_dir=root), "train")
+        s = ds[0]
+        # 0-based continuous: [16, 8, 40, 24] (no resize: image is 64x64)
+        np.testing.assert_allclose(s["boxes"][0], [16.0, 8.0, 40.0, 24.0])
+        f = hflip_sample(s)
+        r1, c1, r2, c2 = (int(round(v)) for v in f["boxes"][0])
+        assert (c1, c2) == (64 - 24, 64 - 8)
+        # the flipped box must sit exactly on the mirrored bright block
+        inside = f["image"][r1:r2, c1:c2].mean()
+        ring = f["image"][r1:r2, max(c1 - 3, 0):c1].mean()
+        assert inside > ring + 1.0  # normalized units: bright vs dark
+
     def test_unknown_class_raises(self, tmp_path):
         root = str(tmp_path / "VOC2007")
         _write_voc(root, ["img0"])
