@@ -49,6 +49,8 @@ def _build_config(args):
         data_kw["loader_mode"] = args.loader_mode
     if getattr(args, "augment_hflip", False):
         data_kw["augment_hflip"] = True
+    if getattr(args, "cache_ram", False):
+        data_kw["loader_cache_ram"] = True
     if data_kw:
         cfg = cfg.replace(data=dataclasses.replace(cfg.data, **data_kw))
     train_kw = {}
@@ -134,6 +136,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    choices=[None, "thread", "process"],
                    help="input workers as GIL-releasing threads (native "
                         "decode) or forked processes (Python-bound work)")
+    p.add_argument("--cache-ram", action="store_true",
+                   help="cache decoded samples in host RAM (epoch 1 pays "
+                        "the decode, later epochs are memcpy; bounded by "
+                        "FRCNN_CACHE_MAX_BYTES, default 64 GiB)")
     p.add_argument("--augment-hflip", action="store_true",
                    help="50%% horizontal-flip train augmentation "
                         "(deterministic per seed/epoch/index)")
@@ -233,7 +239,7 @@ def cmd_bench(args) -> int:
         )
     ) or (
         args.spatial or args.remat or args.shard_opt or args.augment_hflip
-        or args.config != "voc_resnet18"
+        or args.cache_ram or args.config != "voc_resnet18"
     )
     bench_main(_build_config(args) if flagged else None, profile_dir=args.profile)
     return 0

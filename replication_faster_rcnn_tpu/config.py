@@ -195,6 +195,10 @@ class DataConfig:
     loader_workers: int = 4
     loader_mode: str = "thread"  # thread | process
     loader_prefetch: int = 2
+    # memoize decoded samples in host RAM (data/cache.py): epoch 1 pays
+    # the decode, later epochs are memcpy — the single-core host's only
+    # route past the decode-bound ingest ceiling
+    loader_cache_ram: bool = False
     # 50% horizontal-flip train augmentation (the original Faster R-CNN
     # recipe's only augmentation; the reference trains with none —
     # utils/data_loader.py:56-79 resizes+normalizes only). Deterministic

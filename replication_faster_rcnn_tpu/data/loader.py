@@ -68,6 +68,11 @@ class DataLoader:
         step is compiled for exactly batch_size).
       prefetch: max batches buffered ahead (0 disables threading).
       num_workers: workers assembling samples within a batch.
+      cache_ram: memoize decoded samples in host RAM (`data/cache.py`):
+        epoch 1 pays the decode, every later epoch is a memcpy. The
+        single-core answer to an input-bound chip — decode throughput
+        can't be scaled by workers when there is one core. Bounded by
+        FRCNN_CACHE_MAX_BYTES (default 64 GiB).
       worker_mode: "thread" (default — the native decode path releases
         the GIL, so threads scale it across cores) or "process" —
         fork-based worker processes, one whole batch per task, results
@@ -92,11 +97,16 @@ class DataLoader:
         worker_mode: str = "thread",
         augment_hflip: bool = False,
         stall_timeout: float = 120.0,
+        cache_ram: bool = False,
     ) -> None:
         if worker_mode not in ("thread", "process"):
             raise ValueError(f"worker_mode must be thread|process, got {worker_mode!r}")
         self.stall_timeout = float(stall_timeout)
         self.augment_hflip = augment_hflip
+        if cache_ram:
+            from replication_faster_rcnn_tpu.data.cache import CachedView
+
+            dataset = CachedView(dataset)
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -152,6 +162,14 @@ class DataLoader:
         on sequence number — checkpoint-resume reproducibility must not
         depend on worker scheduling). In-flight tasks are bounded so the
         result queue never holds more than workers+prefetch batches."""
+        from replication_faster_rcnn_tpu.data.cache import CachedView
+
+        if isinstance(self.dataset, CachedView):
+            # forked workers fill copy-on-write caches that die with them
+            # (workers are re-forked each epoch) — warming in the parent
+            # FIRST makes the cache genuinely shared; without this,
+            # cache_ram + process mode silently re-decodes every epoch
+            self.dataset.warm()
         ctx = multiprocessing.get_context("fork")
         task_q = ctx.Queue()
         result_q = ctx.Queue()

@@ -94,6 +94,17 @@ class Evaluator:
         # releases the GIL); on the PIL/numpy fallback path eval ingest is
         # GIL-bound at ~1 worker — accepted, eval is a small fraction of
         # a training run and a hung eval would stall the whole run.
+        if self.config.data.loader_cache_ram:
+            # the cache must outlive this call to save anything: in-training
+            # eval calls evaluate() once per eval epoch with the same val
+            # dataset, and a per-call CachedView would decode the whole
+            # split every time for zero benefit
+            if getattr(self, "_cached_base", None) is not dataset:
+                from replication_faster_rcnn_tpu.data.cache import CachedView
+
+                self._cached_base = dataset
+                self._cached_view = CachedView(dataset)
+            dataset = self._cached_view
         loader = DataLoader(
             dataset, batch_size=batch_size, shuffle=False, drop_last=False,
             prefetch=self.config.data.loader_prefetch,

@@ -61,6 +61,7 @@ class FRCNN:
             num_workers=cfg.data.loader_workers,
             worker_mode=cfg.data.loader_mode,
             augment_hflip=cfg.data.augment_hflip and self.mode == "train",
+            cache_ram=cfg.data.loader_cache_ram,
         )
 
     def get_network(self) -> Tuple[object, dict]:

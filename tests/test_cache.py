@@ -1,0 +1,159 @@
+"""RAM sample-cache tests (`data/cache.py`): hit/miss semantics, the
+byte bound, isolation of cached arrays, and composition with the hflip
+augmentation view and the DataLoader."""
+
+import numpy as np
+
+from replication_faster_rcnn_tpu.config import DataConfig
+from replication_faster_rcnn_tpu.data import DataLoader, SyntheticDataset
+from replication_faster_rcnn_tpu.data.augment import AugmentedView
+from replication_faster_rcnn_tpu.data.cache import CachedView
+
+
+def _cfg(**kw):
+    defaults = dict(dataset="synthetic", image_size=(32, 32), max_boxes=4)
+    defaults.update(kw)
+    return DataConfig(**defaults)
+
+
+class _Counting:
+    """Dataset wrapper counting real __getitem__ decodes."""
+
+    def __init__(self, ds):
+        self.ds = ds
+        self.calls = 0
+
+    def __len__(self):
+        return len(self.ds)
+
+    def __getitem__(self, i):
+        self.calls += 1
+        return self.ds[i]
+
+
+class TestCachedView:
+    def test_decodes_once_and_returns_equal_samples(self):
+        base = _Counting(SyntheticDataset(_cfg(), length=6))
+        cv = CachedView(base)
+        first = [cv[i] for i in range(6)]
+        again = [cv[i] for i in range(6)]
+        assert base.calls == 6
+        for a, b in zip(first, again):
+            for k in a:
+                np.testing.assert_array_equal(a[k], b[k])
+        assert cv.nbytes > 0
+
+    def test_byte_bound_passes_through_uncached(self):
+        base = _Counting(SyntheticDataset(_cfg(), length=4))
+        cv = CachedView(base, max_bytes=0)
+        s0 = cv[0]
+        s0b = cv[0]
+        assert base.calls == 2  # nothing cached
+        assert cv.nbytes == 0
+        np.testing.assert_array_equal(s0["image"], s0b["image"])
+
+    def test_caller_key_replacement_does_not_poison_cache(self):
+        cv = CachedView(SyntheticDataset(_cfg(), length=2))
+        s = cv[0]
+        orig = s["image"].copy()
+        s["image"] = np.zeros_like(s["image"])  # replace a key, as hflip does
+        np.testing.assert_array_equal(cv[0]["image"], orig)
+
+    def test_delegates_metadata(self):
+        ds = SyntheticDataset(_cfg(), length=2)
+        cv = CachedView(ds)
+        assert len(cv) == 2
+        # attribute delegation: anything the base dataset exposes
+        assert cv.cfg is ds.cfg
+
+    def test_composes_with_augmented_view(self):
+        base = _Counting(SyntheticDataset(_cfg(), length=16))
+        cv = CachedView(base)
+        e0 = [AugmentedView(cv, seed=0, epoch=0)[i] for i in range(16)]
+        e1 = [AugmentedView(cv, seed=0, epoch=1)[i] for i in range(16)]
+        # decode cost paid once, not per epoch
+        assert base.calls == 16
+        # flips re-roll across epochs on top of the cache
+        differs = [
+            not np.array_equal(a["image"], b["image"]) for a, b in zip(e0, e1)
+        ]
+        assert any(differs)
+
+
+class TestLoaderCacheRam:
+    def test_same_batches_with_and_without_cache(self):
+        ds = SyntheticDataset(_cfg(), length=12)
+        mk = lambda cache: DataLoader(  # noqa: E731
+            ds, batch_size=4, shuffle=True, seed=3, prefetch=0,
+            num_workers=1, cache_ram=cache,
+        )
+        plain, cached = mk(False), mk(True)
+        for epoch in range(2):
+            plain.set_epoch(epoch)
+            cached.set_epoch(epoch)
+            for a, b in zip(plain, cached):
+                for k in a:
+                    np.testing.assert_array_equal(a[k], b[k])
+
+    def test_process_mode_warms_parent_cache(self):
+        # fork workers die each epoch, taking their CoW caches with
+        # them — the loader must warm the parent cache first so epoch 2
+        # costs the parent zero decodes
+        base = _Counting(SyntheticDataset(_cfg(), length=8))
+        dl = DataLoader(
+            base, batch_size=4, shuffle=False, prefetch=1, num_workers=2,
+            worker_mode="process", cache_ram=True,
+        )
+        list(dl)
+        assert base.calls == 8  # warm() in the parent, children hit CoW
+        dl.set_epoch(1)
+        list(dl)
+        assert base.calls == 8
+
+    def test_second_epoch_hits_cache(self):
+        base = _Counting(SyntheticDataset(_cfg(), length=8))
+        dl = DataLoader(
+            base, batch_size=4, shuffle=False, prefetch=0, num_workers=1,
+            cache_ram=True,
+        )
+        list(dl)
+        assert base.calls == 8
+        dl.set_epoch(1)
+        list(dl)
+        assert base.calls == 8
+
+
+def test_evaluator_reuses_cache_across_evaluate_calls():
+    import jax
+
+    from replication_faster_rcnn_tpu.config import (
+        EvalConfig,
+        FasterRCNNConfig,
+        ModelConfig,
+    )
+    from replication_faster_rcnn_tpu.eval import Evaluator
+    from replication_faster_rcnn_tpu.models import faster_rcnn
+
+    cfg = FasterRCNNConfig(
+        model=ModelConfig(
+            backbone="resnet18", roi_op="align", compute_dtype="float32"
+        ),
+        data=DataConfig(
+            dataset="synthetic", image_size=(64, 64), max_boxes=8,
+            loader_cache_ram=True,
+        ),
+        eval=EvalConfig(max_detections=20),
+    )
+    model, variables = faster_rcnn.init_variables(cfg, jax.random.PRNGKey(0))
+    base = _Counting(
+        SyntheticDataset(
+            _cfg(image_size=(64, 64), max_boxes=8), split="val", length=4
+        )
+    )
+    ev = Evaluator(cfg, model)
+    ev.evaluate(variables, base, batch_size=2)
+    assert base.calls == 4
+    # in-training eval calls evaluate() repeatedly with the SAME dataset:
+    # the decoded-sample cache must persist across calls
+    ev.evaluate(variables, base, batch_size=2)
+    assert base.calls == 4
