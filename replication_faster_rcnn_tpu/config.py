@@ -199,6 +199,11 @@ class DataConfig:
     # the decode, later epochs are memcpy — the single-core host's only
     # route past the decode-bound ingest ceiling
     loader_cache_ram: bool = False
+    # ship uint8 images to the device and normalize on-chip (the model's
+    # preprocess, fused by XLA into the first conv): 4x less host->device
+    # transfer, 4x smaller RAM cache, 4x cheaper collate. Off by default:
+    # the f32 path matches the reference bit-for-bit
+    device_normalize: bool = False
     # 50% horizontal-flip train augmentation (the original Faster R-CNN
     # recipe's only augmentation; the reference trains with none —
     # utils/data_loader.py:56-79 resizes+normalizes only). Deterministic

@@ -64,7 +64,8 @@ class COCODataset:
         info = self.images[img_id]
         path = os.path.join(self.cfg.root_dir, self.split, info["file_name"])
         image, orig_h, orig_w = _load_image(
-            path, self.cfg.image_size, self.cfg.pixel_mean, self.cfg.pixel_std
+            path, self.cfg.image_size, self.cfg.pixel_mean,
+            self.cfg.pixel_std, self.cfg.device_normalize,
         )
 
         m = self.cfg.max_boxes

@@ -265,3 +265,39 @@ def nms(
     keep = np.empty((max(max_keep, 1),), np.int32)
     n = lib.nms_greedy(boxes, scores, len(boxes), thresh, keep, max_keep)
     return keep[:n]
+
+
+# --- uint8 (device-normalize) variants -----------------------------------
+# With mean=0 and std=1/255 the fused kernel's (x/255 - mean)/std affine
+# is the identity on pixel values, so the SAME native code yields the
+# resized image in 0..255 — no second C++ entry point needed. The f32->u8
+# rounding costs ~1 ms once per sample (and only once ever with the RAM
+# cache); in exchange the sample ships to the device at a quarter of the
+# bytes and the normalize runs on-chip fused into the first conv
+# (models/faster_rcnn.py::preprocess).
+
+_U8_MEAN = (0.0, 0.0, 0.0)
+_U8_STD = (1.0 / 255.0, 1.0 / 255.0, 1.0 / 255.0)
+
+
+def _to_u8(arr: np.ndarray) -> np.ndarray:
+    return np.clip(np.rint(arr), 0.0, 255.0).astype(np.uint8)
+
+
+def resize_u8(img: np.ndarray, out_hw: Tuple[int, int]) -> np.ndarray:
+    """uint8 HWC RGB -> bilinear-resized uint8 [out_h, out_w, 3]."""
+    return _to_u8(resize_normalize(img, out_hw, _U8_MEAN, _U8_STD))
+
+
+def decode_jpeg_resize_u8(
+    data: bytes, out_hw: Tuple[int, int], fast_scale: bool = True
+) -> Optional[Tuple[np.ndarray, int, int]]:
+    """JPEG bytes -> (resized uint8 [out_h, out_w, 3], orig_h, orig_w);
+    None if the native decoder is unavailable (caller falls back)."""
+    res = decode_jpeg_resize_normalize(
+        data, out_hw, _U8_MEAN, _U8_STD, fast_scale
+    )
+    if res is None:
+        return None
+    out, orig_h, orig_w = res
+    return _to_u8(out), orig_h, orig_w

@@ -67,11 +67,17 @@ class SyntheticDataset:
                 -0.05, 0.05, (bh, bw, 3)
             ).astype(np.float32)
 
-        mean = np.asarray(self.cfg.pixel_mean, np.float32)
-        std = np.asarray(self.cfg.pixel_std, np.float32)
-        image = (image - mean) / std
+        if self.cfg.device_normalize:
+            # raw pixels in [0, 1] -> uint8; the model's on-device
+            # preprocess applies /255 + mean/std (so the u8 and f32 paths
+            # see the same image up to 1/255 quantization)
+            image = np.clip(np.rint(image * 255.0), 0, 255).astype(np.uint8)
+        else:
+            mean = np.asarray(self.cfg.pixel_mean, np.float32)
+            std = np.asarray(self.cfg.pixel_std, np.float32)
+            image = (image - mean) / std
         return {
-            "image": image.astype(np.float32),
+            "image": image,  # uint8 or float32 per the branch above
             "boxes": boxes,
             "labels": labels,
             "mask": labels >= 0,

@@ -37,8 +37,11 @@ from replication_faster_rcnn_tpu.config import DataConfig, VOC_CLASSES
 from replication_faster_rcnn_tpu.data import native_ops
 
 
-def _load_image(path: str, image_size, pixel_mean, pixel_std):
-    """JPEG -> normalized float32 [H, W, 3] + original size.
+def _load_image(path: str, image_size, pixel_mean, pixel_std,
+                device_normalize: bool = False):
+    """JPEG -> normalized float32 [H, W, 3] + original size — or, with
+    ``device_normalize``, resized uint8 (normalization deferred to the
+    model's on-device preprocess, a quarter of the host->device bytes).
 
     Fast path: one native C++ call does decode + RGB conversion + bilinear
     resize + normalize (native/frcnn_native.cpp, libjpeg with DCT-domain
@@ -49,8 +52,12 @@ def _load_image(path: str, image_size, pixel_mean, pixel_std):
     """
     with open(path, "rb") as f:
         data = f.read()
-    native = native_ops.decode_jpeg_resize_normalize(
-        data, image_size, pixel_mean, pixel_std
+    native = (
+        native_ops.decode_jpeg_resize_u8(data, image_size)
+        if device_normalize
+        else native_ops.decode_jpeg_resize_normalize(
+            data, image_size, pixel_mean, pixel_std
+        )
     )
     if native is not None:
         return native
@@ -62,6 +69,8 @@ def _load_image(path: str, image_size, pixel_mean, pixel_std):
         im = im.convert("RGB")
         orig_w, orig_h = im.size
         arr = np.asarray(im, np.uint8)
+    if device_normalize:
+        return native_ops.resize_u8(arr, image_size), orig_h, orig_w
     out = native_ops.resize_normalize(arr, image_size, pixel_mean, pixel_std)
     return out, orig_h, orig_w
 
@@ -143,7 +152,8 @@ class VOCDataset:
         xml_path = os.path.join(self.root, "Annotations", img_id + ".xml")
 
         image, orig_h, orig_w = _load_image(
-            img_path, self.cfg.image_size, self.cfg.pixel_mean, self.cfg.pixel_std
+            img_path, self.cfg.image_size, self.cfg.pixel_mean,
+            self.cfg.pixel_std, self.cfg.device_normalize,
         )
         labels, boxes, difficult = self._parse_annotation(xml_path)
         real = labels >= 0
@@ -156,7 +166,9 @@ class VOCDataset:
         # `data_loader.py:108-109`); eval reads `difficult` to ignore them
         mask = real if self.cfg.use_difficult else (real & ~difficult)
         return {
-            "image": image.astype(np.float32),
+            # _load_image returns float32 (host-normalized) or uint8
+            # (device_normalize) — either is the contract dtype already
+            "image": image,
             "boxes": boxes.astype(np.float32),
             "labels": labels,
             "mask": mask,

@@ -97,10 +97,25 @@ class FasterRCNN(nn.Module):
 
     # --- stage methods (used individually by the trainer) ---
 
+    def preprocess(self, images: Array) -> Array:
+        """uint8 NHWC -> normalized float32, on device.
+
+        With ``data.device_normalize`` the host ships raw bytes (a quarter
+        of the f32 transfer volume — the tunnel/PCIe hop is the fed
+        trainer's bottleneck, not the chip) and this affine runs on-chip,
+        where XLA fuses it into the first conv's input. float32 input
+        passes through untouched (the host already normalized it)."""
+        if images.dtype == jnp.uint8:
+            mean = jnp.asarray(self.config.data.pixel_mean, jnp.float32)
+            std = jnp.asarray(self.config.data.pixel_std, jnp.float32)
+            images = (images.astype(jnp.float32) / 255.0 - mean) / std
+        return images
+
     def extract_features(self, images: Array, train: bool = False):
         """images NHWC [N, H, W, 3] -> shared features.
 
         Single-scale: one [N, H/16, W/16, C] map. FPN: list [P2..P6]."""
+        images = self.preprocess(images)
         if self.config.model.fpn:
             return self.neck(self.trunk(images, train))
         return self.trunk(images, train)

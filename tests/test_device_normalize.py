@@ -1,0 +1,141 @@
+"""uint8-transfer / on-device-normalize path (`data.device_normalize`):
+host ships raw bytes, the model's preprocess applies /255 + mean/std
+on-device. Tests pin the u8 and f32 paths to each other."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from replication_faster_rcnn_tpu.config import (
+    DataConfig,
+    FasterRCNNConfig,
+    ModelConfig,
+)
+from replication_faster_rcnn_tpu.data import SyntheticDataset, collate
+from replication_faster_rcnn_tpu.data import native_ops
+from replication_faster_rcnn_tpu.data.voc import _load_image
+from replication_faster_rcnn_tpu.models import faster_rcnn
+
+MEAN = (0.485, 0.456, 0.406)
+STD = (0.229, 0.224, 0.225)
+
+
+def _cfg(**kw):
+    defaults = dict(dataset="synthetic", image_size=(64, 64), max_boxes=8)
+    defaults.update(kw)
+    return DataConfig(**defaults)
+
+
+class TestU8Kernels:
+    def test_resize_u8_matches_affine_identity(self):
+        rng = np.random.RandomState(0)
+        img = rng.randint(0, 256, (37, 53, 3), np.uint8)
+        out = native_ops.resize_u8(img, (64, 64))
+        assert out.dtype == np.uint8 and out.shape == (64, 64, 3)
+        ref = native_ops.resize_normalize(
+            img, (64, 64), native_ops._U8_MEAN, native_ops._U8_STD
+        )
+        np.testing.assert_array_equal(
+            out, np.clip(np.rint(ref), 0, 255).astype(np.uint8)
+        )
+
+    def test_load_image_u8_consistent_with_f32(self, tmp_path):
+        from PIL import Image
+
+        rng = np.random.RandomState(1)
+        arr = rng.randint(0, 256, (40, 60, 3), np.uint8)
+        p = tmp_path / "x.jpg"
+        Image.fromarray(arr).save(str(p), quality=95)
+        f32, h32, w32 = _load_image(str(p), (32, 32), MEAN, STD)
+        u8, h8, w8 = _load_image(
+            str(p), (32, 32), MEAN, STD, device_normalize=True
+        )
+        assert (h32, w32) == (h8, w8) == (40, 60)
+        assert u8.dtype == np.uint8 and f32.dtype == np.float32
+        renorm = (u8.astype(np.float32) / 255.0 - np.asarray(MEAN, np.float32)) / (
+            np.asarray(STD, np.float32)
+        )
+        # quantization to 1/255 plus one rounding: within half a level
+        assert np.max(np.abs(renorm - f32)) <= (0.75 / 255.0) / min(STD)
+
+
+class TestSyntheticU8:
+    def test_u8_sample_quantizes_f32_sample(self):
+        f = SyntheticDataset(_cfg(), length=2)[0]
+        u = SyntheticDataset(_cfg(device_normalize=True), length=2)[0]
+        assert u["image"].dtype == np.uint8
+        np.testing.assert_array_equal(f["boxes"], u["boxes"])
+        renorm = (
+            u["image"].astype(np.float32) / 255.0 - np.asarray(MEAN, np.float32)
+        ) / np.asarray(STD, np.float32)
+        # f32 path normalizes the raw float; u8 path its 1/255 quantization
+        # (clipped at 1.0 — synthetic object pixels can slightly exceed it)
+        raw = np.clip(
+            f["image"] * np.asarray(STD, np.float32)
+            + np.asarray(MEAN, np.float32),
+            None, 1.0,
+        )
+        clipped_ref = (raw - np.asarray(MEAN, np.float32)) / np.asarray(
+            STD, np.float32
+        )
+        assert np.max(np.abs(renorm - clipped_ref)) <= (0.75 / 255.0) / min(STD)
+
+    def test_collate_preserves_uint8(self):
+        ds = SyntheticDataset(_cfg(device_normalize=True), length=4)
+        batch = collate([ds[i] for i in range(4)])
+        assert batch["image"].dtype == np.uint8
+
+
+class TestModelPreprocess:
+    def test_preprocess_exactly_matches_host_normalize(self):
+        cfg = FasterRCNNConfig(
+            model=ModelConfig(
+                backbone="resnet18", roi_op="align", compute_dtype="float32"
+            ),
+            data=_cfg(device_normalize=True),
+        )
+        model = faster_rcnn.create(cfg)
+        rng = np.random.RandomState(2)
+        u8 = rng.randint(0, 256, (1, 64, 64, 3), np.uint8)
+        got = model.apply({}, jnp.asarray(u8), method="preprocess")
+        want = (
+            u8.astype(np.float32) / 255.0 - np.asarray(cfg.data.pixel_mean)
+        ) / np.asarray(cfg.data.pixel_std)
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+
+    def test_f32_passthrough_untouched(self):
+        cfg = FasterRCNNConfig(model=ModelConfig(backbone="resnet18"),
+                               data=_cfg())
+        model = faster_rcnn.create(cfg)
+        x = jnp.ones((1, 8, 8, 3), jnp.float32) * 0.5
+        out = model.apply({}, x, method="preprocess")
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+@pytest.mark.slow
+def test_train_step_runs_on_u8_batch():
+    from replication_faster_rcnn_tpu.train.train_step import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    cfg = FasterRCNNConfig(
+        model=ModelConfig(
+            backbone="resnet18", roi_op="align", compute_dtype="float32"
+        ),
+        data=_cfg(device_normalize=True),
+    )
+    tx, _ = make_optimizer(cfg, steps_per_epoch=10)
+    model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
+    ds = SyntheticDataset(cfg.data, length=2)
+    batch = collate([ds[0], ds[1]])
+    assert batch["image"].dtype == np.uint8
+    step = jax.jit(make_train_step(model, cfg, tx))
+    new_state, metrics = step(
+        state, {k: jnp.asarray(v) for k, v in batch.items()}
+    )
+    assert np.isfinite(float(metrics["loss"]))
