@@ -93,8 +93,11 @@ def main() -> None:
     per_sample_s = (time.time() - t0) / n_images
     single_rate = 1.0 / per_sample_s
 
-    def _loader_rate(warm_epochs: int = 0, **kw):
-        loader = DataLoader(ds, batch_size=8, shuffle=True, prefetch=2, **kw)
+    def _loader_rate(warm_epochs: int = 0, dataset=None, **kw):
+        loader = DataLoader(
+            dataset if dataset is not None else ds,
+            batch_size=8, shuffle=True, prefetch=2, **kw,
+        )
         for epoch in range(warm_epochs):
             loader.set_epoch(epoch)
             for _ in loader:
@@ -121,6 +124,15 @@ def main() -> None:
     # answer to keeps_up_one_chip=false
     loader_rate_cached = _loader_rate(
         warm_epochs=1, num_workers=1, cache_ram=True
+    )
+    # uint8 samples (device_normalize): 4x smaller cache entries and 4x
+    # less collate memcpy — the steady-state ceiling for the fed trainer's
+    # host side when normalization runs on-chip
+    import dataclasses as _dc
+
+    ds_u8 = VOCDataset(_dc.replace(cfg, device_normalize=True), "train")
+    loader_rate_cached_u8 = _loader_rate(
+        warm_epochs=1, dataset=ds_u8, num_workers=1, cache_ram=True
     )
 
     # the fused resize+normalize kernel alone: native C++ vs numpy fallback
@@ -159,6 +171,7 @@ def main() -> None:
             "loader_process_mode_images_per_sec": round(loader_rate_mp, 2),
             "loader_process_mode_workers": mp_workers,
             "loader_cached_images_per_sec": round(loader_rate_cached, 2),
+            "loader_cached_u8_images_per_sec": round(loader_rate_cached_u8, 2),
             "resize_normalize_native_per_sec": (
                 round(kernel["native"], 2) if kernel.get("native") else None
             ),
