@@ -226,9 +226,14 @@ def main() -> None:
         size = (600, 600) if on_tpu else (128, 128)
         batch = 16 if on_tpu else 4
         n_epoch = 3
+        # LOADER_BENCH_U8=1: run the fed legs on the uint8/device-normalize
+        # path — 4x less host->device bytes per step, the honest
+        # counterpart measurement for --device-normalize
+        u8_feed = os.environ.get("LOADER_BENCH_U8", "0") == "1"
         tcfg = get_config("voc_resnet18").replace(
             data=DataConfig(
-                dataset="synthetic", image_size=size, max_boxes=8
+                dataset="synthetic", image_size=size, max_boxes=8,
+                device_normalize=u8_feed,
             ),
             train=TrainConfig(batch_size=batch, n_epoch=n_epoch),
             mesh=MeshConfig(num_data=1),
@@ -252,6 +257,7 @@ def main() -> None:
             "batch": batch,
             "path": "Trainer.train_one_batch through DataLoader + "
             "shard_batch (host->device each step)",
+            "u8_feed": u8_feed,
         }
 
     # same fed loop with the RAM cache on: epoch 0 fills the cache

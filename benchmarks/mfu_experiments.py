@@ -119,6 +119,18 @@ EXPERIMENTS = [
         "deadline": 2400,
     },
     {
+        # the same fed loop on the uint8/device-normalize path: quarter
+        # the per-step host->device bytes. The delta vs loader_trainer_600
+        # measures how transfer-bound the fed loop actually is.
+        "name": "loader_trainer_600_u8",
+        "env": {"LOADER_BENCH_U8": "1"},
+        "cmd": [sys.executable, "benchmarks/loader_throughput.py"],
+        "success_key": "trainer_loop",
+        "require_backend": "tpu",
+        "why": "u8 fed trainer at 600x600 vs the f32 fed row",
+        "deadline": 2400,
+    },
+    {
         # LAST on purpose: compiling this kernel inside the full train-step
         # module wedged the remote service in round 1, taking the tunnel
         # down. Running it after everything else means a wedge costs no
