@@ -295,6 +295,7 @@ def main() -> None:
             "image_size": list(size),
             "batch": batch,
             "path": "same fed loop, loader cache_ram steady state",
+            "u8_feed": u8_feed,
         }
 
     out = _emit(

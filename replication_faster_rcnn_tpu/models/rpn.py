@@ -105,8 +105,15 @@ def select_proposals(
     keep = (hs >= cfg.min_size) & (ws >= cfg.min_size)
     scores = jnp.where(keep, fg_scores, -jnp.inf)
 
-    # top-pre_nms by score (reference sorts then truncates, `nets/rpn.py:70-72`)
-    top_scores, top_idx = jax.lax.top_k(scores, pre_nms)
+    # top-pre_nms by score (reference sorts then truncates, `nets/rpn.py:70-72`).
+    # One stable argsort serves BOTH the truncation and the NMS's
+    # descending-order requirement (assume_sorted below) — top_k followed
+    # by the NMS-internal argsort sorted ~12k candidates twice per image.
+    # lax.top_k and stable argsort(-s) break ties identically (lowest
+    # original index first), so this is bit-identical to the old pipeline.
+    order = jnp.argsort(-scores)
+    top_idx = jax.lax.slice_in_dim(order, 0, pre_nms)
+    top_scores = scores[top_idx]
     top_boxes = props[top_idx]
 
     # tiled exact NMS by default on every backend; FRCNN_NMS=loop (serial
@@ -119,6 +126,7 @@ def select_proposals(
         cfg.nms_thresh,
         post_nms,
         mask=jnp.isfinite(top_scores),
+        assume_sorted=True,
     )
     rois = top_boxes[idx] * valid[:, None]
     return rois, valid

@@ -146,8 +146,13 @@ def nms_fixed_auto(
     iou_thresh: float,
     max_out: int,
     mask: Array | None = None,
+    assume_sorted: bool = False,
 ) -> tuple[Array, Array]:
     """Backend dispatch for the proposal path.
+
+    ``assume_sorted`` (candidates already in descending-score order) is a
+    pure optimization hint: the tiled backend skips its internal sort;
+    the loop and Pallas backends ignore it (they are order-independent).
 
     Default on every backend (TPU included): the tiled exact algorithm
     (`ops/nms_tiled.py`; ~25-75 sequential matrix steps instead of one per
@@ -222,6 +227,7 @@ def nms_fixed_auto(
             )
             tile = 512
         return nms_fixed_tiled(
-            boxes, scores, iou_thresh, max_out, mask=mask, tile=tile
+            boxes, scores, iou_thresh, max_out, mask=mask, tile=tile,
+            assume_sorted=assume_sorted,
         )
     return nms_xla.nms_fixed(boxes, scores, iou_thresh, max_out, mask=mask)

@@ -38,7 +38,7 @@ Array = jnp.ndarray
 _NEG = -jnp.inf
 
 
-@partial(jax.jit, static_argnames=("max_out", "tile"))
+@partial(jax.jit, static_argnames=("max_out", "tile", "assume_sorted"))
 def nms_fixed_tiled(
     boxes: Array,
     scores: Array,
@@ -46,9 +46,18 @@ def nms_fixed_tiled(
     max_out: int,
     mask: Array | None = None,
     tile: int = 512,
+    assume_sorted: bool = False,
 ) -> tuple[Array, Array]:
     """Drop-in replacement for :func:`ops.nms.nms_fixed` (same contract:
-    [max_out] int32 indices in selection order + [max_out] validity)."""
+    [max_out] int32 indices in selection order + [max_out] validity).
+
+    ``assume_sorted``: the caller guarantees ``scores`` (after applying
+    ``mask``) are already non-increasing, so the internal stable sort and
+    its gathers are skipped. The proposal path uses this to sort ONCE:
+    its top-pre_nms selection already produces descending candidates
+    (`models/rpn.py::select_proposals`), and sorting 12k candidates twice
+    per image was pure waste on the hot path.
+    """
     n = boxes.shape[0]
     tile = min(tile, max(n, 1))
     s = scores.astype(jnp.float32)
@@ -56,15 +65,22 @@ def nms_fixed_tiled(
     if mask is not None:
         s = jnp.where(mask, s, _NEG)
 
-    # stable descending-score order; ties keep ascending original index,
-    # matching nms_fixed's first-occurrence argmax
-    order = jnp.argsort(-s)
     n_tiles = -(-n // tile)
     n_pad = n_tiles * tile
     pad = n_pad - n
-    order_p = jnp.pad(order, (0, pad)).astype(jnp.int32)
-    s_sorted = jnp.pad(s[order], (0, pad), constant_values=_NEG)
-    b_sorted = jnp.pad(boxes.astype(jnp.float32)[order], ((0, pad), (0, 0)))
+    if assume_sorted:
+        order_p = jnp.pad(jnp.arange(n, dtype=jnp.int32), (0, pad))
+        s_sorted = jnp.pad(s, (0, pad), constant_values=_NEG)
+        b_sorted = jnp.pad(boxes.astype(jnp.float32), ((0, pad), (0, 0)))
+    else:
+        # stable descending-score order; ties keep ascending original
+        # index, matching nms_fixed's first-occurrence argmax
+        order = jnp.argsort(-s)
+        order_p = jnp.pad(order, (0, pad)).astype(jnp.int32)
+        s_sorted = jnp.pad(s[order], (0, pad), constant_values=_NEG)
+        b_sorted = jnp.pad(
+            boxes.astype(jnp.float32)[order], ((0, pad), (0, 0))
+        )
     valid_sorted = s_sorted > _NEG
 
     later = jnp.arange(tile)[:, None] < jnp.arange(tile)[None, :]  # a before b

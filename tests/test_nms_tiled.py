@@ -100,3 +100,72 @@ def test_tiled_max_out_exceeds_n():
     scores = rng.uniform(0, 1, 6).astype(np.float32)
     idx, valid = nms_fixed_tiled(jnp.array(boxes), jnp.array(scores), 0.5, 20)
     assert int(np.asarray(valid).sum()) == 6
+
+
+def test_assume_sorted_bit_identical():
+    # pre-sorting candidates and passing assume_sorted=True must select
+    # exactly the same boxes in the same order as the internal sort
+    rng = np.random.default_rng(11)
+    for n in [1, 9, 65, 400]:
+        boxes = rand_boxes(n, rng, size=60.0)
+        scores = rng.uniform(0, 1, n).astype(np.float32)
+        # inject score ties to exercise the tie-break path
+        if n >= 9:
+            scores[2] = scores[7] = scores[5]
+        order = np.argsort(-scores, kind="stable")
+        bi, bv = nms_fixed_tiled(
+            jnp.array(boxes), jnp.array(scores), 0.5, 50, tile=64
+        )
+        si, sv = nms_fixed_tiled(
+            jnp.array(boxes[order]), jnp.array(scores[order]), 0.5, 50,
+            tile=64, assume_sorted=True,
+        )
+        np.testing.assert_array_equal(np.asarray(bv), np.asarray(sv))
+        # map sorted-space indices back to original ids
+        remapped = order[np.asarray(si)[np.asarray(sv)]]
+        np.testing.assert_array_equal(
+            np.asarray(bi)[np.asarray(bv)], remapped
+        )
+
+
+def test_select_proposals_single_sort_matches_topk_pipeline():
+    # models/rpn.py now sorts once (argsort + slice + assume_sorted NMS);
+    # this pins bit-identity against the old top_k -> unsorted-NMS pipeline
+    import jax
+
+    from replication_faster_rcnn_tpu.config import ProposalConfig
+    from replication_faster_rcnn_tpu.models.rpn import select_proposals
+    from replication_faster_rcnn_tpu.ops import boxes as box_ops
+
+    rng = np.random.default_rng(3)
+    A = 333
+    anchors = rand_boxes(A, rng, size=80.0).astype(np.float32)
+    deltas = rng.normal(0, 0.1, (A, 4)).astype(np.float32)
+    fg = rng.uniform(0, 1, A).astype(np.float32)
+    fg[10] = fg[20] = fg[30]  # ties
+    cfg = ProposalConfig()
+    rois, valid = select_proposals(
+        jnp.array(anchors), jnp.array(fg), jnp.array(deltas),
+        96.0, 96.0, cfg, train=True,
+    )
+
+    # the old pipeline, inline
+    from replication_faster_rcnn_tpu.ops.nms_tiled import nms_fixed_tiled
+
+    pre_nms = min(cfg.pre_nms(True), A)
+    props = box_ops.clip(
+        box_ops.decode(jnp.array(anchors), jnp.array(deltas)), 96.0, 96.0
+    )
+    hs = props[:, 2] - props[:, 0]
+    ws = props[:, 3] - props[:, 1]
+    keep = (hs >= cfg.min_size) & (ws >= cfg.min_size)
+    scores = jnp.where(keep, jnp.array(fg), -jnp.inf)
+    top_scores, top_idx = jax.lax.top_k(scores, pre_nms)
+    top_boxes = props[top_idx]
+    idx, val = nms_fixed_tiled(
+        top_boxes, top_scores, cfg.nms_thresh, cfg.post_nms(True),
+        mask=jnp.isfinite(top_scores),
+    )
+    old_rois = top_boxes[idx] * val[:, None]
+    np.testing.assert_array_equal(np.asarray(valid), np.asarray(val))
+    np.testing.assert_array_equal(np.asarray(rois), np.asarray(old_rois))
