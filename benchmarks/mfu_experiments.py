@@ -23,6 +23,13 @@ Experiment order (value-first, so an early death still pays):
 
 Run (relay must be alive — the script refuses otherwise):
   python benchmarks/mfu_experiments.py [--only N,M] [--deadline 1800]
+
+Round-4 note: experiment 0 (flagship b16) recorded 197.3 img/s, then
+experiment 1 (fpn_b8_reverify) died UNAVAILABLE during its long init
+compile and wedged the tunnel. The safe RESUME order defers the two
+FPN configs (compile-heavy, observed wedge trigger) to just before the
+Pallas tail risk:
+  python benchmarks/mfu_experiments.py --only 2,3,4,6,7,8,9,1,5,10
 """
 
 from __future__ import annotations
