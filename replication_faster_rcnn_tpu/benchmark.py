@@ -305,6 +305,20 @@ def _probe_device(config=None) -> None:
 
     import jax.numpy as jnp
 
+    # a process pinned to CPU (jax.config jax_platforms — how tests and
+    # the fallback child run) measures on CPU: there is no tunnel to
+    # probe. Probing anyway is worse than useless — the probe SUBPROCESS
+    # inherits the shell env (JAX_PLATFORMS=axon via sitecustomize), so
+    # it would interrogate a TPU tunnel this process will never touch,
+    # and a wedged tunnel then drags a pure-CPU bench through the full
+    # probe+retry+fallback machinery (observed: os._exit killing a
+    # pytest session 25 min in). Reading jax.config does NOT initialize
+    # a backend, so this check is safe even when the tunnel is dead.
+    pinned = (getattr(jax.config, "jax_platforms", None) or "").split(",")[0]
+    if pinned == "cpu":
+        jax.device_get(jnp.ones((8, 128)).sum())  # warm; instant on CPU
+        return
+
     budget = float(os.environ.get("BENCH_PROBE_S", "180"))
     if not _probe_subprocess(budget):
         window = float(os.environ.get("BENCH_PROBE_RETRIES_S", "420"))

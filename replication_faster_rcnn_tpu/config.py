@@ -192,7 +192,12 @@ class DataConfig:
     # frcnn.py:19-23): worker count and kind. "thread" scales the
     # GIL-releasing native decode; "process" (fork) scales GIL-bound
     # Python sample work across cores
-    loader_workers: int = 4
+    # -1 = auto: min(4, host cores). Measured on a 1-core host the
+    # 4-thread pool was SLOWER than single-thread ingest (pool overhead
+    # with nothing to parallelize: 61-86 vs 108-123 img/s,
+    # benchmarks/loader_throughput.json) — worker count must follow the
+    # host, not a fixed default
+    loader_workers: int = -1
     loader_mode: str = "thread"  # thread | process
     loader_prefetch: int = 2
     # memoize decoded samples in host RAM (data/cache.py): epoch 1 pays

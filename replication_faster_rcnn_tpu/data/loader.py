@@ -67,7 +67,10 @@ class DataLoader:
       drop_last: drop the trailing partial batch (default True: the jitted
         step is compiled for exactly batch_size).
       prefetch: max batches buffered ahead (0 disables threading).
-      num_workers: workers assembling samples within a batch.
+      num_workers: workers assembling samples within a batch; negative
+        means auto — min(4, schedulable cores): on a 1-core host a
+        4-thread pool measured SLOWER than single-thread ingest
+        (benchmarks/loader_throughput.json).
       cache_ram: memoize decoded samples in host RAM (`data/cache.py`):
         epoch 1 pays the decode, every later epoch is a memcpy. The
         single-core answer to an input-bound chip — decode throughput
@@ -112,6 +115,14 @@ class DataLoader:
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.prefetch = prefetch
+        if num_workers < 0:  # auto: scale with the host, never beyond 4
+            import os
+
+            try:  # cores this process may RUN on (cgroup/taskset-aware)
+                avail = len(os.sched_getaffinity(0))
+            except (AttributeError, OSError):
+                avail = os.cpu_count() or 1
+            num_workers = min(4, avail)
         self.num_workers = max(1, num_workers)
         self.seed = seed
         self.worker_mode = worker_mode
