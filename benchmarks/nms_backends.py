@@ -88,7 +88,43 @@ def main(argv=None) -> int:
         print(f"{name:>7}: selections {'IDENTICAL to' if ok else 'DIFFER from'} loop")
         if not ok:
             return 1
-    return 0
+
+    # the proposal-path tail, both ways (round 4: models/rpn.py sorts
+    # once and passes assume_sorted): top_k + internally-sorting NMS vs
+    # one argsort + assume_sorted NMS. Outputs live in truncated-candidate
+    # index space, so they compare to each other, not to the raw loop.
+    pre = min(args.n - args.n // 16, args.n)  # ~top-k keeps most, as in RPN
+
+    def _pipe_topk(b, s):
+        ts, ti = jax.lax.top_k(s, pre)
+        tb = b[ti]
+        return nms_fixed_tiled(
+            tb, ts, args.thresh, args.out, mask=jnp.isfinite(ts)
+        )
+
+    def _pipe_single_sort(b, s):
+        order = jnp.argsort(-s)
+        ti = jax.lax.slice_in_dim(order, 0, pre)
+        ts = s[ti]
+        tb = b[ti]
+        return nms_fixed_tiled(
+            tb, ts, args.thresh, args.out, mask=jnp.isfinite(ts),
+            assume_sorted=True,
+        )
+
+    ms_a, idx_a, val_a = _time(jax.jit(jax.vmap(_pipe_topk)), boxes, scores)
+    ms_b, idx_b, val_b = _time(
+        jax.jit(jax.vmap(_pipe_single_sort)), boxes, scores
+    )
+    same = bool(
+        (np.asarray(idx_a) == np.asarray(idx_b)).all()
+        and (np.asarray(val_a) == np.asarray(val_b)).all()
+    )
+    print(f"proposal tail topk+sort: {ms_a:8.2f} ms/call")
+    print(f"proposal tail one-sort : {ms_b:8.2f} ms/call "
+          f"({ms_a / max(ms_b, 1e-9):.2f}x; selections "
+          f"{'IDENTICAL' if same else 'DIFFER'})")
+    return 0 if same else 1
 
 
 if __name__ == "__main__":
