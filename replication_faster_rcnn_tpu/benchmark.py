@@ -281,6 +281,15 @@ def _probe_subprocess(timeout_s: float) -> bool:
         return False
 
 
+def _cpu_pinned() -> bool:
+    """True when this process is explicitly pinned to the CPU backend
+    (jax.config jax_platforms, seeded by JAX_PLATFORMS=cpu in scrubbed
+    children or set by tests/conftest.py) — no tunnel exists to probe."""
+    return (
+        getattr(jax.config, "jax_platforms", None) or ""
+    ).split(",")[0] == "cpu"
+
+
 def _probe_device(config=None) -> None:
     """Fail fast if the device tunnel is already wedged — but give a
     *recovering* relay a chance first.
@@ -314,8 +323,7 @@ def _probe_device(config=None) -> None:
     # probe+retry+fallback machinery (observed: os._exit killing a
     # pytest session 25 min in). Reading jax.config does NOT initialize
     # a backend, so this check is safe even when the tunnel is dead.
-    pinned = (getattr(jax.config, "jax_platforms", None) or "").split(",")[0]
-    if pinned == "cpu":
+    if _cpu_pinned():
         jax.device_get(jnp.ones((8, 128)).sum())  # warm; instant on CPU
         return
 

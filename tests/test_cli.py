@@ -287,6 +287,9 @@ class TestBenchWatchdog:
             calls["fell_back"] = True
             raise SystemExit(1)
 
+        # the retry machinery under test only runs for tunnel-backed
+        # processes; this pytest process is cpu-pinned, so un-pin it
+        monkeypatch.setattr(benchmark, "_cpu_pinned", lambda: False)
         monkeypatch.setattr(benchmark, "_probe_subprocess", fake_probe)
         monkeypatch.setattr(benchmark, "_relay_alive", fake_alive)
         monkeypatch.setattr(benchmark, "_maybe_fallback", fake_fallback)
@@ -306,6 +309,7 @@ class TestBenchWatchdog:
         from replication_faster_rcnn_tpu import benchmark
 
         seen = {}
+        monkeypatch.setattr(benchmark, "_cpu_pinned", lambda: False)
         monkeypatch.setattr(benchmark, "_probe_subprocess", lambda t: False)
         monkeypatch.setattr(benchmark, "_relay_alive", lambda: False)
 
