@@ -49,6 +49,8 @@ def _build_config(args):
         data_kw["loader_mode"] = args.loader_mode
     if getattr(args, "augment_hflip", False):
         data_kw["augment_hflip"] = True
+    elif getattr(args, "no_augment_hflip", False):
+        data_kw["augment_hflip"] = False
     if getattr(args, "cache_ram", False):
         data_kw["loader_cache_ram"] = True
     if getattr(args, "device_normalize", False):
@@ -147,7 +149,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "FRCNN_CACHE_MAX_BYTES, default 64 GiB)")
     p.add_argument("--augment-hflip", action="store_true",
                    help="50%% horizontal-flip train augmentation "
-                        "(deterministic per seed/epoch/index)")
+                        "(deterministic per seed/epoch/index; the VOC "
+                        "presets default it ON)")
+    p.add_argument("--no-augment-hflip", action="store_true",
+                   help="disable the flip (reproduces the reference's "
+                        "no-augmentation training on VOC presets)")
     p.add_argument("--num-model", type=int, default=None,
                    help="size of the mesh's model axis")
     p.add_argument("--spatial", action="store_true",
@@ -244,7 +250,7 @@ def cmd_bench(args) -> int:
         )
     ) or (
         args.spatial or args.remat or args.shard_opt or args.augment_hflip
-        or args.cache_ram or args.device_normalize
+        or args.no_augment_hflip or args.cache_ram or args.device_normalize
         or args.config != "voc_resnet18"
     )
     bench_main(_build_config(args) if flagged else None, profile_dir=args.profile)
