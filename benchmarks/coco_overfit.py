@@ -118,6 +118,11 @@ def main() -> None:
     ap.add_argument("--data-root", default="/tmp/coco_synth")
     ap.add_argument("--workdir", default="/tmp/coco_overfit_ckpts")
     ap.add_argument("--skip-cli-leg", action="store_true")
+    ap.add_argument("--augment-hflip", action="store_true",
+                    help="train with the 50%% flip; results go to "
+                    "coco_overfit_result_aug.json so the aug-off row is "
+                    "kept for comparison (COCO-side counterpart of the "
+                    "VOC evidence that flipped the preset default)")
     args = ap.parse_args()
 
     for d in (args.data_root, args.workdir):
@@ -183,7 +188,8 @@ def main() -> None:
             num_classes=len(CAT_IDS) + 1,
         ),
         data=DataConfig(dataset="coco", root_dir=args.data_root,
-                        image_size=size, max_boxes=8),
+                        image_size=size, max_boxes=8,
+                        augment_hflip=args.augment_hflip),
         eval=EvalConfig(metric="coco"),
         train=TrainConfig(
             batch_size=args.batch, n_epoch=args.epochs, lr=args.lr,
@@ -228,8 +234,13 @@ def main() -> None:
         "lr": args.lr,
         "train_seconds": round(train_s, 1),
         "backend": __import__("jax").default_backend(),
+        "augment_hflip": args.augment_hflip,
     }
-    out = os.path.join(REPO, "benchmarks", "coco_overfit_result.json")
+    out = os.path.join(
+        REPO, "benchmarks",
+        "coco_overfit_result_aug.json" if args.augment_hflip
+        else "coco_overfit_result.json",
+    )
     with open(out, "w") as f:
         json.dump(result, f, indent=2)
     print(json.dumps(result))
