@@ -29,7 +29,9 @@ experiment 1 (fpn_b8_reverify) died UNAVAILABLE during its long init
 compile and wedged the tunnel. The safe RESUME order defers the two
 FPN configs (compile-heavy, observed wedge trigger) to just before the
 Pallas tail risk:
-  python benchmarks/mfu_experiments.py --only 2,3,4,6,7,8,9,1,5,10
+  python benchmarks/mfu_experiments.py --only 2,3,4,6,7,8,9,10,11,1,5,12
+(safe configs first; FPN pair — the observed wedge trigger — next; the
+Pallas in-step validation, the other known wedge risk, dead last.)
 """
 
 from __future__ import annotations
@@ -136,6 +138,23 @@ EXPERIMENTS = [
         "require_backend": "tpu",
         "why": "u8 fed trainer at 600x600 vs the f32 fed row",
         "deadline": 2400,
+    },
+    {
+        # BASELINE config #4 (ROIAlign head) at flagship scale — no
+        # on-chip row exists; also isolates the align-vs-pool head cost
+        # against the flagship's ROIPool number
+        "name": "voc12_align_b16",
+        "env": {},
+        "args": ["--config", "voc12_resnet18_align", "--batch-size", "16"],
+        "why": "first on-chip record for the align-head BASELINE config",
+    },
+    {
+        # BASELINE config #5 at b8 (its preset batch 32 is FORBIDDEN:
+        # b32 600x600 wedged the tunnel in round 1 — verify SKILL.md)
+        "name": "coco_resnet50_b8",
+        "env": {},
+        "args": ["--config", "coco_resnet50", "--batch-size", "8"],
+        "why": "first on-chip record for the coco_resnet50 BASELINE config",
     },
     {
         # LAST on purpose: compiling this kernel inside the full train-step
