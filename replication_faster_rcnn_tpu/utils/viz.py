@@ -65,7 +65,10 @@ def draw_anchor_centers(config, out_path: Optional[str] = None):
 
 
 def _unnormalize(image: np.ndarray, mean, std) -> np.ndarray:
-    """normalized float32 HWC -> uint8 RGB."""
+    """normalized float32 HWC -> uint8 RGB (uint8 passes through:
+    device_normalize samples are already raw pixels)."""
+    if image.dtype == np.uint8:
+        return image
     arr = (image * np.asarray(std, np.float32) + np.asarray(mean, np.float32))
     return (np.clip(arr, 0.0, 1.0) * 255.0).astype(np.uint8)
 

@@ -54,6 +54,26 @@ class TestGtOverlay:
             edge = im[r1 : r1 + 2, int(c1) : int(c2)]
             assert (edge == np.asarray([40, 220, 40])).all(axis=-1).any()
 
+    def test_overlay_on_uint8_device_normalize_sample(self):
+        # device_normalize samples are raw uint8 pixels — the overlay
+        # must draw them as-is, not re-apply the f32 denormalization
+        import dataclasses
+
+        cfg = _cfg()
+        cfg = cfg.replace(
+            data=dataclasses.replace(cfg.data, device_normalize=True)
+        )
+        ds = SyntheticDataset(cfg.data, "train", length=1)
+        sample = ds[0]
+        assert sample["image"].dtype == np.uint8
+        im = np.asarray(viz.draw_gt_overlay(sample, cfg))
+        assert im.shape == (96, 96, 3)
+        boxes = sample["boxes"][sample["mask"]]
+        for r1, c1, r2, c2 in boxes:
+            r1, c1 = int(max(r1, 0)), int(max(c1, 0))
+            edge = im[r1 : r1 + 2, int(c1) : int(c2)]
+            assert (edge == np.asarray([40, 220, 40])).all(axis=-1).any()
+
     def test_cli_viz_writes_both_artifacts(self, tmp_path, capsys):
         for what in ("anchors", "sample"):
             out = tmp_path / f"{what}.png"
