@@ -371,10 +371,16 @@ CONFIGS = {
         model=ModelConfig(backbone="resnet18", roi_op="align"),
         data=_voc_data(root_dir="data/voc/VOCdevkit/VOC2012"),
     ),
-    # 5. COCO-2017 80-class, batch 32, data-parallel v5e-8
+    # 5. COCO-2017 80-class, batch 32, data-parallel v5e-8. COCO presets
+    #    also flip by default: measured on the COCO-format overfit fixture
+    #    val AP50 0.476 vs 0.426, val coco-mAP 0.194 vs 0.177
+    #    (benchmarks/coco_overfit_result_aug.json, round 4)
     "coco_resnet50": _cfg(
         model=ModelConfig(backbone="resnet50", num_classes=COCO_NUM_CLASSES, roi_op="align"),
-        data=DataConfig(dataset="coco", root_dir="data/coco", max_boxes=100),
+        data=DataConfig(
+            dataset="coco", root_dir="data/coco", max_boxes=100,
+            augment_hflip=True,
+        ),
         train=TrainConfig(batch_size=32),
         eval=EvalConfig(metric="coco"),
     ),
@@ -390,7 +396,10 @@ CONFIGS = {
             rpn_mid_channels=512,
         ),
         anchors=AnchorConfig(scales=(4.0, 8.0, 16.0, 32.0)),
-        data=DataConfig(dataset="coco", root_dir="data/coco", max_boxes=100),
+        data=DataConfig(
+            dataset="coco", root_dir="data/coco", max_boxes=100,
+            augment_hflip=True,
+        ),
         eval=EvalConfig(metric="coco"),
     ),
 }
