@@ -149,6 +149,22 @@ def test_dp8_matches_single_device():
     _assert_dp8_matches_single_device(_cfg, "n_pos_rpn")
 
 
+def test_u8_dp8_matches_single_device():
+    # uint8 batches (device_normalize) shard over the data axis like any
+    # other leaf; the on-device normalize must be dp-equivalence-safe
+    import dataclasses
+
+    def cfg_u8(n):
+        cfg = _cfg(n)
+        return cfg.replace(
+            data=dataclasses.replace(cfg.data, device_normalize=True)
+        )
+
+    ds = SyntheticDataset(cfg_u8(1).data, length=2)
+    assert ds[0]["image"].dtype == np.uint8  # the premise of the test
+    _assert_dp8_matches_single_device(cfg_u8, "n_pos_rpn")
+
+
 def test_fpn_dp8_matches_single_device():
     """FPN variant of the DP equivalence check: the multi-level proposal
     path and the flat level-offset ROIAlign gather (models/fpn.py) must be
