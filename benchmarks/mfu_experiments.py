@@ -32,6 +32,16 @@ Pallas tail risk:
   python benchmarks/mfu_experiments.py --only 2,3,4,6,7,8,9,10,11,1,5,12
 (safe configs first; FPN pair — the observed wedge trigger — next; the
 Pallas in-step validation, the other known wedge risk, dead last.)
+
+Round-4 resume (fresh relay post-restart, 08:30Z): experiments 2,3,4,6
+all measured (tile256 214.6 / tile1024 212.8 / bf16-mu 216.3 /
+eval 358.8). Experiment 7 (profile_trace_b16, `--profile`) then blocked
+from its FIRST RPC (2 s of CPU after 25 min — before any profiling
+started) and the service wedged for all new clients; the bench process
+exited on its own after the runner abandoned it. Treat `--profile`
+through this tunnel as a wedge risk alongside FPN init and Pallas.
+Remaining resume order (profile leg dropped):
+  python benchmarks/mfu_experiments.py --only 8,9,10,11,1,5,12
 """
 
 from __future__ import annotations
