@@ -79,6 +79,7 @@ def compute_losses(
     train: bool = True,
     axis_name: str = None,
     positions: Array = None,
+    features_wall: bool = False,
 ) -> Tuple[Array, Tuple[Dict[str, Array], Any]]:
     """Forward + 4 losses. Returns (total, (metrics, new_batch_stats)).
 
@@ -86,6 +87,13 @@ def compute_losses(
     (`parallel/spmd.py`): loss normalizers psum over the axis, per-image
     sampling keys fold in the global batch position so the objective and
     randomness match the jit auto-partitioned path exactly.
+
+    ``features_wall`` stops gradients at the trunk/neck features, so a
+    grad of this loss excludes the whole trunk backward. Diagnostics
+    only (`benchmarks/grad_breakdown.py` uses the full-vs-walled time
+    difference to attribute backward cost on hardware, since the
+    tunnel-side ``jax.profiler`` is a wedge risk — verify SKILL.md);
+    never set in training.
     """
     images = batch["image"]
     gt_boxes = batch["boxes"]
@@ -108,6 +116,8 @@ def compute_losses(
     feat, mut = model.apply(
         variables, images, train, method="extract_features", mutable=["batch_stats"]
     )
+    if features_wall:
+        feat = jax.tree_util.tree_map(jax.lax.stop_gradient, feat)
     logits, deltas, anchors = model.apply(variables, feat, method="rpn_forward")
 
     # first-stage targets, on device
