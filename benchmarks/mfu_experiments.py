@@ -40,8 +40,14 @@ from its FIRST RPC (2 s of CPU after 25 min — before any profiling
 started) and the service wedged for all new clients; the bench process
 exited on its own after the runner abandoned it. Treat `--profile`
 through this tunnel as a wedge risk alongside FPN init and Pallas.
-Remaining resume order (profile leg dropped):
-  python benchmarks/mfu_experiments.py --only 8,9,10,11,1,5,12
+Remaining resume order (profile leg dropped): the service wedged for
+new clients after the --profile block and the relay process itself died
+~09:45Z. When a fresh relay appears, run — cheap settled questions
+first, wedge risks last:
+  python benchmarks/mfu_experiments.py --only 13,8,9,10,11,14,1,5,12
+(13 = clean default-config flagship point; 8,9 = fed-trainer legs;
+10,11 = align/coco first records; 14 = grad_breakdown attribution;
+then the FPN pair and Pallas dead last.)
 """
 
 from __future__ import annotations
@@ -179,6 +185,28 @@ EXPERIMENTS = [
         "why": "in-step validation of the opt-in Pallas NMS kernel",
         "deadline": 2400,
     },
+    {
+        # index 13 — the post-restart sessions measured every b16 VARIANT
+        # at 212.8-216.3 while the pre-wedge default pair sat at 196-197;
+        # this clean default-config point settles whether the gap was
+        # service state (expected) or the variants themselves
+        "name": "flagship_b16_default_rerecord",
+        "env": {"BENCH_BATCH": "16"},
+        "args": [],
+        "why": "clean default-config point to resolve the 197-vs-216 band",
+    },
+    {
+        # index 14 — profiler-free backward attribution (the --profile
+        # trace is a documented wedge risk): times fwd / walled-grad /
+        # image-grad / full-grad programs, banking each row as it lands
+        "name": "grad_breakdown_b16",
+        "env": {},
+        "cmd": [sys.executable, "benchmarks/grad_breakdown.py",
+                "--batch-size", "16"],
+        "success_key": "grad_full_ms",
+        "why": "split backward into trunk/head and wgrad/dgrad on chip",
+        "deadline": 1800,
+    },
 ]
 
 
@@ -251,6 +279,11 @@ def run_one(exp, deadline: float) -> bool:
                         "why": exp["why"],
                         "env": exp.get("env", {}),
                         "args": exp.get("args", []),
+                        **(
+                            {"cmd": [os.path.basename(cmd[0])] + cmd[1:]}
+                            if exp.get("cmd")
+                            else {}
+                        ),
                         "result": rec,
                         "wall_s": round(time.time() - t0, 1),
                         "recorded_utc": time.strftime(
