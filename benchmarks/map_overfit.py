@@ -57,6 +57,10 @@ def main() -> None:
                     help="50%% horizontal-flip train augmentation; results "
                     "go to map_overfit_result*_aug.json so the aug-off "
                     "baseline row is kept for comparison (VERDICT r3 #5)")
+    ap.add_argument("--augment-scale", type=float, nargs=2, default=None,
+                    metavar=("LO", "HI"),
+                    help="scale-jitter augmentation; with it on, results "
+                    "go to map_overfit_result*_scale.json")
     ap.add_argument(
         "--config", default="voc_resnet18",
         choices=["voc_resnet18", "voc_resnet50_fpn"],
@@ -104,7 +108,9 @@ def main() -> None:
             base.model, roi_op="align", compute_dtype=args.dtype
         ),
         data=DataConfig(dataset="synthetic", image_size=size, max_boxes=8,
-                        augment_hflip=args.augment_hflip),
+                        augment_hflip=args.augment_hflip,
+                        augment_scale=tuple(args.augment_scale)
+                        if args.augment_scale else None),
         train=TrainConfig(
             batch_size=args.batch,
             n_epoch=args.epochs,
@@ -130,6 +136,8 @@ def main() -> None:
     suffix = "" if args.config == "voc_resnet18" else "_fpn"
     if args.augment_hflip:
         suffix += "_aug"
+    if args.augment_scale:
+        suffix += "_scale"
     curve_path = os.path.join(
         REPO, "benchmarks", f"map_overfit_curve{suffix}.jsonl"
     )
@@ -192,6 +200,7 @@ def main() -> None:
         "lr": args.lr,
         "dtype": args.dtype,
         "augment_hflip": args.augment_hflip,
+        "augment_scale": args.augment_scale,
         "train_seconds": round(train_s, 1),
         "backend": __import__("jax").default_backend(),
     }

@@ -51,6 +51,8 @@ def _build_config(args):
         data_kw["augment_hflip"] = True
     elif getattr(args, "no_augment_hflip", False):
         data_kw["augment_hflip"] = False
+    if getattr(args, "augment_scale", None):
+        data_kw["augment_scale"] = tuple(args.augment_scale)
     if getattr(args, "cache_ram", False):
         data_kw["loader_cache_ram"] = True
     if getattr(args, "device_normalize", False):
@@ -154,6 +156,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--no-augment-hflip", action="store_true",
                    help="disable the flip (reproduces the reference's "
                         "no-augmentation training on VOC presets)")
+    p.add_argument("--augment-scale", type=float, nargs=2, default=None,
+                   metavar=("LO", "HI"),
+                   help="random scale-jitter augmentation, e.g. 0.75 1.25 "
+                        "(fixed canvas: zoom-out pads, zoom-in crops; "
+                        "deterministic per seed/epoch/index)")
     p.add_argument("--num-model", type=int, default=None,
                    help="size of the mesh's model axis")
     p.add_argument("--spatial", action="store_true",
@@ -246,7 +253,7 @@ def cmd_bench(args) -> int:
             args.dataset, args.data_root, args.image_size, args.backbone,
             args.roi_op, args.batch_size, args.lr, args.epochs, args.seed,
             args.num_model, args.backend, args.mu_dtype, args.loader_workers,
-            args.loader_mode,
+            args.loader_mode, args.augment_scale,
         )
     ) or (
         args.spatial or args.remat or args.shard_opt or args.augment_hflip

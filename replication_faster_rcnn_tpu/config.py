@@ -214,6 +214,21 @@ class DataConfig:
     # utils/data_loader.py:56-79 resizes+normalizes only). Deterministic
     # per (seed, epoch, index): resume replays the same flips.
     augment_hflip: bool = False
+    # random scale jitter (lo, hi), e.g. (0.75, 1.25): fixed-canvas
+    # zoom in/out with random placement, boxes tracked and collapsed
+    # rows masked (data/augment.py::scale_jitter_sample). None = off.
+    # Same deterministic (seed, epoch, index) keying as the flip.
+    augment_scale: Optional[Tuple[float, float]] = None
+
+    def __post_init__(self):
+        if self.augment_scale is not None:
+            lo, hi = self.augment_scale
+            # fail at config build, not at the first training epoch
+            if not 0.1 <= lo <= hi <= 4.0:
+                raise ValueError(
+                    "augment_scale must satisfy 0.1 <= lo <= hi <= 4.0, "
+                    f"got {self.augment_scale!r}"
+                )
 
 
 @dataclasses.dataclass(frozen=True)

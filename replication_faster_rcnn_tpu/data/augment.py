@@ -41,36 +41,181 @@ def hflip_sample(sample: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     return out
 
 
-class AugmentedView:
-    """Map-style view applying a 50% per-sample horizontal flip.
+def _resize_bilinear(image: np.ndarray, oh: int, ow: int) -> np.ndarray:
+    """Half-pixel-center bilinear resize, pure vectorized numpy.
 
-    The coin for (seed, epoch, idx) is a small counter-based mix — not
-    Python ``hash`` (salted for some types) and not a shared RNG stream
-    (order-dependent) — so any worker, process or thread, computes the
-    same decision for the same sample.
+    Matches the continuous-coordinate model the box transform assumes:
+    a point at continuous x maps to x * ow/w exactly."""
+    h, w = image.shape[:2]
+    im = image.astype(np.float32)
+    ys = (np.arange(oh, dtype=np.float32) + 0.5) * (h / oh) - 0.5
+    xs = (np.arange(ow, dtype=np.float32) + 0.5) * (w / ow) - 0.5
+    y0 = np.floor(ys).astype(np.int64)
+    x0 = np.floor(xs).astype(np.int64)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    y0c, y1c = np.clip(y0, 0, h - 1), np.clip(y0 + 1, 0, h - 1)
+    x0c, x1c = np.clip(x0, 0, w - 1), np.clip(x0 + 1, 0, w - 1)
+    top = im[y0c][:, x0c] * (1 - wx) + im[y0c][:, x1c] * wx
+    bot = im[y1c][:, x0c] * (1 - wx) + im[y1c][:, x1c] * wx
+    out = top * (1 - wy) + bot * wy
+    if image.dtype == np.uint8:
+        return np.clip(np.rint(out), 0, 255).astype(np.uint8)
+    return out.astype(image.dtype)
+
+
+def scale_jitter_sample(
+    sample: Dict[str, np.ndarray],
+    scale: float,
+    off_y: float,
+    off_x: float,
+) -> Dict[str, np.ndarray]:
+    """Random-scale view on a FIXED canvas (jit shapes never change).
+
+    The image content is resized by ``scale``; zoom-out (<1) pads the
+    canvas with the image's channel means (the normalization's zero in
+    f32 samples, a neutral gray for uint8 device-normalize samples),
+    zoom-in (>1) crops a canvas-sized window. ``off_y``/``off_x`` in
+    [0, 1] place the content/window (0.5 = centered). Boxes follow the
+    same continuous-coordinate affine (b*s - shift), are clipped to the
+    canvas, and rows that collapse below 1px get label -1 / mask False /
+    -1-filled geometry — identical to the loader's padded-row
+    convention, so downstream target assignment and eval are unaffected.
+
+    Reference parity note: the reference has no augmentation at all
+    (`utils/data_loader.py:56-79`); multi-scale training is standard in
+    descendants of the original recipe.
+    """
+    image = sample["image"]
+    h, w = image.shape[:2]
+    ch, cw = max(1, int(round(h * scale))), max(1, int(round(w * scale)))
+    if image.dtype == np.uint8:
+        # the repo's canonical u8 resize (fused C++ kernel when built,
+        # same half-pixel spec as the numpy fallback) — keeps the
+        # device-normalize ingest path off the slow pure-numpy gather
+        from replication_faster_rcnn_tpu.data.native_ops import resize_u8
+
+        content = resize_u8(image, (ch, cw))
+    else:
+        content = _resize_bilinear(image, ch, cw)
+    # exact per-axis factors after rounding, so boxes track pixels
+    sy, sx = ch / h, cw / w
+
+    canvas = np.empty_like(image)
+    if ch < h or cw < w:  # zoom-in content covers the whole canvas
+        fill = image.mean(axis=(0, 1))
+        if image.dtype == np.uint8:
+            fill = np.clip(np.rint(fill), 0, 255)
+        canvas[:] = fill.astype(image.dtype)[None, None, :]
+    # content-placement shift: out = in*s - shift (negative = padding)
+    shift_y = int(round((ch - h) * np.clip(off_y, 0.0, 1.0)))
+    shift_x = int(round((cw - w) * np.clip(off_x, 0.0, 1.0)))
+    src_y0, dst_y0 = max(0, shift_y), max(0, -shift_y)
+    src_x0, dst_x0 = max(0, shift_x), max(0, -shift_x)
+    span_y = min(ch - src_y0, h - dst_y0)
+    span_x = min(cw - src_x0, w - dst_x0)
+    canvas[dst_y0 : dst_y0 + span_y, dst_x0 : dst_x0 + span_x] = content[
+        src_y0 : src_y0 + span_y, src_x0 : src_x0 + span_x
+    ]
+
+    boxes = sample["boxes"].copy()
+    labels = sample["labels"].copy()
+    mask = sample["mask"].copy() if "mask" in sample else None
+    valid = np.asarray(labels >= 0, bool)
+    if valid.any():
+        b = boxes[valid]
+        b = np.stack(
+            [
+                b[:, 0] * sy - shift_y,
+                b[:, 1] * sx - shift_x,
+                b[:, 2] * sy - shift_y,
+                b[:, 3] * sx - shift_x,
+            ],
+            axis=1,
+        )
+        b[:, 0::2] = np.clip(b[:, 0::2], 0.0, float(h))
+        b[:, 1::2] = np.clip(b[:, 1::2], 0.0, float(w))
+        collapsed = ((b[:, 2] - b[:, 0]) < 1.0) | ((b[:, 3] - b[:, 1]) < 1.0)
+        b[collapsed] = -1.0
+        boxes[valid] = b
+        vi = np.flatnonzero(valid)[collapsed]
+        labels[vi] = -1
+        if mask is not None:
+            mask[vi] = False
+
+    out = dict(sample)
+    out["image"] = canvas
+    out["boxes"] = boxes
+    out["labels"] = labels
+    if mask is not None:
+        out["mask"] = mask
+    return out
+
+
+def _splitmix(z: int) -> int:
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return z ^ (z >> 31)
+
+
+class AugmentedView:
+    """Map-style view applying per-sample train augmentations: a 50%
+    horizontal flip and/or a scale jitter drawn from ``scale_range``.
+
+    Decisions for (seed, epoch, idx) come from a small counter-based mix
+    — not Python ``hash`` (salted for some types) and not a shared RNG
+    stream (order-dependent) — so any worker, process or thread,
+    computes the same decisions for the same sample.
     """
 
-    def __init__(self, dataset, seed: int, epoch: int) -> None:
+    def __init__(
+        self,
+        dataset,
+        seed: int,
+        epoch: int,
+        hflip: bool = True,
+        scale_range=None,
+    ) -> None:
         self.dataset = dataset
         self.seed = int(seed)
         self.epoch = int(epoch)
+        self.hflip = bool(hflip)
+        if scale_range is not None:
+            lo, hi = float(scale_range[0]), float(scale_range[1])
+            if not 0.1 <= lo <= hi <= 4.0:
+                raise ValueError(
+                    f"scale_range must satisfy 0.1 <= lo <= hi <= 4, got {scale_range!r}"
+                )
+            scale_range = (lo, hi)
+        self.scale_range = scale_range
 
     def __len__(self) -> int:
         return len(self.dataset)
 
     def __getitem__(self, idx: int):
         sample = self.dataset[idx]
-        # splitmix64 finalizer on the (seed, epoch, idx) mix; one output
-        # bit is the coin — no per-sample Mersenne Twister construction
-        # on the ingest hot path
-        z = (
-            self.seed * 0x9E3779B97F4A7C15
-            + self.epoch * 0xBF58476D1CE4E5B9
-            + idx * 0x94D049BB133111EB
-        ) & 0xFFFFFFFFFFFFFFFF
-        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
-        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
-        z ^= z >> 31
-        if z & 1:
-            return hflip_sample(sample)
+        # splitmix64 finalizer chain on the (seed, epoch, idx) mix; one
+        # output bit is the flip coin, further outputs drive the jitter —
+        # no per-sample Mersenne Twister construction on the ingest path
+        z = _splitmix(
+            (
+                self.seed * 0x9E3779B97F4A7C15
+                + self.epoch * 0xBF58476D1CE4E5B9
+                + idx * 0x94D049BB133111EB
+            )
+            & 0xFFFFFFFFFFFFFFFF
+        )
+        if self.scale_range is not None:
+            lo, hi = self.scale_range
+            z2 = _splitmix(z + 0x9E3779B97F4A7C15)
+            z3 = _splitmix(z2 + 0x9E3779B97F4A7C15)
+            z4 = _splitmix(z3 + 0x9E3779B97F4A7C15)
+            u = (z2 >> 11) / float(1 << 53)
+            scale = lo + (hi - lo) * u
+            off_y = (z3 >> 11) / float(1 << 53)
+            off_x = (z4 >> 11) / float(1 << 53)
+            if abs(scale - 1.0) > 1e-3:
+                sample = scale_jitter_sample(sample, scale, off_y, off_x)
+        if self.hflip and (z & 1):
+            sample = hflip_sample(sample)
         return sample

@@ -99,6 +99,7 @@ class DataLoader:
         seed: int = 0,
         worker_mode: str = "thread",
         augment_hflip: bool = False,
+        augment_scale=None,
         stall_timeout: float = 120.0,
         cache_ram: bool = False,
     ) -> None:
@@ -106,6 +107,7 @@ class DataLoader:
             raise ValueError(f"worker_mode must be thread|process, got {worker_mode!r}")
         self.stall_timeout = float(stall_timeout)
         self.augment_hflip = augment_hflip
+        self.augment_scale = augment_scale
         if cache_ram:
             from replication_faster_rcnn_tpu.data.cache import CachedView
 
@@ -151,14 +153,20 @@ class DataLoader:
 
     def _epoch_dataset(self):
         """The dataset view for the current epoch: identity, or the
-        deterministic hflip augmentation keyed on (seed, epoch, idx) —
-        computed per-iteration so set_epoch() re-rolls the flips while
-        resume replays them exactly."""
-        if not self.augment_hflip:
+        deterministic hflip/scale-jitter augmentations keyed on
+        (seed, epoch, idx) — computed per-iteration so set_epoch()
+        re-rolls the draws while resume replays them exactly."""
+        if not (self.augment_hflip or self.augment_scale):
             return self.dataset
         from replication_faster_rcnn_tpu.data.augment import AugmentedView
 
-        return AugmentedView(self.dataset, self.seed, self.epoch)
+        return AugmentedView(
+            self.dataset,
+            self.seed,
+            self.epoch,
+            hflip=self.augment_hflip,
+            scale_range=self.augment_scale,
+        )
 
     def _build(
         self, idxs: np.ndarray, pool: Optional[futures.ThreadPoolExecutor], ds

@@ -111,6 +111,7 @@ class Trainer:
             num_workers=config.data.loader_workers,
             worker_mode=config.data.loader_mode,
             augment_hflip=config.data.augment_hflip,
+            augment_scale=config.data.augment_scale,
             cache_ram=config.data.loader_cache_ram,
         )
         steps_per_epoch = max(len(self.loader), 1)

@@ -227,6 +227,116 @@ class TestAugment:
             for k in a:
                 np.testing.assert_array_equal(a[k], b[k])
 
+    def test_scale_jitter_zoom_out_geometry(self):
+        """s=0.5 centered: boxes halve and shift by the padding offset;
+        the canvas keeps its shape and the border is the fill value."""
+        from replication_faster_rcnn_tpu.data.augment import scale_jitter_sample
+
+        ds = SyntheticDataset(_cfg(), length=1)
+        s = ds[0]
+        h, w = s["image"].shape[:2]
+        out = scale_jitter_sample(s, 0.5, 0.5, 0.5)
+        assert out["image"].shape == s["image"].shape
+        ch, cw = round(h * 0.5), round(w * 0.5)
+        # content placement shift for off=0.5: round((ch - h) * 0.5) <= 0
+        shift_y, shift_x = round((ch - h) * 0.5), round((cw - w) * 0.5)
+        m = np.asarray(s["mask"], bool) & np.asarray(out["mask"], bool)
+        np.testing.assert_allclose(
+            out["boxes"][m][:, 0], s["boxes"][m][:, 0] * (ch / h) - shift_y,
+            atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            out["boxes"][m][:, 1], s["boxes"][m][:, 1] * (cw / w) - shift_x,
+            atol=1e-5,
+        )
+        # the padded border equals the channel-mean fill
+        fill = s["image"].mean(axis=(0, 1))
+        np.testing.assert_allclose(out["image"][0, 0], fill, atol=1e-5)
+        np.testing.assert_allclose(out["image"][-1, -1], fill, atol=1e-5)
+
+    def test_scale_jitter_zoom_in_clips_and_masks_collapsed(self):
+        """A box pushed fully outside the crop window collapses: label -1,
+        mask False, geometry -1-filled (the padded-row convention)."""
+        from replication_faster_rcnn_tpu.data.augment import scale_jitter_sample
+
+        ds = SyntheticDataset(_cfg(), length=1)
+        s = dict(ds[0])
+        h, w = s["image"].shape[:2]
+        boxes = s["boxes"].copy()
+        labels = s["labels"].copy()
+        mask = np.asarray(s["mask"], bool).copy()
+        # plant a tiny box in the far top-left corner
+        boxes[0] = [0.0, 0.0, 3.0, 3.0]
+        labels[0] = 1
+        mask[0] = True
+        s.update(boxes=boxes, labels=labels, mask=mask)
+        # zoom 2x anchored at the bottom-right (off=1): crop shift is
+        # (ch - h), so the corner box maps to negative coords entirely
+        out = scale_jitter_sample(s, 2.0, 1.0, 1.0)
+        assert out["labels"][0] == -1
+        assert not out["mask"][0]
+        np.testing.assert_array_equal(out["boxes"][0], [-1.0] * 4)
+        # surviving boxes stay inside the canvas
+        keep = np.asarray(out["mask"], bool)
+        if keep.any():
+            b = out["boxes"][keep]
+            assert (b[:, 0] >= 0).all() and (b[:, 2] <= h).all()
+            assert (b[:, 1] >= 0).all() and (b[:, 3] <= w).all()
+
+    def test_scale_jitter_pixels_follow_boxes(self):
+        """The painted object must still be under its jittered box."""
+        from replication_faster_rcnn_tpu.data.augment import scale_jitter_sample
+
+        ds = SyntheticDataset(_cfg(), length=1)
+        s = ds[0]
+        for scale, oy, ox in ((0.6, 0.3, 0.8), (1.5, 0.2, 0.7)):
+            out = scale_jitter_sample(s, scale, oy, ox)
+            if not np.asarray(out["mask"], bool)[0]:
+                continue
+            r1, c1, r2, c2 = (int(v) for v in out["boxes"][0])
+            inside = out["image"][r1:r2, c1:c2].mean()
+            assert inside > out["image"].mean()
+
+    def test_scale_jitter_uint8_dtype_preserved(self):
+        from replication_faster_rcnn_tpu.data.augment import scale_jitter_sample
+
+        ds = SyntheticDataset(_cfg(), length=1)
+        s = dict(ds[0])
+        img = np.clip((s["image"] * 64 + 128), 0, 255).astype(np.uint8)
+        s["image"] = img
+        out = scale_jitter_sample(s, 0.7, 0.5, 0.5)
+        assert out["image"].dtype == np.uint8
+        assert out["image"].shape == img.shape
+
+    def test_loader_scale_jitter_deterministic_and_composes_with_flip(self):
+        ds = SyntheticDataset(_cfg(), length=8)
+        kw = dict(batch_size=4, shuffle=False, prefetch=0, seed=11,
+                  augment_hflip=True, augment_scale=(0.75, 1.25))
+        l1, l2 = DataLoader(ds, **kw), DataLoader(ds, **kw)
+        l1.set_epoch(1)
+        l2.set_epoch(1)
+        for a, b in zip(l1, l2):
+            np.testing.assert_array_equal(a["image"], b["image"])
+            np.testing.assert_array_equal(a["boxes"], b["boxes"])
+            np.testing.assert_array_equal(a["labels"], b["labels"])
+        # shapes stay fixed (jit contract) and some sample actually moved
+        plain = list(DataLoader(ds, batch_size=4, shuffle=False, prefetch=0))
+        l1.set_epoch(1)
+        moved = False
+        for a, p in zip(l1, plain):
+            assert a["image"].shape == p["image"].shape
+            moved = moved or not np.array_equal(a["image"], p["image"])
+        assert moved
+
+    def test_scale_jitter_range_validated(self):
+        from replication_faster_rcnn_tpu.data.augment import AugmentedView
+
+        ds = SyntheticDataset(_cfg(), length=2)
+        with pytest.raises(ValueError, match="scale_range"):
+            AugmentedView(ds, 0, 0, scale_range=(0.0, 1.0))
+        with pytest.raises(ValueError, match="scale_range"):
+            AugmentedView(ds, 0, 0, scale_range=(1.5, 0.5))
+
 
 def _write_voc(root, ids, difficult_flags=None):
     from PIL import Image
