@@ -80,6 +80,7 @@ def compute_losses(
     axis_name: str = None,
     positions: Array = None,
     features_wall: bool = False,
+    targets_only: bool = False,
 ) -> Tuple[Array, Tuple[Dict[str, Array], Any]]:
     """Forward + 4 losses. Returns (total, (metrics, new_batch_stats)).
 
@@ -94,6 +95,12 @@ def compute_losses(
     difference to attribute backward cost on hardware, since the
     tunnel-side ``jax.profiler`` is a wedge risk — verify SKILL.md);
     never set in training.
+
+    ``targets_only`` returns right after the second-stage target
+    creators with a scalar probe consuming their outputs (empty
+    metrics) — the bench's `targets_ms` stage prefix, kept inside this
+    function so the timed prefix can't drift from the real step.
+    Diagnostics only.
     """
     images = batch["image"]
     gt_boxes = batch["boxes"]
@@ -135,6 +142,12 @@ def compute_losses(
         rng_pt, rois, roi_valid, gt_boxes, gt_labels, gt_mask, config.roi_targets,
         positions,
     )
+    if targets_only:
+        probe = (
+            reg_t.sum() + lab_t.sum() + sample_rois.sum()
+            + reg_t2.sum() + lab_t2.sum()
+        ).astype(jnp.float32)
+        return probe, ({}, mut["batch_stats"])
 
     # head on the sampled rois (BN in the tail also updates; the VGG16
     # tail's dropout draws from the 'dropout' rng in train mode)

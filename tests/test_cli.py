@@ -97,6 +97,7 @@ class TestBenchSuccess:
         assert bd["trunk_ms"] > 0 and bd["step_ms"] > 0
         required = {
             "trunk_ms", "rpn_heads_ms", "proposal_nms_ms",
+            "targets_ms", "head_loss_ms",
             "targets_head_loss_ms", "backward_ms", "opt_update_ms",
             "backward_update_ms", "step_ms",
         }
