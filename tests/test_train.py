@@ -291,3 +291,50 @@ class TestFeaturesWall:
                   "attrib_trunk_backward_ms", "attrib_all_wgrads_ms"):
             assert k in rows
         assert rows["grad_full_ms"] > 0
+
+
+class TestLayerCostTable:
+    def _load(self):
+        import importlib.util
+        import pathlib
+
+        script = (
+            pathlib.Path(__file__).resolve().parents[1]
+            / "benchmarks"
+            / "layer_cost_table.py"
+        )
+        spec = importlib.util.spec_from_file_location("layer_cost", script)
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+        return m
+
+    def test_tiling_eff(self):
+        m = self._load()
+        assert m._eff(128, 128) == 1.0
+        assert m._eff(576, 64) == pytest.approx((576 / 640) * 0.5)
+        assert m._eff(147, 64) == pytest.approx((147 / 256) * 0.5)
+
+    def test_collect_and_analyze_tiny(self, tmp_path, monkeypatch):
+        m = self._load()
+        monkeypatch.setattr(m, "OUT", str(tmp_path / "t.json"))
+        monkeypatch.setattr(
+            "sys.argv",
+            ["layer_cost_table.py", "--batch-size", "2",
+             "--image-size", "64", "64", "--measured-step-ms", "10"],
+        )
+        m.main()
+        import json as _json
+
+        out = _json.load(open(tmp_path / "t.json"))
+        agg = out["aggregate"]
+        # resnet18 trunk 15 convs + RPN 3 + head 5 = 23 regardless of shape
+        assert agg["n_convs"] == 23
+        assert 0 < agg["best_achievable_conv_mfu"] <= 1
+        assert agg["compute_floor_ms_at_tiling_ceiling"] >= agg[
+            "compute_floor_ms_at_peak"
+        ]
+        # every row's ceilings are valid fractions; stem dgrad skipped
+        assert out["convs"][0]["dgrad_skipped"]
+        for r in out["convs"]:
+            for k in ("eff_fwd", "eff_dgrad", "eff_wgrad"):
+                assert 0 < r[k] <= 1
