@@ -7,6 +7,12 @@ blocked from its first RPC and wedged the remote service
 (verify SKILL.md incident 2026-08-01 ~08:48Z), so this script derives
 the same attribution from wall-times of jitted grad VARIANTS instead:
 
+  trunk_train  forward trunk only, train-mode BN (batch-stats
+               reductions computed) — paired with trunk_eval this is
+               the BN-density A/B from layer_cost_table /
+               STAGE_BREAKDOWN: eval-mode BN is a fusable affine, so
+               the delta is the price of train-mode BN on the trunk
+  trunk_eval   forward trunk only, eval-mode BN
   fwd        forward + 4 losses (no grad)
   grad_wall  value_and_grad with ``features_wall=True`` — gradients stop
              at the trunk/neck features, so the program runs the full
@@ -27,8 +33,9 @@ fusion-boundary estimate, same caveat as ``_stage_breakdown``):
                     -- not separable without more programs; the three
                     rows above already say where the milliseconds live.
 
-Run ON THE CHIP (each variant is a fresh ~40 s compile of a
-resnet18-class program — the historically safe compile class):
+Run ON THE CHIP (six programs, each a fresh compile of a
+resnet18-class program — the historically safe compile class; the two
+trunk-only programs are small, the four loss/grad variants ~40 s each):
 
     python benchmarks/grad_breakdown.py [--config voc_resnet18]
                                         [--batch-size 16]
@@ -100,6 +107,19 @@ def make_programs(model, cfg, state, batch):
 
     rng = jax.random.fold_in(state.rng, state.step)
 
+    def _trunk(train):
+        @jax.jit
+        def t(params, batch):
+            v = {"params": params, "batch_stats": state.batch_stats}
+            feat, _ = model.apply(
+                v, batch["image"], train, method="extract_features",
+                mutable=["batch_stats"],
+            )
+            feats = feat if isinstance(feat, (list, tuple)) else [feat]
+            return sum(f.astype(jnp.float32).sum() for f in feats)
+
+        return t
+
     @jax.jit
     def fwd(params, batch):
         total, _ = compute_losses(
@@ -137,7 +157,7 @@ def make_programs(model, cfg, state, batch):
         )
         return total + jnp.sqrt((g.astype(jnp.float32) ** 2).sum())
 
-    return fwd, _grad_of(True), _grad_of(False), grad_imgs
+    return fwd, _grad_of(True), _grad_of(False), grad_imgs, _trunk(True), _trunk(False)
 
 
 def main() -> None:
@@ -157,14 +177,19 @@ def main() -> None:
     model, cfg, state, batch = build(
         args.config, args.batch_size, args.image_size
     )
-    fwd, grad_wall, grad_full, grad_imgs = make_programs(
-        model, cfg, state, batch
+    fwd, grad_wall, grad_full, grad_imgs, trunk_train, trunk_eval = (
+        make_programs(model, cfg, state, batch)
     )
 
     rows = {}
     # cheap-to-expensive, and bank each row as it lands: every new compile
-    # through the tunnel is potentially the session's last
+    # through the tunnel is potentially the session's last. The trunk
+    # train/eval A/B tests the BN-density hypothesis from
+    # layer_cost_table (STAGE_BREAKDOWN.md): eval-mode BN is a fusable
+    # affine; train-mode adds the batch-stats reductions
     for name, fn in (
+        ("trunk_train_ms", trunk_train),
+        ("trunk_eval_ms", trunk_eval),
         ("fwd_ms", fwd),
         ("grad_wall_ms", grad_wall),
         ("grad_imgs_ms", grad_imgs),
@@ -205,7 +230,11 @@ def _write(args, backend, rows) -> None:
                     "grad_wall stops gradients at the trunk features "
                     "(compute_losses features_wall); grad_imgs "
                     "differentiates w.r.t. images with params closed over "
-                    "(full dgrad chain, zero wgrads)"
+                    "(full dgrad chain, zero wgrads); trunk_train/"
+                    "trunk_eval are the forward trunk with train-/eval-"
+                    "mode BN — their delta prices the train-mode "
+                    "batch-stats reductions (the BN-density hypothesis, "
+                    "STAGE_BREAKDOWN.md)"
                 ),
             },
             f,

@@ -177,6 +177,10 @@ def main() -> None:
     )
     args = ap.parse_args()
 
+    out_path = OUT
+    if args.config != "voc_resnet18":  # flagship keeps the unsuffixed name
+        out_path = OUT.replace(".json", f"_{args.config}.json")
+
     cfg, convs = collect_convs(args.config, args.batch_size, args.image_size)
     rows, tot, eff_tot = analyze(convs)
 
@@ -230,7 +234,7 @@ def main() -> None:
             "and the 0.153 record corresponds to ~0.186 full-tap."
         ),
     }
-    with open(OUT, "w") as f:
+    with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"aggregate": agg}))
 

@@ -197,8 +197,9 @@ EXPERIMENTS = [
     },
     {
         # index 14 — profiler-free backward attribution (the --profile
-        # trace is a documented wedge risk): times fwd / walled-grad /
-        # image-grad / full-grad programs, banking each row as it lands
+        # trace is a documented wedge risk): times trunk-BN-A/B, fwd,
+        # walled-grad, image-grad and full-grad programs (six compiles),
+        # banking each row as it lands
         "name": "grad_breakdown_b16",
         "env": {},
         "cmd": [sys.executable, "benchmarks/grad_breakdown.py",

@@ -287,7 +287,8 @@ class TestFeaturesWall:
 
         out = _json.load(open(tmp_path / "gb.json"))
         rows = out["rows"]
-        for k in ("fwd_ms", "grad_wall_ms", "grad_imgs_ms", "grad_full_ms",
+        for k in ("trunk_train_ms", "trunk_eval_ms",
+                  "fwd_ms", "grad_wall_ms", "grad_imgs_ms", "grad_full_ms",
                   "attrib_trunk_backward_ms", "attrib_all_wgrads_ms"):
             assert k in rows
         assert rows["grad_full_ms"] > 0
