@@ -44,10 +44,11 @@ Remaining resume order (profile leg dropped): the service wedged for
 new clients after the --profile block and the relay process itself died
 ~09:45Z. When a fresh relay appears, run — cheap settled questions
 first, wedge risks last:
-  python benchmarks/mfu_experiments.py --only 13,8,9,10,11,14,1,5,12
-(13 = clean default-config flagship point; 8,9 = fed-trainer legs;
-10,11 = align/coco first records; 14 = grad_breakdown attribution;
-then the FPN pair and Pallas dead last.)
+  python benchmarks/mfu_experiments.py --only 13,15,8,9,10,11,14,1,5,12
+(13 = clean default-config flagship point; 15 = frozen-BN A/B against
+it; 8,9 = fed-trainer legs; 10,11 = align/coco first records;
+14 = grad_breakdown attribution; then the FPN pair and Pallas dead
+last.)
 """
 
 from __future__ import annotations
@@ -207,6 +208,20 @@ EXPERIMENTS = [
         "success_key": "grad_full_ms",
         "why": "split backward into trunk/head and wgrad/dgrad on chip",
         "deadline": 1800,
+    },
+    {
+        # index 15 — the BN-density hypothesis' structural lever
+        # (STAGE_BREAKDOWN.md): frozen BN turns every trunk/tail BN into
+        # a fusable affine. vs the default-config point (experiment 13)
+        # this isolates what train-mode BN costs the whole step.
+        # NOTE on the A/B: exp 13's BENCH_BATCH=16 is per-device while
+        # --batch-size 16 here is global — identical ONLY on the 1-chip
+        # relay host this queue targets; on a multi-chip host pass
+        # per-device x n_dev instead
+        "name": "flagship_b16_frozen_bn",
+        "env": {},
+        "args": ["--frozen-bn", "--batch-size", "16"],
+        "why": "price train-mode BN: the cross-config gap ranking tracks BN density",
     },
 ]
 

@@ -78,7 +78,8 @@ def _build_config(args):
         train_kw["adam_mu_dtype"] = args.mu_dtype
     if train_kw:
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **train_kw))
-    if args.backbone or args.roi_op or getattr(args, "remat", False):
+    if (args.backbone or args.roi_op or getattr(args, "remat", False)
+            or getattr(args, "frozen_bn", False)):
         model_kw = {}
         if args.backbone:
             model_kw["backbone"] = args.backbone
@@ -86,6 +87,8 @@ def _build_config(args):
             model_kw["roi_op"] = args.roi_op
         if getattr(args, "remat", False):
             model_kw["remat"] = True
+        if getattr(args, "frozen_bn", False):
+            model_kw["frozen_bn"] = True
         cfg = cfg.replace(model=dataclasses.replace(cfg.model, **model_kw))
     mesh_kw = {}
     if getattr(args, "num_model", None) is not None:
@@ -132,6 +135,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each trunk block (recompute "
                         "activations in backward; saves HBM)")
+    p.add_argument("--frozen-bn", action="store_true",
+                   help="freeze BatchNorm statistics during training "
+                        "(detection fine-tuning practice; each BN becomes "
+                        "a fusable affine. Affine scale/bias stay "
+                        "trainable, unlike torchvision's full freeze)")
     p.add_argument("--mu-dtype", default=None,
                    choices=[None, "float32", "bfloat16"],
                    help="dtype for Adam's first moment (bfloat16 halves "
@@ -257,6 +265,7 @@ def cmd_bench(args) -> int:
         )
     ) or (
         args.spatial or args.remat or args.shard_opt or args.augment_hflip
+        or args.frozen_bn
         or args.no_augment_hflip or args.cache_ram or args.device_normalize
         or args.config != "voc_resnet18"
     )

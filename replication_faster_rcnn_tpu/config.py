@@ -145,6 +145,15 @@ class ModelConfig:
     # auto-partitioning the global-batch BN reduction happens automatically
     # and a named axis here would be unbound.
     bn_axis: Optional[str] = None
+    # freeze BatchNorm STATISTICS during training (the detection-
+    # fine-tuning practice torchvision implements as FrozenBatchNorm2d):
+    # every BN applies its stored running stats, becoming a fusable
+    # affine — no batch-stats reductions in the step. Deliberate
+    # deviation from torchvision: the affine scale/bias stay trainable
+    # (identical param/opt trees with the flag on or off); torchvision
+    # freezes those too. Off by default: the reference trains BN in
+    # batch-stats mode (torch modules default to train())
+    frozen_bn: bool = False
 
     def __post_init__(self):
         if self.roi_op not in ("align", "pool"):

@@ -54,7 +54,7 @@ class FasterRCNN(nn.Module):
 
             self.trunk = ResNetFeatures(
                 cfg.model.backbone, dtype, bn_axis=cfg.model.bn_axis,
-                remat=cfg.model.remat,
+                remat=cfg.model.remat, frozen_bn=cfg.model.frozen_bn,
             )
             self.neck = FPNNeck(cfg.model.fpn_channels, dtype)
             self.rpn = RPNHead(
@@ -76,7 +76,7 @@ class FasterRCNN(nn.Module):
             else:
                 self.trunk = ResNetTrunk(
                     cfg.model.backbone, dtype, bn_axis=cfg.model.bn_axis,
-                    remat=cfg.model.remat,
+                    remat=cfg.model.remat, frozen_bn=cfg.model.frozen_bn,
                 )
             # the head dispatches internally on arch (VGG16 fc6/fc7 tail
             # vs ResNet layer4 tail)
@@ -93,6 +93,7 @@ class FasterRCNN(nn.Module):
                 sampling_ratio=cfg.model.roi_sampling_ratio,
                 dtype=dtype,
                 bn_axis=cfg.model.bn_axis,
+                frozen_bn=cfg.model.frozen_bn,
             )
 
     # --- stage methods (used individually by the trainer) ---

@@ -49,10 +49,12 @@ class ResNetFeatures(nn.Module):
     dtype: Any = jnp.bfloat16
     bn_axis: Any = None
     remat: bool = False  # jax.checkpoint each residual block
+    frozen_bn: bool = False  # see ResNetTrunk.frozen_bn
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> List[Array]:
         depths = _spec(self.arch)[1]
+        train = train and not self.frozen_bn  # `train` only gates BN here
         ax, rm = self.bn_axis, self.remat
         x = x.astype(self.dtype)
         x = _conv(64, 7, 2, 3, self.dtype, "conv1")(x)

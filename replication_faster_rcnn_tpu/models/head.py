@@ -46,6 +46,7 @@ class DetectionHead(nn.Module):
     sampling_ratio: int = 2
     dtype: Any = jnp.bfloat16
     bn_axis: Any = None  # sync-BN axis for the ResNet tail under shard_map
+    frozen_bn: bool = False  # see ResNetTrunk.frozen_bn (applies to the tail)
 
     @nn.compact
     def __call__(
@@ -86,7 +87,8 @@ class DetectionHead(nn.Module):
             embed = VGG16Tail(self.dtype, name="tail")(crops, train)
         else:
             embed = ResNetTail(
-                self.arch, self.dtype, bn_axis=self.bn_axis, name="tail"
+                self.arch, self.dtype, bn_axis=self.bn_axis,
+                frozen_bn=self.frozen_bn, name="tail"
             )(crops, train)
         embed = embed.astype(jnp.float32)  # [N*R, C_tail]
 

@@ -270,10 +270,18 @@ class ResNetTrunk(nn.Module):
     stem: str = "imagenet"  # "imagenet" | "cifar"
     bn_axis: Any = None  # mesh axis for sync-BN under shard_map
     remat: bool = False  # jax.checkpoint each residual block
+    # run every BN with its stored statistics even in train mode (no
+    # batch-stats reductions: each BN becomes a fusable affine).
+    # DELIBERATE deviation from torchvision's FrozenBatchNorm2d: the
+    # affine scale/bias stay TRAINABLE here (torchvision freezes them as
+    # buffers); this is the affine-fine-tuning variant, chosen so the
+    # optimizer/param tree is identical with the flag on or off.
+    frozen_bn: bool = False
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
         depths = _spec(self.arch)[1]
+        train = train and not self.frozen_bn  # `train` only gates BN here
         x = x.astype(self.dtype)
         if self.stem == "cifar":
             x = _conv(64, 3, 1, 1, self.dtype, "conv1")(x)
@@ -305,10 +313,12 @@ class ResNetTail(nn.Module):
     arch: str = "resnet18"
     dtype: Any = jnp.bfloat16
     bn_axis: Any = None
+    frozen_bn: bool = False  # see ResNetTrunk.frozen_bn
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
         depths = _spec(self.arch)[1]
+        train = train and not self.frozen_bn  # `train` only gates BN here
         x = x.astype(self.dtype)
         x = _stage(
             self.arch, x, _WIDTHS[3], depths[3], 2, self.dtype, train, "layer4",

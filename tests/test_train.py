@@ -339,3 +339,56 @@ class TestLayerCostTable:
         for r in out["convs"]:
             for k in ("eff_fwd", "eff_dgrad", "eff_wgrad"):
                 assert 0 < r[k] <= 1
+
+
+class TestFrozenBN:
+    """model.frozen_bn=True — BN runs on stored stats even in train mode
+    (torchvision-detection FrozenBatchNorm2d convention)."""
+
+    def _setup(self, frozen):
+        import dataclasses
+
+        cfg = _tiny_cfg()
+        cfg = cfg.replace(
+            model=dataclasses.replace(cfg.model, frozen_bn=frozen)
+        )
+        tx, _ = make_optimizer(cfg, steps_per_epoch=10)
+        model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
+        ds = SyntheticDataset(cfg.data, length=2)
+        batch = {k: jnp.asarray(v) for k, v in collate([ds[0], ds[1]]).items()}
+        return cfg, model, state, batch, tx
+
+    def test_batch_stats_frozen_params_move(self):
+        cfg, model, state, batch, tx = self._setup(True)
+        step = jax.jit(make_train_step(model, cfg, tx))
+        new_state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        for old, new in zip(
+            jax.tree_util.tree_leaves(state.batch_stats),
+            jax.tree_util.tree_leaves(new_state.batch_stats),
+        ):
+            np.testing.assert_array_equal(np.asarray(old), np.asarray(new))
+        # the affine (and everything else) still trains
+        leaf = jax.tree_util.tree_leaves(state.params)[0]
+        new_leaf = jax.tree_util.tree_leaves(new_state.params)[0]
+        assert not np.allclose(np.asarray(leaf), np.asarray(new_leaf))
+
+    def test_train_forward_equals_eval_forward(self):
+        # with frozen stats the trunk is mode-independent (no dropout in
+        # the ResNet trunk), so train and eval features must be identical
+        cfg, model, state, batch, _ = self._setup(True)
+        v = {"params": state.params, "batch_stats": state.batch_stats}
+        f_train, _ = model.apply(
+            v, batch["image"], True, method="extract_features",
+            mutable=["batch_stats"],
+        )
+        f_eval = model.apply(v, batch["image"], False, method="extract_features")
+        np.testing.assert_array_equal(np.asarray(f_train), np.asarray(f_eval))
+
+    def test_unfrozen_still_updates_stats(self):
+        cfg, model, state, batch, tx = self._setup(False)
+        step = jax.jit(make_train_step(model, cfg, tx))
+        new_state, _ = step(state, batch)
+        old = jax.tree_util.tree_leaves(state.batch_stats)[0]
+        new = jax.tree_util.tree_leaves(new_state.batch_stats)[0]
+        assert not np.allclose(np.asarray(old), np.asarray(new))
