@@ -104,6 +104,8 @@ def _build_config(args):
         eval_kw["use_07_metric"] = True
     if getattr(args, "metric", None):
         eval_kw["metric"] = args.metric
+    if getattr(args, "tta_hflip", False):
+        eval_kw["tta_hflip"] = True
     if eval_kw:
         cfg = cfg.replace(eval=dataclasses.replace(cfg.eval, **eval_kw))
     return cfg
@@ -371,6 +373,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="VOC2007 11-point AP instead of area-under-PR")
     p_eval.add_argument("--metric", default=None, choices=[None, "voc", "coco"],
                         help="voc: mAP@iou-thresh; coco: mAP@[.50:.95]")
+    p_eval.add_argument("--tta-hflip", action="store_true",
+                        help="flip test-time augmentation: mirrored second "
+                             "forward, candidates merged before NMS "
+                             "(~2x eval compute for a small mAP gain)")
     p_eval.set_defaults(fn=cmd_eval)
 
     p_bench = sub.add_parser("bench", help="train-step throughput")

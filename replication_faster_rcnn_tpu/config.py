@@ -292,6 +292,11 @@ class EvalConfig:
     iou_thresh: float = 0.5  # mAP@0.5
     use_07_metric: bool = False  # area-under-PR by default; True = 11-point
     metric: str = "voc"  # "voc" (mAP@iou_thresh) | "coco" (mAP@[.50:.95])
+    # flip test-time augmentation: a second forward on the mirrored
+    # image, candidates reflected back and merged before the shared
+    # per-class NMS (eval/detect.py::decode_detections_tta). ~2x eval
+    # compute for a small mAP gain; off by default
+    tta_hflip: bool = False
 
     def __post_init__(self):
         if self.metric not in ("voc", "coco"):

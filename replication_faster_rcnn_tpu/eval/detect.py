@@ -30,24 +30,15 @@ from replication_faster_rcnn_tpu.ops import nms as nms_ops
 Array = jnp.ndarray
 
 
-def decode_detections(
+def _class_boxes_scores(
     rois: Array,
-    roi_valid: Array,
     cls_logits: Array,
     reg_out: Array,
     img_h: float,
     img_w: float,
-    eval_cfg: EvalConfig,
     roi_cfg: ROITargetConfig,
-) -> Dict[str, Array]:
-    """Per-image decode.
-
-    Args:
-      rois: [R, 4]; roi_valid: [R]; cls_logits: [R, C]; reg_out: [R, C*4].
-
-    Returns dict with 'boxes' [D, 4], 'scores' [D], 'classes' [D] int32,
-    'valid' [D] bool, D = eval_cfg.max_detections.
-    """
+) -> Tuple[Array, Array]:
+    """Pre-NMS stage: (probs [R, C], clipped class boxes [R, C, 4])."""
     r = rois.shape[0]
     c = cls_logits.shape[-1]
     probs = jax.nn.softmax(cls_logits, axis=-1)  # [R, C]
@@ -57,9 +48,18 @@ def decode_detections(
     std = jnp.asarray(roi_cfg.reg_std, jnp.float32)
     deltas = reg_out.reshape(r, c, 4) * std + mean  # [R, C, 4]
     boxes = box_ops.decode(rois[:, None, :], deltas)  # [R, C, 4]
-    boxes = box_ops.clip(boxes, img_h, img_w)
+    return probs, box_ops.clip(boxes, img_h, img_w)
 
-    # flatten (roi, class>0) pairs; background column dropped by masking
+
+def _nms_tail(
+    boxes: Array,
+    probs: Array,
+    roi_valid: Array,
+    eval_cfg: EvalConfig,
+) -> Dict[str, Array]:
+    """Shared decode tail: flatten (roi, class>0) pairs, score-threshold,
+    per-class NMS, fixed D = eval_cfg.max_detections outputs."""
+    r, c = probs.shape
     flat_boxes = boxes.reshape(r * c, 4)
     flat_scores = probs.reshape(r * c)
     class_ids = jnp.tile(jnp.arange(c), (r,))
@@ -82,6 +82,75 @@ def decode_detections(
     }
 
 
+def decode_detections(
+    rois: Array,
+    roi_valid: Array,
+    cls_logits: Array,
+    reg_out: Array,
+    img_h: float,
+    img_w: float,
+    eval_cfg: EvalConfig,
+    roi_cfg: ROITargetConfig,
+) -> Dict[str, Array]:
+    """Per-image decode.
+
+    Args:
+      rois: [R, 4]; roi_valid: [R]; cls_logits: [R, C]; reg_out: [R, C*4].
+
+    Returns dict with 'boxes' [D, 4], 'scores' [D], 'classes' [D] int32,
+    'valid' [D] bool, D = eval_cfg.max_detections.
+    """
+    probs, boxes = _class_boxes_scores(
+        rois, cls_logits, reg_out, img_h, img_w, roi_cfg
+    )
+    return _nms_tail(boxes, probs, roi_valid, eval_cfg)
+
+
+def decode_detections_tta(
+    rois: Array,
+    roi_valid: Array,
+    cls_logits: Array,
+    reg_out: Array,
+    rois_f: Array,
+    roi_valid_f: Array,
+    cls_logits_f: Array,
+    reg_out_f: Array,
+    img_h: float,
+    img_w: float,
+    eval_cfg: EvalConfig,
+    roi_cfg: ROITargetConfig,
+) -> Dict[str, Array]:
+    """Flip test-time augmentation: merge the plain pass with a pass run
+    on the horizontally mirrored image (``*_f`` arrays, still in the
+    MIRRORED frame). Each pass decodes class boxes in its own frame;
+    the mirrored boxes are reflected back (x -> W - x, the train-time
+    ``hflip_sample`` convention) and the union of 2R candidates runs one
+    shared per-class NMS — so duplicates across passes suppress each
+    other instead of surviving two independent NMS rounds. The reference
+    has no eval path at all (`test_eval.py` empty); TTA is a
+    capability-plus over the paper recipe."""
+    probs_a, boxes_a = _class_boxes_scores(
+        rois, cls_logits, reg_out, img_h, img_w, roi_cfg
+    )
+    probs_b, boxes_b = _class_boxes_scores(
+        rois_f, cls_logits_f, reg_out_f, img_h, img_w, roi_cfg
+    )
+    # reflect mirrored-frame boxes back: [y1, x1, y2, x2] row-major
+    boxes_b = jnp.stack(
+        [
+            boxes_b[..., 0],
+            img_w - boxes_b[..., 3],
+            boxes_b[..., 2],
+            img_w - boxes_b[..., 1],
+        ],
+        axis=-1,
+    )
+    probs = jnp.concatenate([probs_a, probs_b], axis=0)  # [2R, C]
+    boxes = jnp.concatenate([boxes_a, boxes_b], axis=0)  # [2R, C, 4]
+    valid = jnp.concatenate([roi_valid, roi_valid_f], axis=0)
+    return _nms_tail(boxes, probs, valid, eval_cfg)
+
+
 def batched_decode(
     rois: Array,
     roi_valid: Array,
@@ -98,3 +167,19 @@ def batched_decode(
             r, v, cl, rg, img_h, img_w, eval_cfg, roi_cfg
         )
     )(rois, roi_valid, cls_logits, reg_out)
+
+
+def batched_decode_tta(
+    plain: Tuple[Array, Array, Array, Array],
+    mirrored: Tuple[Array, Array, Array, Array],
+    img_h: float,
+    img_w: float,
+    eval_cfg: EvalConfig,
+    roi_cfg: ROITargetConfig,
+) -> Dict[str, Array]:
+    """vmap of :func:`decode_detections_tta` over the batch."""
+    return jax.vmap(
+        lambda r, v, cl, rg, rf, vf, clf, rgf: decode_detections_tta(
+            r, v, cl, rg, rf, vf, clf, rgf, img_h, img_w, eval_cfg, roi_cfg
+        )
+    )(*plain, *mirrored)

@@ -18,7 +18,10 @@ import numpy as np
 
 from replication_faster_rcnn_tpu.config import FasterRCNNConfig
 from replication_faster_rcnn_tpu.data import DataLoader
-from replication_faster_rcnn_tpu.eval.detect import batched_decode
+from replication_faster_rcnn_tpu.eval.detect import (
+    batched_decode,
+    batched_decode_tta,
+)
 from replication_faster_rcnn_tpu.eval.voc_eval import coco_map, voc_ap
 from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
 
@@ -35,10 +38,23 @@ class Evaluator:
         self.devices = devices
         h, w = config.data.image_size
 
-        def infer(variables: Any, images):
+        def _forward(variables: Any, images):
             logits, deltas, rois, valid, cls, reg, _ = self.model.apply(
                 variables, images, train=False
             )
+            return rois, valid, cls, reg
+
+        def infer(variables: Any, images):
+            plain = _forward(variables, images)
+            if config.eval.tta_hflip:
+                # second pass on the mirrored image; its candidates stay
+                # in the mirrored frame until the decode reflects them
+                mirrored = _forward(variables, images[:, :, ::-1, :])
+                return batched_decode_tta(
+                    plain, mirrored, float(h), float(w),
+                    config.eval, config.roi_targets,
+                )
+            rois, valid, cls, reg = plain
             return batched_decode(
                 rois, valid, cls, reg, float(h), float(w),
                 config.eval, config.roi_targets,
