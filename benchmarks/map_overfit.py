@@ -184,9 +184,18 @@ def main() -> None:
         evaluator.evaluate(variables, big_val, batch_size=args.batch)["mAP"]
     )
 
+    # flip-TTA leg on the same split/state: what the mirrored second
+    # forward + merged NMS buys at eval time (eval/detect.py TTA path)
+    tta_cfg = cfg.replace(eval=dataclasses.replace(cfg.eval, tta_hflip=True))
+    big_val_map_tta = float(
+        Evaluator(tta_cfg, trainer.model)
+        .evaluate(variables, big_val, batch_size=args.batch)["mAP"]
+    )
+
     result = {
         "final_val_mAP": final_map,
         "val_mAP_large_split": big_val_map,
+        "val_mAP_large_split_tta": big_val_map_tta,
         "val_images_large_split": args.final_val_images,
         "last_intraining_val_mAP": last.get("mAP"),
         "train_set_mAP": train_map,
