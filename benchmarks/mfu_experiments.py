@@ -44,7 +44,7 @@ Remaining resume order (profile leg dropped): the service wedged for
 new clients after the --profile block and the relay process itself died
 ~09:45Z. When a fresh relay appears, run — cheap settled questions
 first, wedge risks last:
-  python benchmarks/mfu_experiments.py --only 13,15,8,9,10,11,14,1,5,12
+  python benchmarks/mfu_experiments.py --only 13,15,16,8,9,10,11,14,1,5,12
 (13 = clean default-config flagship point; 15 = frozen-BN A/B against
 it; 8,9 = fed-trainer legs; 10,11 = align/coco first records;
 14 = grad_breakdown attribution; then the FPN pair and Pallas dead
@@ -222,6 +222,18 @@ EXPERIMENTS = [
         "env": {},
         "args": ["--frozen-bn", "--batch-size", "16"],
         "why": "price train-mode BN: the cross-config gap ranking tracks BN density",
+    },
+    {
+        # index 16 — on-chip cost of the device-side scale-jitter
+        # resample (ops/image.py): vs experiment 13 this prices the
+        # fused input-pipeline gather inside the timed step (expected
+        # ~negligible next to the conv stack; host-side the same jitter
+        # costs 27 ms/sample). Same single-chip batch note as exp 15.
+        "name": "flagship_b16_device_jitter",
+        "env": {},
+        "args": ["--augment-scale", "0.75", "1.25",
+                 "--augment-scale-device", "--batch-size", "16"],
+        "why": "price the on-chip jitter gather vs the 27 ms/sample host resample",
     },
 ]
 

@@ -484,6 +484,19 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
     state = place_train_state(state, shardings)
 
     ds = SyntheticDataset(cfg.data, length=batch_size)
+    if cfg.data.augment_scale:
+        # --augment-scale[-device] must change what the step RUNS, not
+        # just the config label: the view attaches the 'jitter' geometry
+        # (device mode — the on-chip resample becomes part of the timed
+        # step) or pre-jitters on host (host mode; step unchanged but
+        # the batch content matches training)
+        from replication_faster_rcnn_tpu.data.augment import AugmentedView
+
+        ds = AugmentedView(
+            ds, seed=0, epoch=0, hflip=False,
+            scale_range=cfg.data.augment_scale,
+            scale_on_device=cfg.data.augment_scale_device,
+        )
     batch = collate([ds[i] for i in range(batch_size)])
     device_batch = shard_batch(batch, mesh, cfg.mesh)
 

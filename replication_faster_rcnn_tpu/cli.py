@@ -53,6 +53,8 @@ def _build_config(args):
         data_kw["augment_hflip"] = False
     if getattr(args, "augment_scale", None):
         data_kw["augment_scale"] = tuple(args.augment_scale)
+    if getattr(args, "augment_scale_device", False):
+        data_kw["augment_scale_device"] = True
     if getattr(args, "cache_ram", False):
         data_kw["loader_cache_ram"] = True
     if getattr(args, "device_normalize", False):
@@ -171,6 +173,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="random scale-jitter augmentation, e.g. 0.75 1.25 "
                         "(fixed canvas: zoom-out pads, zoom-in crops; "
                         "deterministic per seed/epoch/index)")
+    p.add_argument("--augment-scale-device", action="store_true",
+                   help="run the jitter's image resample on device (host "
+                        "transforms boxes only; removes the per-sample "
+                        "host resample cost from ingest)")
     p.add_argument("--num-model", type=int, default=None,
                    help="size of the mesh's model axis")
     p.add_argument("--spatial", action="store_true",
@@ -267,7 +273,7 @@ def cmd_bench(args) -> int:
         )
     ) or (
         args.spatial or args.remat or args.shard_opt or args.augment_hflip
-        or args.frozen_bn
+        or args.frozen_bn or args.augment_scale_device
         or args.no_augment_hflip or args.cache_ram or args.device_normalize
         or args.config != "voc_resnet18"
     )

@@ -64,60 +64,27 @@ def _resize_bilinear(image: np.ndarray, oh: int, ow: int) -> np.ndarray:
     return out.astype(image.dtype)
 
 
-def scale_jitter_sample(
-    sample: Dict[str, np.ndarray],
-    scale: float,
-    off_y: float,
-    off_x: float,
-) -> Dict[str, np.ndarray]:
-    """Random-scale view on a FIXED canvas (jit shapes never change).
-
-    The image content is resized by ``scale``; zoom-out (<1) pads the
-    canvas with the image's channel means (the normalization's zero in
-    f32 samples, a neutral gray for uint8 device-normalize samples),
-    zoom-in (>1) crops a canvas-sized window. ``off_y``/``off_x`` in
-    [0, 1] place the content/window (0.5 = centered). Boxes follow the
-    same continuous-coordinate affine (b*s - shift), are clipped to the
-    canvas, and rows that collapse below 1px get label -1 / mask False /
-    -1-filled geometry — identical to the loader's padded-row
-    convention, so downstream target assignment and eval are unaffected.
-
-    Reference parity note: the reference has no augmentation at all
-    (`utils/data_loader.py:56-79`); multi-scale training is standard in
-    descendants of the original recipe.
-    """
-    image = sample["image"]
-    h, w = image.shape[:2]
+def jitter_geometry(
+    h: int, w: int, scale: float, off_y: float, off_x: float
+) -> tuple:
+    """(ch, cw, shift_y, shift_x): the integer jitter geometry shared by
+    the host resample below and the on-device one (`ops/image.py`) —
+    both sides consume the SAME rounded integers, so they can never
+    disagree about sub-pixel placement."""
     ch, cw = max(1, int(round(h * scale))), max(1, int(round(w * scale)))
-    if image.dtype == np.uint8:
-        # the repo's canonical u8 resize (fused C++ kernel when built,
-        # same half-pixel spec as the numpy fallback) — keeps the
-        # device-normalize ingest path off the slow pure-numpy gather
-        from replication_faster_rcnn_tpu.data.native_ops import resize_u8
+    shift_y = int(round((ch - h) * float(np.clip(off_y, 0.0, 1.0))))
+    shift_x = int(round((cw - w) * float(np.clip(off_x, 0.0, 1.0))))
+    return ch, cw, shift_y, shift_x
 
-        content = resize_u8(image, (ch, cw))
-    else:
-        content = _resize_bilinear(image, ch, cw)
-    # exact per-axis factors after rounding, so boxes track pixels
+
+def jitter_boxes(
+    sample: Dict[str, np.ndarray], geom: tuple, h: int, w: int
+) -> Dict[str, np.ndarray]:
+    """Box/label/mask half of the jitter (image untouched): the affine
+    b*s - shift with canvas clipping; collapsed rows take the padded-row
+    convention (label -1, mask False, -1 geometry)."""
+    ch, cw, shift_y, shift_x = geom
     sy, sx = ch / h, cw / w
-
-    canvas = np.empty_like(image)
-    if ch < h or cw < w:  # zoom-in content covers the whole canvas
-        fill = image.mean(axis=(0, 1))
-        if image.dtype == np.uint8:
-            fill = np.clip(np.rint(fill), 0, 255)
-        canvas[:] = fill.astype(image.dtype)[None, None, :]
-    # content-placement shift: out = in*s - shift (negative = padding)
-    shift_y = int(round((ch - h) * np.clip(off_y, 0.0, 1.0)))
-    shift_x = int(round((cw - w) * np.clip(off_x, 0.0, 1.0)))
-    src_y0, dst_y0 = max(0, shift_y), max(0, -shift_y)
-    src_x0, dst_x0 = max(0, shift_x), max(0, -shift_x)
-    span_y = min(ch - src_y0, h - dst_y0)
-    span_x = min(cw - src_x0, w - dst_x0)
-    canvas[dst_y0 : dst_y0 + span_y, dst_x0 : dst_x0 + span_x] = content[
-        src_y0 : src_y0 + span_y, src_x0 : src_x0 + span_x
-    ]
-
     boxes = sample["boxes"].copy()
     labels = sample["labels"].copy()
     mask = sample["mask"].copy() if "mask" in sample else None
@@ -142,13 +109,67 @@ def scale_jitter_sample(
         labels[vi] = -1
         if mask is not None:
             mask[vi] = False
-
     out = dict(sample)
-    out["image"] = canvas
     out["boxes"] = boxes
     out["labels"] = labels
     if mask is not None:
         out["mask"] = mask
+    return out
+
+
+def scale_jitter_sample(
+    sample: Dict[str, np.ndarray],
+    scale: float,
+    off_y: float,
+    off_x: float,
+) -> Dict[str, np.ndarray]:
+    """Random-scale view on a FIXED canvas (jit shapes never change).
+
+    The image content is resized by ``scale``; zoom-out (<1) pads the
+    canvas with the image's channel means (the normalization's zero in
+    f32 samples, a neutral gray for uint8 device-normalize samples),
+    zoom-in (>1) crops a canvas-sized window. ``off_y``/``off_x`` in
+    [0, 1] place the content/window (0.5 = centered). Boxes follow the
+    same continuous-coordinate affine (b*s - shift), are clipped to the
+    canvas, and rows that collapse below 1px get label -1 / mask False /
+    -1-filled geometry — identical to the loader's padded-row
+    convention, so downstream target assignment and eval are unaffected.
+
+    Reference parity note: the reference has no augmentation at all
+    (`utils/data_loader.py:56-79`); multi-scale training is standard in
+    descendants of the original recipe.
+    """
+    image = sample["image"]
+    h, w = image.shape[:2]
+    geom = jitter_geometry(h, w, scale, off_y, off_x)
+    ch, cw, shift_y, shift_x = geom
+    if image.dtype == np.uint8:
+        # the repo's canonical u8 resize (fused C++ kernel when built,
+        # same half-pixel spec as the numpy fallback) — keeps the
+        # device-normalize ingest path off the slow pure-numpy gather
+        from replication_faster_rcnn_tpu.data.native_ops import resize_u8
+
+        content = resize_u8(image, (ch, cw))
+    else:
+        content = _resize_bilinear(image, ch, cw)
+
+    canvas = np.empty_like(image)
+    if ch < h or cw < w:  # zoom-in content covers the whole canvas
+        fill = image.mean(axis=(0, 1))
+        if image.dtype == np.uint8:
+            fill = np.clip(np.rint(fill), 0, 255)
+        canvas[:] = fill.astype(image.dtype)[None, None, :]
+    # content-placement shift: out = in*s - shift (negative = padding)
+    src_y0, dst_y0 = max(0, shift_y), max(0, -shift_y)
+    src_x0, dst_x0 = max(0, shift_x), max(0, -shift_x)
+    span_y = min(ch - src_y0, h - dst_y0)
+    span_x = min(cw - src_x0, w - dst_x0)
+    canvas[dst_y0 : dst_y0 + span_y, dst_x0 : dst_x0 + span_x] = content[
+        src_y0 : src_y0 + span_y, src_x0 : src_x0 + span_x
+    ]
+
+    out = jitter_boxes(sample, geom, h, w)
+    out["image"] = canvas
     return out
 
 
@@ -175,6 +196,7 @@ class AugmentedView:
         epoch: int,
         hflip: bool = True,
         scale_range=None,
+        scale_on_device: bool = False,
     ) -> None:
         self.dataset = dataset
         self.seed = int(seed)
@@ -188,6 +210,11 @@ class AugmentedView:
                 )
             scale_range = (lo, hi)
         self.scale_range = scale_range
+        # device mode: the host transforms boxes only and attaches the
+        # integer jitter geometry as sample["jitter"]; the image resample
+        # runs on-chip (`ops/image.py::batched_scale_jitter`), so the
+        # ~27 ms/600x600 host resample cost disappears from ingest
+        self.scale_on_device = bool(scale_on_device) and scale_range is not None
 
     def __len__(self) -> int:
         return len(self.dataset)
@@ -205,6 +232,16 @@ class AugmentedView:
             )
             & 0xFFFFFFFFFFFFFFFF
         )
+        # Order is mode-dependent ON PURPOSE. Host mode keeps the
+        # original jitter-then-flip so a fixed (seed, epoch, idx) still
+        # byte-reproduces the committed evidence runs
+        # (benchmarks/map_overfit_result_aug_scale.json). Device mode is
+        # flip-then-jitter: the flip must land before collate (it is a
+        # host view), so the on-chip resample always acts on the flipped
+        # frame. The two orders are distributionally identical (the
+        # placement offsets are uniform and mirror-symmetric).
+        if self.scale_on_device and self.hflip and (z & 1):
+            sample = hflip_sample(sample)
         if self.scale_range is not None:
             lo, hi = self.scale_range
             z2 = _splitmix(z + 0x9E3779B97F4A7C15)
@@ -214,8 +251,19 @@ class AugmentedView:
             scale = lo + (hi - lo) * u
             off_y = (z3 >> 11) / float(1 << 53)
             off_x = (z4 >> 11) / float(1 << 53)
-            if abs(scale - 1.0) > 1e-3:
+            jittered = abs(scale - 1.0) > 1e-3
+            if self.scale_on_device:
+                h, w = sample["image"].shape[:2]
+                if jittered:
+                    geom = jitter_geometry(h, w, scale, off_y, off_x)
+                    sample = jitter_boxes(sample, geom, h, w)
+                else:
+                    geom = (h, w, 0, 0)  # identity resample on device
+                out = dict(sample)
+                out["jitter"] = np.asarray(geom, np.int32)
+                sample = out
+            elif jittered:
                 sample = scale_jitter_sample(sample, scale, off_y, off_x)
-        if self.hflip and (z & 1):
+        if not self.scale_on_device and self.hflip and (z & 1):
             sample = hflip_sample(sample)
         return sample

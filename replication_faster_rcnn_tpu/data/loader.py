@@ -100,6 +100,7 @@ class DataLoader:
         worker_mode: str = "thread",
         augment_hflip: bool = False,
         augment_scale=None,
+        augment_scale_device: bool = False,
         stall_timeout: float = 120.0,
         cache_ram: bool = False,
     ) -> None:
@@ -108,6 +109,7 @@ class DataLoader:
         self.stall_timeout = float(stall_timeout)
         self.augment_hflip = augment_hflip
         self.augment_scale = augment_scale
+        self.augment_scale_device = augment_scale_device
         if cache_ram:
             from replication_faster_rcnn_tpu.data.cache import CachedView
 
@@ -166,6 +168,7 @@ class DataLoader:
             self.epoch,
             hflip=self.augment_hflip,
             scale_range=self.augment_scale,
+            scale_on_device=self.augment_scale_device,
         )
 
     def _build(

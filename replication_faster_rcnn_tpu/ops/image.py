@@ -1,0 +1,74 @@
+"""On-device image ops for the input pipeline.
+
+Host-side scale jitter costs ~27 ms per 600x600 sample on one core (the
+resample dominates; `PARITY.md` augmentation evidence), which makes the
+measured +6.5-val-mAP augmentation ingest-bound exactly where the chip
+is fastest. The TPU-native split: the HOST transforms only the boxes
+and draws the jitter geometry (`data/augment.py` attaches an integer
+``[ch, cw, shift_y, shift_x]`` row per sample), and the image resample
+runs HERE, on device, as one vmapped bilinear gather that XLA fuses
+into the input side of the step — per-batch cost is microseconds of
+VPU time instead of tens of host milliseconds per image.
+
+Geometry contract (must match ``data/augment.py::scale_jitter_sample``
+exactly, which is why the host ships the rounded integers rather than
+the raw scale): output pixel (y, x) reads content index
+(y + shift_y, x + shift_x); a content index inside [0, ch) x [0, cw)
+maps to the source image at half-pixel-center coordinates
+((i + 0.5) * H / ch - 0.5), bilinear with edge-clamped taps; outside
+it takes the per-image channel-mean fill. uint8 inputs round back to
+uint8 (the host path's convention for device-normalize caches).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+
+def scale_jitter_image(image: Array, params: Array) -> Array:
+    """One image [H, W, C] + int32 params [4] = (ch, cw, sy, sx)."""
+    h, w = image.shape[0], image.shape[1]
+    ch = params[0].astype(jnp.float32)
+    cw = params[1].astype(jnp.float32)
+    sy = params[2]
+    sx = params[3]
+    im = image.astype(jnp.float32)
+
+    iy = jnp.arange(h, dtype=jnp.int32) + sy  # content row index per out row
+    ix = jnp.arange(w, dtype=jnp.int32) + sx
+    valid_y = (iy >= 0) & (iy < params[0])
+    valid_x = (ix >= 0) & (ix < params[1])
+
+    ys = (iy.astype(jnp.float32) + 0.5) * (h / ch) - 0.5
+    xs = (ix.astype(jnp.float32) + 0.5) * (w / cw) - 0.5
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    y0c, y1c = jnp.clip(y0, 0, h - 1), jnp.clip(y0 + 1, 0, h - 1)
+    x0c, x1c = jnp.clip(x0, 0, w - 1), jnp.clip(x0 + 1, 0, w - 1)
+
+    top = im[y0c][:, x0c] * (1 - wx) + im[y0c][:, x1c] * wx
+    bot = im[y1c][:, x0c] * (1 - wx) + im[y1c][:, x1c] * wx
+    out = top * (1 - wy) + bot * wy
+
+    fill = im.mean(axis=(0, 1))
+    if image.dtype == jnp.uint8:
+        fill = jnp.clip(jnp.round(fill), 0, 255)
+    valid = valid_y[:, None, None] & valid_x[None, :, None]
+    out = jnp.where(valid, out, fill[None, None, :])
+    if image.dtype == jnp.uint8:
+        return jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+    return out.astype(image.dtype)
+
+
+def batched_scale_jitter(images: Array, params: Array) -> Array:
+    """images [N, H, W, C], params int32 [N, 4] -> jittered images.
+
+    Rows with (ch, cw, sy, sx) == (H, W, 0, 0) are identity resamples
+    (the half-pixel map becomes exact passthrough up to float assoc.;
+    uint8 rows round back to their original values)."""
+    return jax.vmap(scale_jitter_image)(images, params)

@@ -103,6 +103,13 @@ def compute_losses(
     Diagnostics only.
     """
     images = batch["image"]
+    if "jitter" in batch:
+        # device-side scale-jitter resample (data.augment_scale_device):
+        # the host shipped raw images + integer jitter geometry; the
+        # boxes in this batch are already transformed host-side
+        from replication_faster_rcnn_tpu.ops.image import batched_scale_jitter
+
+        images = batched_scale_jitter(images, batch["jitter"])
     gt_boxes = batch["boxes"]
     gt_labels = batch["labels"]
     gt_mask = batch["mask"]

@@ -112,6 +112,7 @@ class Trainer:
             worker_mode=config.data.loader_mode,
             augment_hflip=config.data.augment_hflip,
             augment_scale=config.data.augment_scale,
+            augment_scale_device=config.data.augment_scale_device,
             cache_ram=config.data.loader_cache_ram,
         )
         steps_per_epoch = max(len(self.loader), 1)

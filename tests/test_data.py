@@ -548,3 +548,177 @@ class TestCOCO:
         )
         ds = make_dataset(cfg, "val")  # "val" -> "val2017"
         assert len(ds) == 2
+
+
+class TestDeviceScaleJitter:
+    """augment_scale_device: host transforms boxes + ships geometry;
+    the image resample runs on device (ops/image.py)."""
+
+    def _views(self, **kw):
+        # hflip off: host mode orders jitter-then-flip (byte-repro of the
+        # committed evidence) while device mode flips first, so the pure
+        # cross-mode resample equivalence is only defined flip-free
+        ds = SyntheticDataset(_cfg(), length=8)
+        from replication_faster_rcnn_tpu.data.augment import AugmentedView
+
+        host = AugmentedView(ds, 3, 1, hflip=False, scale_range=(0.75, 1.25))
+        dev = AugmentedView(
+            ds, 3, 1, hflip=False, scale_range=(0.75, 1.25),
+            scale_on_device=True,
+        )
+        return host, dev
+
+    def test_device_mode_flip_composes_first(self):
+        """Device mode flips before jittering: a flipped+jittered
+        sample's boxes equal jitter_boxes(hflip_sample(raw))."""
+        from replication_faster_rcnn_tpu.data.augment import (
+            AugmentedView,
+            hflip_sample,
+            jitter_boxes,
+        )
+
+        ds = SyntheticDataset(_cfg(), length=16)
+        dev = AugmentedView(
+            ds, 9, 2, hflip=True, scale_range=(0.75, 1.25),
+            scale_on_device=True,
+        )
+        checked = 0
+        for i in range(16):
+            d = dev[i]
+            raw = ds[i]
+            ch, cw, sy, sx = (int(v) for v in d["jitter"])
+            h, w = raw["image"].shape[:2]
+            if (ch, cw, sy, sx) == (h, w, 0, 0):
+                continue  # identity jitter: nothing to compose
+            flipped = np.array_equal(d["image"], raw["image"][:, ::-1, :])
+            base = hflip_sample(raw) if flipped else raw
+            want = jitter_boxes(base, (ch, cw, sy, sx), h, w)
+            np.testing.assert_array_equal(d["boxes"], want["boxes"])
+            np.testing.assert_array_equal(d["labels"], want["labels"])
+            checked += 1
+        assert checked > 0
+
+    def test_device_resample_matches_host(self):
+        import jax.numpy as jnp
+
+        from replication_faster_rcnn_tpu.ops.image import batched_scale_jitter
+
+        host, dev = self._views()
+        for i in range(8):
+            hs, dsamp = host[i], dev[i]
+            assert dsamp["jitter"].shape == (4,)
+            # boxes/labels/mask: same host-side transform in both modes
+            np.testing.assert_array_equal(hs["boxes"], dsamp["boxes"])
+            np.testing.assert_array_equal(hs["labels"], dsamp["labels"])
+            np.testing.assert_array_equal(hs["mask"], dsamp["mask"])
+            # image: device resample reproduces the host resample
+            out = np.asarray(
+                batched_scale_jitter(
+                    jnp.asarray(dsamp["image"])[None],
+                    jnp.asarray(dsamp["jitter"])[None],
+                )[0]
+            )
+            np.testing.assert_allclose(out, hs["image"], atol=1e-4)
+
+    def test_device_resample_matches_host_uint8(self):
+        import jax.numpy as jnp
+
+        from replication_faster_rcnn_tpu.data.augment import AugmentedView
+        from replication_faster_rcnn_tpu.ops.image import batched_scale_jitter
+
+        ds = SyntheticDataset(_cfg(), length=4)
+
+        class U8View:
+            def __len__(self):
+                return len(ds)
+
+            def __getitem__(self, i):
+                s = dict(ds[i])
+                s["image"] = np.clip(
+                    s["image"] * 64 + 128, 0, 255
+                ).astype(np.uint8)
+                return s
+
+        u8 = U8View()
+        host = AugmentedView(u8, 5, 0, hflip=False, scale_range=(0.7, 1.3))
+        dev = AugmentedView(
+            u8, 5, 0, hflip=False, scale_range=(0.7, 1.3),
+            scale_on_device=True,
+        )
+        for i in range(4):
+            hs, dsamp = host[i], dev[i]
+            out = np.asarray(
+                batched_scale_jitter(
+                    jnp.asarray(dsamp["image"])[None],
+                    jnp.asarray(dsamp["jitter"])[None],
+                )[0]
+            )
+            assert out.dtype == np.uint8
+            # native-kernel vs device rounding may differ by 1 level
+            diff = np.abs(out.astype(int) - hs["image"].astype(int))
+            assert diff.max() <= 1, diff.max()
+
+    def test_identity_rows_pass_through(self):
+        import jax.numpy as jnp
+
+        from replication_faster_rcnn_tpu.ops.image import batched_scale_jitter
+
+        img = np.random.RandomState(0).rand(32, 48, 3).astype(np.float32)
+        params = np.asarray([[32, 48, 0, 0]], np.int32)
+        out = np.asarray(
+            batched_scale_jitter(jnp.asarray(img)[None], jnp.asarray(params))[0]
+        )
+        np.testing.assert_allclose(out, img, atol=1e-6)
+
+    def test_loader_and_train_step_with_device_jitter(self):
+        import jax
+        import jax.numpy as jnp
+
+        from replication_faster_rcnn_tpu.train.train_step import (
+            create_train_state,
+            make_optimizer,
+            make_train_step,
+        )
+        import dataclasses
+
+        from replication_faster_rcnn_tpu.config import (
+            DataConfig,
+            FasterRCNNConfig,
+            MeshConfig,
+            ModelConfig,
+            TrainConfig,
+        )
+
+        cfg = FasterRCNNConfig(
+            model=ModelConfig(
+                backbone="resnet18", roi_op="align", compute_dtype="float32"
+            ),
+            data=DataConfig(
+                dataset="synthetic", image_size=(64, 64), max_boxes=8,
+                augment_hflip=True, augment_scale=(0.75, 1.25),
+                augment_scale_device=True,
+            ),
+            train=TrainConfig(batch_size=2),
+            mesh=MeshConfig(num_data=1),
+        )
+        ds = SyntheticDataset(cfg.data, length=4)
+        loader = DataLoader(
+            ds, batch_size=2, shuffle=False, prefetch=0,
+            augment_hflip=True, augment_scale=(0.75, 1.25),
+            augment_scale_device=True,
+        )
+        batch = next(iter(loader))
+        assert batch["jitter"].shape == (2, 4)
+        assert batch["jitter"].dtype == np.int32
+        tx, _ = make_optimizer(cfg, steps_per_epoch=10)
+        model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
+        step = jax.jit(make_train_step(model, cfg, tx))
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        new_state, metrics = step(state, jb)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_config_requires_scale_range(self):
+        from replication_faster_rcnn_tpu.config import DataConfig
+
+        with pytest.raises(ValueError, match="augment_scale_device"):
+            DataConfig(augment_scale_device=True)
