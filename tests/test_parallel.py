@@ -111,16 +111,18 @@ def test_shard_batch_placement():
     assert len(arr.sharding.device_set) == 8
 
 
-def _assert_dp8_matches_single_device(cfg_for, npos_key):
+def _assert_dp8_matches_single_device(cfg_for, npos_key, batch=None):
     """Shared scaffold: same batch, same init, one step on a 1-device mesh
     and on an 8-device data-parallel mesh must produce the same loss and
     the same updated params (the jit auto-partitioned psum must be
     semantics-preserving). ``cfg_for(n_data)`` builds the config (its
     DataConfig also drives the synthetic batch, so variants can change
     shapes freely); ``npos_key`` picks which sampling-count metric to
-    compare."""
-    ds = SyntheticDataset(cfg_for(1).data, length=8)
-    batch = collate([ds[i] for i in range(8)])
+    compare; ``batch`` overrides the default synthetic batch (e.g. a
+    pre-augmented one carrying extra keys)."""
+    if batch is None:
+        ds = SyntheticDataset(cfg_for(1).data, length=8)
+        batch = collate([ds[i] for i in range(8)])
 
     results = {}
     for n in (1, 8):
@@ -419,3 +421,25 @@ def test_shard_map_step_matches_jit_auto(path):
             rtol=1e-4,
             atol=1e-6,
         )
+
+
+def test_device_jitter_dp8_matches_single_device():
+    """The device-side scale-jitter batch key ('jitter', int32 [N, 4])
+    shards over the data axis like any leaf, and the on-chip resample
+    (ops/image.py) must be dp-equivalence-safe: same jittered batch, one
+    step on 1-device and 8-device meshes, identical loss and params."""
+    from replication_faster_rcnn_tpu.data.augment import AugmentedView
+
+    base = SyntheticDataset(_cfg(1).data, length=8)
+    view = AugmentedView(
+        base, seed=4, epoch=0, hflip=True, scale_range=(0.75, 1.25),
+        scale_on_device=True,
+    )
+    batch = collate([view[i] for i in range(8)])
+    assert batch["jitter"].shape == (8, 4)
+    # at least one non-identity row, or the test proves nothing
+    h, w = batch["image"].shape[1:3]
+    assert not all(
+        tuple(r) == (h, w, 0, 0) for r in batch["jitter"]
+    )
+    _assert_dp8_matches_single_device(_cfg, "n_pos_rpn", batch=batch)
