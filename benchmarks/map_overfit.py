@@ -61,6 +61,9 @@ def main() -> None:
                     metavar=("LO", "HI"),
                     help="scale-jitter augmentation; with it on, results "
                     "go to map_overfit_result*_scale.json")
+    ap.add_argument("--augment-scale-device", action="store_true",
+                    help="run the jitter resample on device (host ships "
+                    "boxes + geometry); results go to *_scale_dev.json")
     ap.add_argument(
         "--config", default="voc_resnet18",
         choices=["voc_resnet18", "voc_resnet50_fpn"],
@@ -90,6 +93,8 @@ def main() -> None:
     import dataclasses
 
     size = (args.image_size, args.image_size)
+    if args.augment_scale_device and not args.augment_scale:
+        ap.error("--augment-scale-device requires --augment-scale LO HI")
     base = get_config(args.config)
     if base.model.fpn and len(args.anchor_scales) != 1:
         ap.error(
@@ -110,7 +115,8 @@ def main() -> None:
         data=DataConfig(dataset="synthetic", image_size=size, max_boxes=8,
                         augment_hflip=args.augment_hflip,
                         augment_scale=tuple(args.augment_scale)
-                        if args.augment_scale else None),
+                        if args.augment_scale else None,
+                        augment_scale_device=args.augment_scale_device),
         train=TrainConfig(
             batch_size=args.batch,
             n_epoch=args.epochs,
@@ -138,6 +144,8 @@ def main() -> None:
         suffix += "_aug"
     if args.augment_scale:
         suffix += "_scale"
+    if args.augment_scale_device:
+        suffix += "_dev"
     curve_path = os.path.join(
         REPO, "benchmarks", f"map_overfit_curve{suffix}.jsonl"
     )
@@ -210,6 +218,7 @@ def main() -> None:
         "dtype": args.dtype,
         "augment_hflip": args.augment_hflip,
         "augment_scale": args.augment_scale,
+        "augment_scale_device": args.augment_scale_device,
         "train_seconds": round(train_s, 1),
         "backend": __import__("jax").default_backend(),
     }
