@@ -65,6 +65,12 @@ def main() -> None:
                     help="run the jitter resample on device (host ships "
                     "boxes + geometry); results go to *_scale_dev.json")
     ap.add_argument(
+        "--tta", dest="tta", action="store_true", default=None,
+        help="run the flip-TTA eval leg on the large val split (defaults "
+        "on only when augmentation flags are set — the TTA leg roughly "
+        "doubles final-eval wall time)")
+    ap.add_argument("--no-tta", dest="tta", action="store_false")
+    ap.add_argument(
         "--config", default="voc_resnet18",
         choices=["voc_resnet18", "voc_resnet50_fpn"],
         help="preset to train: the flagship, or the FPN config (#3 in "
@@ -193,12 +199,23 @@ def main() -> None:
     )
 
     # flip-TTA leg on the same split/state: what the mirrored second
-    # forward + merged NMS buys at eval time (eval/detect.py TTA path)
-    tta_cfg = cfg.replace(eval=dataclasses.replace(cfg.eval, tta_hflip=True))
-    big_val_map_tta = float(
-        Evaluator(tta_cfg, trainer.model)
-        .evaluate(variables, big_val, batch_size=args.batch)["mAP"]
-    )
+    # forward + merged NMS buys at eval time (eval/detect.py TTA path).
+    # Runs only for augmentation studies (or explicit --tta): it roughly
+    # doubles final-eval wall time, so baseline runs skip it.
+    run_tta = args.tta
+    if run_tta is None:
+        run_tta = bool(
+            args.augment_hflip or args.augment_scale is not None
+        )
+    big_val_map_tta = None
+    if run_tta:
+        tta_cfg = cfg.replace(
+            eval=dataclasses.replace(cfg.eval, tta_hflip=True)
+        )
+        big_val_map_tta = float(
+            Evaluator(tta_cfg, trainer.model)
+            .evaluate(variables, big_val, batch_size=args.batch)["mAP"]
+        )
 
     result = {
         "final_val_mAP": final_map,

@@ -49,6 +49,20 @@ first, wedge risks last:
 it; 8,9 = fed-trainer legs; 10,11 = align/coco first records;
 14 = grad_breakdown attribution; then the FPN pair and Pallas dead
 last.)
+
+Round-5 plan (tunnel dead at round start AGAIN — watcher at
+/tmp/tpu_watch.sh polls every 150 s). The moment it reports ALIVE:
+  1. python bench.py                  # bench of record FIRST (r4 VERDICT #2);
+                                      # breakdown now emits dispatch_floor_ms +
+                                      # opt_update_direct_adj_ms (VERDICT #1:
+                                      # is the 15-22 ms direct row just the
+                                      # tunnel's per-program RPC floor?)
+  2. python benchmarks/mfu_experiments.py --only 13,8,9,14,15,16,10,11
+  3. python bench.py                  # bench-late (VERDICT #8): a later wedge
+                                      # must not erase the round's live number
+  4. python benchmarks/mfu_experiments.py --only 1,5,7,12
+     (FPN pair -> profile -> Pallas: the three known wedge classes, in
+     increasing blast-radius order, after everything safe is banked)
 """
 
 from __future__ import annotations

@@ -244,6 +244,9 @@ class DataConfig:
                     "augment_scale must satisfy 0.1 <= lo <= hi <= 4.0, "
                     f"got {self.augment_scale!r}"
                 )
+            # coerce list inputs (dict/JSON config paths) to a tuple so the
+            # frozen dataclass stays hashable like its other tuple fields
+            object.__setattr__(self, "augment_scale", (float(lo), float(hi)))
         if self.augment_scale_device and self.augment_scale is None:
             raise ValueError(
                 "augment_scale_device requires augment_scale to be set"

@@ -251,14 +251,16 @@ class AugmentedView:
             scale = lo + (hi - lo) * u
             off_y = (z3 >> 11) / float(1 << 53)
             off_x = (z4 >> 11) / float(1 << 53)
-            jittered = abs(scale - 1.0) > 1e-3
+            # "did this draw move any pixels?" is decided by the ROUNDED
+            # integer geometry, not a deadband on the continuous scale: a
+            # scale of 1.0009 at 600 px rounds to a 601-px canvas and IS a
+            # jitter, while 1.0004 rounds back to identity
+            h, w = sample["image"].shape[:2]
+            geom = jitter_geometry(h, w, scale, off_y, off_x)
+            jittered = geom != (h, w, 0, 0)
             if self.scale_on_device:
-                h, w = sample["image"].shape[:2]
                 if jittered:
-                    geom = jitter_geometry(h, w, scale, off_y, off_x)
                     sample = jitter_boxes(sample, geom, h, w)
-                else:
-                    geom = (h, w, 0, 0)  # identity resample on device
                 out = dict(sample)
                 out["jitter"] = np.asarray(geom, np.int32)
                 sample = out

@@ -1,0 +1,161 @@
+"""Real-data contract test: a miniature on-disk VOC tree (synthesized
+JPEGs + real Annotations XML) driven end-to-end through ``cli train
+--data-root`` and ``cli eval``.
+
+The reference's entire purpose is `python train.py` over a VOCdevkit tree
+(`utils/data_loader.py:42-48` imageset files, `:56-79` JPEG+XML ingest).
+This image ships no VOC data (zero egress), so every mAP number in the
+repo is synthetic-fixture evidence — this test keeps the real-data recipe
+in PARITY.md §"what remains" from rotting: the exact layout, coordinate
+convention, difficult-flag handling, and CLI surface a real VOC07/12 run
+will use are exercised on every fast-tier run.
+"""
+
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+from replication_faster_rcnn_tpu import cli
+from replication_faster_rcnn_tpu.config import VOC_CLASSES, DataConfig
+from replication_faster_rcnn_tpu.data.voc import VOCDataset
+
+# (image id, (H, W), objects as (class, ymin, xmin, ymax, xmax) in the
+# package's 0-based continuous convention, difficult flag)
+_FIXTURE = [
+    ("000001", (80, 100), [("dog", 10.0, 20.0, 50.0, 70.0, 0)]),
+    (
+        "000002",
+        (96, 72),
+        [
+            ("person", 5.0, 8.0, 60.0, 40.0, 0),
+            ("car", 30.0, 30.0, 90.0, 70.0, 1),  # difficult
+        ],
+    ),
+    ("000003", (64, 64), [("cat", 0.0, 0.0, 32.0, 32.0, 0)]),
+]
+
+
+def _write_voc_tree(root):
+    """Lay out Annotations/ JPEGImages/ ImageSets/Main/ exactly as a real
+    VOCdevkit VOC2012 directory does (reference `utils/data_loader.py:42-48`)."""
+    from PIL import Image
+
+    os.makedirs(os.path.join(root, "Annotations"))
+    os.makedirs(os.path.join(root, "JPEGImages"))
+    os.makedirs(os.path.join(root, "ImageSets", "Main"))
+    rng = np.random.RandomState(0)
+    for img_id, (h, w), objects in _FIXTURE:
+        arr = rng.randint(0, 60, size=(h, w, 3), dtype=np.uint8)
+        ann = ET.Element("annotation")
+        ET.SubElement(ann, "filename").text = img_id + ".jpg"
+        size = ET.SubElement(ann, "size")
+        ET.SubElement(size, "width").text = str(w)
+        ET.SubElement(size, "height").text = str(h)
+        for cls, y0, x0, y1, x1, diff in objects:
+            # plant a bright rectangle so the images are non-degenerate
+            arr[int(y0) : int(y1), int(x0) : int(x1)] = rng.randint(
+                160, 255, size=3, dtype=np.uint8
+            )
+            obj = ET.SubElement(ann, "object")
+            ET.SubElement(obj, "name").text = cls
+            ET.SubElement(obj, "difficult").text = str(diff)
+            bnd = ET.SubElement(obj, "bndbox")
+            # disk XML is 1-based inclusive: mins + 1, maxes as-is
+            ET.SubElement(bnd, "ymin").text = str(int(y0) + 1)
+            ET.SubElement(bnd, "xmin").text = str(int(x0) + 1)
+            ET.SubElement(bnd, "ymax").text = str(int(y1))
+            ET.SubElement(bnd, "xmax").text = str(int(x1))
+        Image.fromarray(arr).save(
+            os.path.join(root, "JPEGImages", img_id + ".jpg"), quality=95
+        )
+        ET.ElementTree(ann).write(
+            os.path.join(root, "Annotations", img_id + ".xml")
+        )
+    ids = [img_id for img_id, _, _ in _FIXTURE]
+    for split, members in (("train", ids), ("val", ids), ("trainval", ids)):
+        with open(
+            os.path.join(root, "ImageSets", "Main", split + ".txt"), "w"
+        ) as f:
+            f.write("\n".join(members) + "\n")
+
+
+@pytest.fixture(scope="module")
+def voc_root(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("mini_voc"))
+    _write_voc_tree(root)
+    return root
+
+
+class TestMiniTreeLoads:
+    def test_dataset_reads_tree(self, voc_root):
+        cfg = DataConfig(root_dir=voc_root, image_size=(64, 64))
+        ds = VOCDataset(cfg, "train")
+        assert len(ds) == 3
+        s = ds[0]
+        assert s["image"].shape == (64, 64, 3)
+        assert s["image"].dtype == np.float32
+        # 000001's dog: disk 1-based coords came back as the 0-based
+        # continuous originals, scaled by the 64/H, 64/W resize and rounded
+        # (reference `utils/data_loader.py:66-69,115` rounds scaled boxes)
+        h, w = _FIXTURE[0][1]
+        expect = np.round(
+            np.array([10.0, 20.0, 50.0, 70.0])
+            * np.array([64 / h, 64 / w, 64 / h, 64 / w])
+        )
+        np.testing.assert_allclose(s["boxes"][0], expect, rtol=1e-6)
+        assert s["labels"][0] == VOC_CLASSES.index("dog")
+        assert s["mask"][0] and not s["difficult"][0]
+
+    def test_difficult_masked_not_dropped(self, voc_root):
+        cfg = DataConfig(root_dir=voc_root, image_size=(64, 64))
+        s = VOCDataset(cfg, "train")[1]
+        # the difficult car keeps its class label (eval needs it as an
+        # ignore-region) but is excluded from the training mask
+        assert s["labels"][1] == VOC_CLASSES.index("car")
+        assert s["difficult"][1]
+        assert not s["mask"][1]
+        assert s["mask"][0]  # the non-difficult person trains
+
+    def test_use_difficult_true_includes_it(self, voc_root):
+        cfg = DataConfig(
+            root_dir=voc_root, image_size=(64, 64), use_difficult=True
+        )
+        s = VOCDataset(cfg, "train")[1]
+        assert s["mask"][1]
+
+
+class TestCliEndToEnd:
+    @pytest.mark.slow
+    def test_train_then_eval_on_tree(self, voc_root, tmp_path, capsys):
+        """The real-VOC recipe's exact CLI surface: bounded-step train then
+        eval, both against --data-root pointing at an on-disk VOC tree."""
+        workdir = str(tmp_path / "ckpts")
+        rc = cli.main(
+            [
+                "train",
+                "--config", "voc_resnet18",
+                "--data-root", voc_root,
+                "--image-size", "64",
+                "--batch-size", "2",
+                "--steps", "2",
+                "--log-every", "1",
+                "--workdir", workdir,
+            ]
+        )
+        assert rc == 0
+        rc = cli.main(
+            [
+                "eval",
+                "--config", "voc_resnet18",
+                "--data-root", voc_root,
+                "--image-size", "64",
+                "--batch-size", "2",
+                "--split", "val",
+                "--workdir", workdir,  # fresh init: no checkpoint saved
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "mAP@0.5" in out
