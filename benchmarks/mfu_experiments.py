@@ -57,7 +57,7 @@ Round-5 plan (tunnel dead at round start AGAIN — watcher at
                                       # opt_update_direct_adj_ms (VERDICT #1:
                                       # is the 15-22 ms direct row just the
                                       # tunnel's per-program RPC floor?)
-  2. python benchmarks/mfu_experiments.py --only 13,8,9,14,15,16,10,11
+  2. python benchmarks/mfu_experiments.py --only 13,8,9,14,15,16,17,10,11
   3. python bench.py                  # bench-late (VERDICT #8): a later wedge
                                       # must not erase the round's live number
   4. python benchmarks/mfu_experiments.py --only 1,5,7,12
@@ -248,6 +248,18 @@ EXPERIMENTS = [
         "args": ["--augment-scale", "0.75", "1.25",
                  "--augment-scale-device", "--batch-size", "16"],
         "why": "price the on-chip jitter gather vs the 27 ms/sample host resample",
+    },
+    {
+        # index 17 — the BN-free structural point on the BN-density axis
+        # (STAGE_BREAKDOWN.md): exp 15 (frozen-BN) prices train-mode
+        # batch-stats reductions; this removes BN entirely (GroupNorm(32),
+        # per-sample, no mutable state). Together the three points
+        # (batch / frozen / group) attribute the BN share of the 4.6x
+        # gap over the tiling ceiling.
+        "name": "flagship_b16_groupnorm",
+        "env": {},
+        "args": ["--norm", "group", "--batch-size", "16"],
+        "why": "GroupNorm backbone: the BN-free point on the BN-density axis",
     },
 ]
 

@@ -81,7 +81,8 @@ def _build_config(args):
     if train_kw:
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **train_kw))
     if (args.backbone or args.roi_op or getattr(args, "remat", False)
-            or getattr(args, "frozen_bn", False)):
+            or getattr(args, "frozen_bn", False)
+            or getattr(args, "norm", None)):
         model_kw = {}
         if args.backbone:
             model_kw["backbone"] = args.backbone
@@ -91,6 +92,8 @@ def _build_config(args):
             model_kw["remat"] = True
         if getattr(args, "frozen_bn", False):
             model_kw["frozen_bn"] = True
+        if getattr(args, "norm", None):
+            model_kw["norm"] = args.norm
         cfg = cfg.replace(model=dataclasses.replace(cfg.model, **model_kw))
     mesh_kw = {}
     if getattr(args, "num_model", None) is not None:
@@ -144,6 +147,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "(detection fine-tuning practice; each BN becomes "
                         "a fusable affine. Affine scale/bias stay "
                         "trainable, unlike torchvision's full freeze)")
+    p.add_argument("--norm", default=None, choices=[None, "batch", "group"],
+                   help="backbone normalization: 'batch' (reference "
+                        "semantics) or 'group' (GroupNorm(32), BN-free — "
+                        "no batch-stats reductions/fusion breaks; "
+                        "torch-pretrained BN weights don't convert)")
     p.add_argument("--mu-dtype", default=None,
                    choices=[None, "float32", "bfloat16"],
                    help="dtype for Adam's first moment (bfloat16 halves "
@@ -269,7 +277,7 @@ def cmd_bench(args) -> int:
             args.dataset, args.data_root, args.image_size, args.backbone,
             args.roi_op, args.batch_size, args.lr, args.epochs, args.seed,
             args.num_model, args.backend, args.mu_dtype, args.loader_workers,
-            args.loader_mode, args.augment_scale,
+            args.loader_mode, args.augment_scale, args.norm,
         )
     ) or (
         args.spatial or args.remat or args.shard_opt or args.augment_hflip

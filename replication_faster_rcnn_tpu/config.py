@@ -154,10 +154,29 @@ class ModelConfig:
     # freezes those too. Off by default: the reference trains BN in
     # batch-stats mode (torch modules default to train())
     frozen_bn: bool = False
+    # normalization at the backbone's BN sites: "batch" (reference
+    # semantics) or "group" (GroupNorm(32), the BN-free structural lever
+    # from the MFU attribution — no batch-stats reductions/fusion breaks,
+    # shard-invariant, but torch-pretrained BN checkpoints don't convert;
+    # see models/resnet.py::_norm). VGG16 has no norm layers; the flag is
+    # a no-op there.
+    norm: str = "batch"
 
     def __post_init__(self):
         if self.roi_op not in ("align", "pool"):
             raise ValueError(f"roi_op must be 'align' or 'pool', got {self.roi_op!r}")
+        if self.norm not in ("batch", "group"):
+            raise ValueError(f"norm must be 'batch' or 'group', got {self.norm!r}")
+        if self.norm == "group" and self.frozen_bn:
+            raise ValueError(
+                "frozen_bn freezes BatchNorm statistics; GroupNorm has none "
+                "— the combination is meaningless, pick one"
+            )
+        if self.norm == "group" and self.bn_axis is not None:
+            raise ValueError(
+                "bn_axis configures cross-replica sync-BN; GroupNorm "
+                "normalizes within each sample and needs no axis"
+            )
 
     @property
     def backbone_channels(self) -> int:

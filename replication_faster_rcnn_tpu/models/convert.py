@@ -220,6 +220,19 @@ def graft_into_variables(variables: Dict[str, Any], pth_path: str) -> Dict[str, 
         out["params"] = params
         return out
 
+    # a norm="group" model has the same param names/shapes at every BN
+    # site (scale/bias) but NO batch_stats collection — a torch BN
+    # checkpoint would graft silently and apply BN-calibrated affines to
+    # group-normalized activations. Fail fast instead (the GN preset
+    # trains from scratch or from a GN-pretrained checkpoint via
+    # train/pretrain.py).
+    if "bn1" in params.get("trunk", {}) and not stats.get("trunk"):
+        raise ValueError(
+            "model has no BatchNorm statistics (norm='group'?) — "
+            "torch-pretrained BN checkpoints do not convert onto a "
+            "GroupNorm backbone"
+        )
+
     (tp, ts), (lp, ls) = load_pretrained_backbone(pth_path)
 
     fpn = "layer4.0" in params.get("trunk", {})

@@ -55,6 +55,7 @@ class FasterRCNN(nn.Module):
             self.trunk = ResNetFeatures(
                 cfg.model.backbone, dtype, bn_axis=cfg.model.bn_axis,
                 remat=cfg.model.remat, frozen_bn=cfg.model.frozen_bn,
+                norm=cfg.model.norm,
             )
             self.neck = FPNNeck(cfg.model.fpn_channels, dtype)
             self.rpn = RPNHead(
@@ -77,6 +78,7 @@ class FasterRCNN(nn.Module):
                 self.trunk = ResNetTrunk(
                     cfg.model.backbone, dtype, bn_axis=cfg.model.bn_axis,
                     remat=cfg.model.remat, frozen_bn=cfg.model.frozen_bn,
+                    norm=cfg.model.norm,
                 )
             # the head dispatches internally on arch (VGG16 fc6/fc7 tail
             # vs ResNet layer4 tail)
@@ -94,6 +96,7 @@ class FasterRCNN(nn.Module):
                 dtype=dtype,
                 bn_axis=cfg.model.bn_axis,
                 frozen_bn=cfg.model.frozen_bn,
+                norm=cfg.model.norm,
             )
 
     # --- stage methods (used individually by the trainer) ---

@@ -50,21 +50,22 @@ class ResNetFeatures(nn.Module):
     bn_axis: Any = None
     remat: bool = False  # jax.checkpoint each residual block
     frozen_bn: bool = False  # see ResNetTrunk.frozen_bn
+    norm: str = "batch"  # see ResNetTrunk.norm
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> List[Array]:
         depths = _spec(self.arch)[1]
         train = train and not self.frozen_bn  # `train` only gates BN here
-        ax, rm = self.bn_axis, self.remat
+        ax, rm, nm = self.bn_axis, self.remat, self.norm
         x = x.astype(self.dtype)
         x = _conv(64, 7, 2, 3, self.dtype, "conv1")(x)
-        x = _norm(self.dtype, train, "bn1", ax)(x)
+        x = _norm(self.dtype, train, "bn1", ax, nm)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
-        c2 = _stage(self.arch, x, _WIDTHS[0], depths[0], 1, self.dtype, train, "layer1", ax, rm)
-        c3 = _stage(self.arch, c2, _WIDTHS[1], depths[1], 2, self.dtype, train, "layer2", ax, rm)
-        c4 = _stage(self.arch, c3, _WIDTHS[2], depths[2], 2, self.dtype, train, "layer3", ax, rm)
-        c5 = _stage(self.arch, c4, _WIDTHS[3], depths[3], 2, self.dtype, train, "layer4", ax, rm)
+        c2 = _stage(self.arch, x, _WIDTHS[0], depths[0], 1, self.dtype, train, "layer1", ax, rm, nm)
+        c3 = _stage(self.arch, c2, _WIDTHS[1], depths[1], 2, self.dtype, train, "layer2", ax, rm, nm)
+        c4 = _stage(self.arch, c3, _WIDTHS[2], depths[2], 2, self.dtype, train, "layer3", ax, rm, nm)
+        c5 = _stage(self.arch, c4, _WIDTHS[3], depths[3], 2, self.dtype, train, "layer4", ax, rm, nm)
         return [c2, c3, c4, c5]
 
 

@@ -47,6 +47,7 @@ class DetectionHead(nn.Module):
     dtype: Any = jnp.bfloat16
     bn_axis: Any = None  # sync-BN axis for the ResNet tail under shard_map
     frozen_bn: bool = False  # see ResNetTrunk.frozen_bn (applies to the tail)
+    norm: str = "batch"  # see ResNetTrunk.norm (applies to the tail)
 
     @nn.compact
     def __call__(
@@ -88,7 +89,7 @@ class DetectionHead(nn.Module):
         else:
             embed = ResNetTail(
                 self.arch, self.dtype, bn_axis=self.bn_axis,
-                frozen_bn=self.frozen_bn, name="tail"
+                frozen_bn=self.frozen_bn, norm=self.norm, name="tail"
             )(crops, train)
         embed = embed.astype(jnp.float32)  # [N*R, C_tail]
 

@@ -31,13 +31,39 @@ import jax.numpy as jnp
 Array = jnp.ndarray
 
 
-def _norm(dtype: Any, train: bool, name: str, axis_name: Any = None) -> nn.BatchNorm:
-    """BatchNorm matching torch defaults (eps 1e-5, momentum 0.1 — i.e.
-    running = 0.9 * running + 0.1 * batch). Stats/scale kept in float32.
+def _norm(
+    dtype: Any,
+    train: bool,
+    name: str,
+    axis_name: Any = None,
+    kind: str = "batch",
+):
+    """Normalization layer at the reference's BN sites.
 
-    ``axis_name`` enables cross-replica (sync) BN under the explicit
-    shard_map backend: batch statistics pmean over that mesh axis, matching
-    what jit auto-partitioning computes on a globally-sharded batch."""
+    ``kind='batch'`` (default): BatchNorm matching torch defaults (eps
+    1e-5, momentum 0.1 — i.e. running = 0.9 * running + 0.1 * batch).
+    Stats/scale kept in float32. ``axis_name`` enables cross-replica
+    (sync) BN under the explicit shard_map backend: batch statistics
+    pmean over that mesh axis, matching what jit auto-partitioning
+    computes on a globally-sharded batch.
+
+    ``kind='group'``: GroupNorm(32) — the BN-free structural lever from
+    the MFU attribution (STAGE_BREAKDOWN.md: the measured-vs-ceiling gap
+    ranking tracks BatchNorm density; train-mode BN's batch-stats
+    reductions are fusion breaks + HBM round-trips XLA cannot elide,
+    while GN normalizes within each sample — no mutable state, no
+    cross-batch coupling, shard-invariant by construction). Parameter
+    names stay at the BN sites' names (scale/bias under e.g. 'bn1') so
+    the tree layout is stable; there are no running statistics, so
+    torch-pretrained BN checkpoints do NOT convert onto a GN model."""
+    if kind == "group":
+        return nn.GroupNorm(
+            num_groups=32,
+            epsilon=1e-5,
+            dtype=dtype,
+            param_dtype=jnp.float32,
+            name=name,
+        )
     return nn.BatchNorm(
         use_running_average=not train,
         momentum=0.9,
@@ -147,18 +173,19 @@ class BasicBlock(nn.Module):
     downsample: bool = False
     dtype: Any = jnp.bfloat16
     bn_axis: Any = None
+    norm: str = "batch"
 
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
         identity = x
         out = _conv(self.features, 3, self.stride, 1, self.dtype, "conv1")(x)
-        out = _norm(self.dtype, train, "bn1", self.bn_axis)(out)
+        out = _norm(self.dtype, train, "bn1", self.bn_axis, self.norm)(out)
         out = nn.relu(out)
         out = _conv(self.features, 3, 1, 1, self.dtype, "conv2")(out)
-        out = _norm(self.dtype, train, "bn2", self.bn_axis)(out)
+        out = _norm(self.dtype, train, "bn2", self.bn_axis, self.norm)(out)
         if self.downsample:
             identity = _conv(self.features, 1, self.stride, 0, self.dtype, "downsample_conv")(x)
-            identity = _norm(self.dtype, train, "downsample_bn", self.bn_axis)(identity)
+            identity = _norm(self.dtype, train, "downsample_bn", self.bn_axis, self.norm)(identity)
         return nn.relu(out + identity)
 
 
@@ -178,24 +205,25 @@ class Bottleneck(nn.Module):
     base_width: int = 64
     bn_axis: Any = None
     expansion: int = 4
+    norm: str = "batch"
 
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
         identity = x
         width = int(self.features * (self.base_width / 64.0)) * self.groups
         out = _conv(width, 1, 1, 0, self.dtype, "conv1")(x)
-        out = _norm(self.dtype, train, "bn1", self.bn_axis)(out)
+        out = _norm(self.dtype, train, "bn1", self.bn_axis, self.norm)(out)
         out = nn.relu(out)
         out = _conv(width, 3, self.stride, 1, self.dtype, "conv2", self.groups)(out)
-        out = _norm(self.dtype, train, "bn2", self.bn_axis)(out)
+        out = _norm(self.dtype, train, "bn2", self.bn_axis, self.norm)(out)
         out = nn.relu(out)
         out = _conv(self.features * self.expansion, 1, 1, 0, self.dtype, "conv3")(out)
-        out = _norm(self.dtype, train, "bn3", self.bn_axis)(out)
+        out = _norm(self.dtype, train, "bn3", self.bn_axis, self.norm)(out)
         if self.downsample:
             identity = _conv(
                 self.features * self.expansion, 1, self.stride, 0, self.dtype, "downsample_conv"
             )(x)
-            identity = _norm(self.dtype, train, "downsample_bn", self.bn_axis)(identity)
+            identity = _norm(self.dtype, train, "downsample_bn", self.bn_axis, self.norm)(identity)
         return nn.relu(out + identity)
 
 
@@ -228,6 +256,7 @@ def _stage(
     name: str,
     bn_axis: Any = None,
     remat: bool = False,
+    norm: str = "batch",
 ) -> Array:
     block, _, groups, base_width = _spec(arch)
     # per-block jax.checkpoint: the backward pass recomputes each residual
@@ -247,6 +276,7 @@ def _stage(
             dtype=dtype,
             name=f"{name}.{i}",
             bn_axis=bn_axis,
+            norm=norm,
             **kw,
         )(x, train)
     return x
@@ -277,6 +307,7 @@ class ResNetTrunk(nn.Module):
     # buffers); this is the affine-fine-tuning variant, chosen so the
     # optimizer/param tree is identical with the flag on or off.
     frozen_bn: bool = False
+    norm: str = "batch"  # "batch" | "group" — see _norm
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
@@ -285,19 +316,19 @@ class ResNetTrunk(nn.Module):
         x = x.astype(self.dtype)
         if self.stem == "cifar":
             x = _conv(64, 3, 1, 1, self.dtype, "conv1")(x)
-            x = _norm(self.dtype, train, "bn1", self.bn_axis)(x)
+            x = _norm(self.dtype, train, "bn1", self.bn_axis, self.norm)(x)
             x = nn.relu(x)
         else:
             x = _conv(64, 7, 2, 3, self.dtype, "conv1")(x)
-            x = _norm(self.dtype, train, "bn1", self.bn_axis)(x)
+            x = _norm(self.dtype, train, "bn1", self.bn_axis, self.norm)(x)
             x = nn.relu(x)
             x = nn.max_pool(
                 x, window_shape=(3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
             )
-        ax, rm = self.bn_axis, self.remat
-        x = _stage(self.arch, x, _WIDTHS[0], depths[0], 1, self.dtype, train, "layer1", ax, rm)
-        x = _stage(self.arch, x, _WIDTHS[1], depths[1], 2, self.dtype, train, "layer2", ax, rm)
-        x = _stage(self.arch, x, _WIDTHS[2], depths[2], 2, self.dtype, train, "layer3", ax, rm)
+        ax, rm, nm = self.bn_axis, self.remat, self.norm
+        x = _stage(self.arch, x, _WIDTHS[0], depths[0], 1, self.dtype, train, "layer1", ax, rm, nm)
+        x = _stage(self.arch, x, _WIDTHS[1], depths[1], 2, self.dtype, train, "layer2", ax, rm, nm)
+        x = _stage(self.arch, x, _WIDTHS[2], depths[2], 2, self.dtype, train, "layer3", ax, rm, nm)
         return x
 
 
@@ -314,6 +345,7 @@ class ResNetTail(nn.Module):
     dtype: Any = jnp.bfloat16
     bn_axis: Any = None
     frozen_bn: bool = False  # see ResNetTrunk.frozen_bn
+    norm: str = "batch"  # see ResNetTrunk.norm
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
@@ -322,7 +354,7 @@ class ResNetTail(nn.Module):
         x = x.astype(self.dtype)
         x = _stage(
             self.arch, x, _WIDTHS[3], depths[3], 2, self.dtype, train, "layer4",
-            self.bn_axis,
+            self.bn_axis, norm=self.norm,
         )
         return jnp.mean(x, axis=(1, 2))  # global avg pool == AdaptiveAvgPool2d(1)
 

@@ -61,8 +61,13 @@ def make_shard_map_train_step(
     model's, so states are interchangeable between the two backends.
     """
     axis = config.mesh.data_axis
+    # sync-BN binds batch statistics to the data axis; GroupNorm is
+    # per-sample and needs no axis (the config layer rejects the combo)
     cfg = config.replace(
-        model=dataclasses.replace(config.model, bn_axis=axis)
+        model=dataclasses.replace(
+            config.model,
+            bn_axis=axis if config.model.norm == "batch" else None,
+        )
     )
     model = FasterRCNN(cfg)
 

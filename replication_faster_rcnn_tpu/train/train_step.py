@@ -154,12 +154,14 @@ def compute_losses(
             reg_t.sum() + lab_t.sum() + sample_rois.sum()
             + reg_t2.sum() + lab_t2.sum()
         ).astype(jnp.float32)
-        return probe, ({}, mut["batch_stats"])
+        return probe, ({}, mut.get("batch_stats", {}))
 
     # head on the sampled rois (BN in the tail also updates; the VGG16
     # tail's dropout draws from the 'dropout' rng in train mode)
     (cls_out, reg_out), mut2 = model.apply(
-        {"params": params, "batch_stats": mut["batch_stats"]},
+        # norm="group" models carry no batch_stats collection — flax then
+        # omits the key from the mutated-state dict
+        {"params": params, "batch_stats": mut.get("batch_stats", {})},
         feat,
         sample_rois,
         img_h,
@@ -186,7 +188,7 @@ def compute_losses(
         "n_pos_rpn": (lab_t == 1).sum().astype(jnp.float32),
         "n_pos_head": (lab_t2 > 0).sum().astype(jnp.float32),
     }
-    return total, (metrics, mut2["batch_stats"])
+    return total, (metrics, mut2.get("batch_stats", {}))
 
 
 def make_train_step(
