@@ -65,6 +65,11 @@ def main() -> None:
                     help="run the jitter resample on device (host ships "
                     "boxes + geometry); results go to *_scale_dev.json")
     ap.add_argument(
+        "--norm", default="batch", choices=["batch", "group"],
+        help="backbone normalization; 'group' trains the GroupNorm(32) "
+        "variant (results go to *_gn.json) — quality evidence for the "
+        "BN-free MFU lever")
+    ap.add_argument(
         "--tta", dest="tta", action="store_true", default=None,
         help="run the flip-TTA eval leg on the large val split (defaults "
         "on only when augmentation flags are set — the TTA leg roughly "
@@ -116,7 +121,8 @@ def main() -> None:
             base.anchors, scales=tuple(args.anchor_scales)
         ),
         model=dataclasses.replace(
-            base.model, roi_op="align", compute_dtype=args.dtype
+            base.model, roi_op="align", compute_dtype=args.dtype,
+            norm=args.norm,
         ),
         data=DataConfig(dataset="synthetic", image_size=size, max_boxes=8,
                         augment_hflip=args.augment_hflip,
@@ -152,6 +158,8 @@ def main() -> None:
         suffix += "_scale"
     if args.augment_scale_device:
         suffix += "_dev"
+    if args.norm == "group":
+        suffix += "_gn"
     curve_path = os.path.join(
         REPO, "benchmarks", f"map_overfit_curve{suffix}.jsonl"
     )
@@ -236,6 +244,7 @@ def main() -> None:
         "augment_hflip": args.augment_hflip,
         "augment_scale": args.augment_scale,
         "augment_scale_device": args.augment_scale_device,
+        "norm": args.norm,
         "train_seconds": round(train_s, 1),
         "backend": __import__("jax").default_backend(),
     }

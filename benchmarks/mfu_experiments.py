@@ -57,12 +57,17 @@ Round-5 plan (tunnel dead at round start AGAIN — watcher at
                                       # opt_update_direct_adj_ms (VERDICT #1:
                                       # is the 15-22 ms direct row just the
                                       # tunnel's per-program RPC floor?)
-  2. python benchmarks/mfu_experiments.py --only 13,8,9,14,15,16,17,10,11
+  2. python benchmarks/mfu_experiments.py --only 13,8,9,14,1,15,16,17,10,11
+     (13 flagship re-record; 8,9 fed-trainer legs = VERDICT #5; 14 grad
+     attribution = VERDICT #7; then 1 = the FPN b8 re-verify, VERDICT #4 —
+     a known wedge class, placed after the four most-wanted numbers but
+     before the lever A/Bs; stop-on-failure halts everything behind a
+     wedge. 17 = the new GroupNorm point on the BN-density axis.)
   3. python bench.py                  # bench-late (VERDICT #8): a later wedge
                                       # must not erase the round's live number
-  4. python benchmarks/mfu_experiments.py --only 1,5,7,12
-     (FPN pair -> profile -> Pallas: the three known wedge classes, in
-     increasing blast-radius order, after everything safe is banked)
+  4. python benchmarks/mfu_experiments.py --only 5,7,12
+     (FPN b16 -> profile -> Pallas: remaining wedge classes in increasing
+     blast-radius order, after everything else is banked)
 """
 
 from __future__ import annotations

@@ -373,11 +373,15 @@ class ResNetClassifier(nn.Module):
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
     stem: str = "imagenet"
+    norm: str = "batch"  # see ResNetTrunk.norm — "group" pretrains the
+    # GN backbone whose checkpoint grafts onto a norm="group" detector
 
     @nn.compact
     def __call__(self, x: Array, train: bool = False) -> Array:
-        x = ResNetTrunk(self.arch, self.dtype, self.stem, name="trunk")(x, train)
-        x = ResNetTail(self.arch, self.dtype, name="tail")(x, train)
+        x = ResNetTrunk(
+            self.arch, self.dtype, self.stem, norm=self.norm, name="trunk"
+        )(x, train)
+        x = ResNetTail(self.arch, self.dtype, norm=self.norm, name="tail")(x, train)
         return nn.Dense(self.num_classes, param_dtype=jnp.float32, name="fc")(
             x.astype(jnp.float32)
         )

@@ -26,9 +26,14 @@ def make_classifier(
     num_classes: int = 10,
     stem: str = "cifar",
     dtype: str = "bfloat16",
+    norm: str = "batch",
 ):
+    """``norm="group"`` pretrains the GroupNorm backbone — the only
+    pretrained-weight source for a ``norm="group"`` detector (torch BN
+    checkpoints are rejected by `models/convert.py`)."""
     return ResNetClassifier(
-        arch=arch, num_classes=num_classes, dtype=jnp.dtype(dtype), stem=stem
+        arch=arch, num_classes=num_classes, dtype=jnp.dtype(dtype), stem=stem,
+        norm=norm,
     )
 
 
@@ -47,7 +52,8 @@ def make_pretrain_step(model: ResNetClassifier, tx: optax.GradientTransformation
                 logits, labels
             ).mean()
             acc = (jnp.argmax(logits, -1) == labels).mean()
-            return ce, (acc, mut["batch_stats"])
+            # norm="group" classifiers carry no batch_stats collection
+            return ce, (acc, mut.get("batch_stats", {}))
 
         (loss, (acc, stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             variables["params"]
@@ -98,11 +104,27 @@ def pretrain(
 
 def graft_classifier(detector_variables: Dict[str, Any], classifier_variables: Dict[str, Any]):
     """Copy a pretrained classifier's trunk/tail into FasterRCNN variables
-    (single-scale layout: trunk -> `trunk`, tail -> `head.tail`)."""
+    (single-scale layout: trunk -> `trunk`, tail -> `head.tail`).
+
+    The two sides must use the same normalization: BN and GN backbones
+    share param names/shapes at every norm site (scale/bias), so a
+    mismatched graft would succeed silently and train badly — the same
+    hazard `models/convert.py` guards against for torch checkpoints."""
     out_p = dict(detector_variables["params"])
     out_s = dict(detector_variables.get("batch_stats", {}))
     cp = classifier_variables["params"]
     cs = classifier_variables.get("batch_stats", {})
+    det_bn = bool(detector_variables.get("batch_stats", {}).get("trunk"))
+    cls_bn = bool(cs.get("trunk"))
+    if det_bn != cls_bn:
+        raise ValueError(
+            "normalization mismatch: the "
+            f"{'BatchNorm' if cls_bn else 'GroupNorm'} classifier "
+            "checkpoint cannot graft onto a "
+            f"{'BatchNorm' if det_bn else 'GroupNorm'} detector — "
+            "pretrain with make_classifier(norm=...) matching the "
+            "detector's ModelConfig.norm"
+        )
     out_p["trunk"] = cp["trunk"]
     out_s["trunk"] = cs.get("trunk", {})
     head = dict(out_p["head"])

@@ -86,6 +86,47 @@ class TestParamTree:
                 variables, str(tmp_path / "never_read.pth")
             )
 
+    def test_gn_pretrain_grafts_and_bn_mismatch_raises(self):
+        """The GN pretraining escape hatch must actually work end-to-end
+        (make_classifier(norm='group') -> graft_classifier), and a
+        BN-pretrained classifier must be rejected by the norm-mismatch
+        guard instead of silently merging onto the GN detector."""
+        from replication_faster_rcnn_tpu.train import (
+            create_train_state,
+            make_optimizer,
+        )
+        from replication_faster_rcnn_tpu.train.pretrain import (
+            graft_classifier,
+            make_classifier,
+        )
+
+        cfg = _gn_config()
+        tx, _ = make_optimizer(cfg, 10)
+        _, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
+        det_vars = {
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+        }
+
+        gn_cls = make_classifier(norm="group")
+        x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+        gn_vars = gn_cls.init({"params": jax.random.PRNGKey(1)}, x, train=False)
+        gn_vars = {
+            "params": gn_vars["params"],
+            "batch_stats": gn_vars.get("batch_stats", {}),
+        }
+        assert not jax.tree_util.tree_leaves(gn_vars["batch_stats"])
+        grafted = graft_classifier(det_vars, gn_vars)
+        # same structure class as before: the train state stays valid
+        assert sorted(grafted["params"]["trunk"]["bn1"].keys()) == [
+            "bias", "scale",
+        ]
+
+        bn_cls = make_classifier(norm="batch")
+        bn_vars = bn_cls.init({"params": jax.random.PRNGKey(2)}, x, train=False)
+        with pytest.raises(ValueError, match="normalization mismatch"):
+            graft_classifier(det_vars, dict(bn_vars))
+
     def test_spmd_builder_skips_bn_axis_for_group(self):
         """make_shard_map_train_step must not bind a sync-BN axis on a GN
         model (the config layer rejects the combination)."""
