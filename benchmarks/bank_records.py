@@ -1,0 +1,163 @@
+"""Bank fresh on-chip bench results into ``bench_v5e_round2.json``.
+
+``bench.py``'s CPU-fallback line surfaces ``last_recorded_tpu`` from
+``benchmarks/bench_v5e_round2.json`` ONLY — but live captures land in
+``benchmarks/mfu_experiments.json`` (the queue runner) and
+``benchmarks/bench_r05_{early,late}.json`` (the relay watcher's banked
+bench lines). If the relay revives mid-session and dies again before the
+driver's end-of-round bench, those fresh numbers would be invisible to
+the line of record. This script normalizes and appends them (deduped on
+the ``measured`` stamp); the watcher runs it after every capture phase,
+and it is safe to run any number of times.
+
+    python benchmarks/bank_records.py [--dry-run]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "benchmarks")
+CANON = os.path.join(BENCH, "bench_v5e_round2.json")
+
+
+def _config_string(exp: dict) -> str:
+    """First word must be the preset name (bench.py's same-config match
+    keys on it); the rest is a human-readable flag summary."""
+    args = exp.get("args", [])
+    preset = "voc_resnet18"
+    if "--config" in args:
+        preset = args[args.index("--config") + 1]
+    extras = " ".join(
+        a for a in args if a != "--config" and a != preset
+    )
+    env = exp.get("env", {})
+    envs = " ".join(f"{k}={v}" for k, v in env.items() if k != "BENCH_WATCHDOG_S")
+    parts = [preset, "600x600", extras, envs,
+             f"(queue experiment {exp['name']})", "one v5e chip"]
+    return " ".join(p for p in parts if p)
+
+
+def _bench_line_records(path: str, label: str):
+    """A watcher-banked raw bench.py JSON line -> record, unless it was a
+    CPU fallback."""
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path) as f:
+            line = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return []
+    if line.get("fallback_backend") or not line.get("value"):
+        return []
+    # lead with an ISO UTC stamp (the banked file's mtime = capture time):
+    # benchmark.py picks the most recent record by lexicographic compare
+    # of this field, so a non-timestamp prefix would win forever
+    stamp = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime(os.path.getmtime(path))
+    )
+    measured = (
+        f"{stamp} banked from {os.path.basename(path)} ({label}, round 5)"
+    )
+    rec = {
+        "value": line["value"],
+        "vs_baseline": line.get("vs_baseline"),
+        "config": "voc_resnet18 600x600 batch 16, bench.py defaults, one v5e chip",
+        "metric": line.get("metric"),
+        "measured": measured,
+    }
+    for k in ("flops_per_step", "mfu"):
+        if line.get(k) is not None:
+            rec[k] = line[k]
+    if isinstance(line.get("breakdown"), dict):
+        rec["breakdown_ms"] = line["breakdown"]
+    return [rec]
+
+
+def collect_new(since: str):
+    out = []
+    mfu_path = os.path.join(BENCH, "mfu_experiments.json")
+    if os.path.exists(mfu_path):
+        with open(mfu_path) as f:
+            for exp in json.load(f).get("experiments", []):
+                res = exp.get("result")
+                when = exp.get("recorded_utc")
+                if not (isinstance(res, dict) and when):
+                    continue
+                if when < since:  # ISO strings compare chronologically
+                    continue
+                # bench-format results only (fed-trainer/grad legs have
+                # their own evidence files and aren't throughput records)
+                if not (res.get("metric") and res.get("value")):
+                    continue
+                if res.get("fallback_backend"):
+                    continue
+                rec = {
+                    "value": res["value"],
+                    "vs_baseline": res.get("vs_baseline"),
+                    "config": _config_string(exp),
+                    "metric": res["metric"],
+                    "measured": f"{when} by mfu_experiments queue on the "
+                                f"real chip ({exp['name']})",
+                }
+                for k in ("flops_per_step", "mfu"):
+                    if res.get(k) is not None:
+                        rec[k] = res[k]
+                if isinstance(res.get("breakdown"), dict):
+                    rec["breakdown_ms"] = res["breakdown"]
+                out.append(rec)
+    out += _bench_line_records(
+        os.path.join(BENCH, "bench_r05_early.json"), "bench-of-record early"
+    )
+    out += _bench_line_records(
+        os.path.join(BENCH, "bench_r05_late.json"), "bench-late"
+    )
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dry-run", action="store_true")
+    ap.add_argument(
+        "--since", default="2026-08-01T21:00:00Z",
+        help="only bank queue records stamped at/after this UTC instant "
+        "(default: the round-5 session start — earlier measurements were "
+        "curated by hand, often under a differently formatted stamp)")
+    args = ap.parse_args()
+
+    with open(CANON) as f:
+        canon = json.load(f)
+    # dedup on the measured stamp: a genuine re-measurement that lands on
+    # an identical rounded value (queue exp 13 exists to re-record) must
+    # still bank; the --since cutoff keeps hand-curated history out
+    have = {r.get("measured") for r in canon.get("records", [])}
+    fresh = [
+        r for r in collect_new(args.since) if r["measured"] not in have
+    ]
+    if not fresh:
+        print("nothing new to bank")
+        return
+    for r in fresh:
+        print(f"banking: {r['metric']} = {r['value']} ({r['measured']})")
+    if args.dry_run:
+        return
+    canon["records"].extend(fresh)
+    canon.setdefault("notes", [])
+    if isinstance(canon["notes"], list):
+        canon["notes"].append(
+            f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}: "
+            f"bank_records.py appended {len(fresh)} round-5 record(s)"
+        )
+    tmp = CANON + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(canon, f, indent=1)
+    os.replace(tmp, CANON)  # atomic: a kill mid-write can't truncate CANON
+    print(f"appended {len(fresh)} record(s) to {CANON}")
+
+
+if __name__ == "__main__":
+    main()
