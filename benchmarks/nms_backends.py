@@ -5,12 +5,13 @@ Run on a healthy TPU (check the relay first — see
 
     python benchmarks/nms_backends.py [--batch 8] [--n 12000] [--out 600]
 
-Prints ms/call for the XLA selection loop (`ops/nms.py`), the tiled exact
-algorithm (`ops/nms_tiled.py`), and — on TPU only, opt-in via
---pallas because its in-train-step compile has wedged this image's remote
-compile service before — the Pallas kernel, plus a selection-parity check.
+Prints ms/call for the XLA selection loop (`ops/nms.py`) and the tiled
+exact algorithm (`ops/nms_tiled.py`), plus a selection-parity check.
 CPU reference numbers (1 core, 12k->600, batch 1): loop 88.6ms,
-tiled 8.2ms (identical selections).
+tiled 8.2ms (identical selections). (A third backend — the Pallas
+kernel, standalone 3.2x the loop on v5e — was removed in round 5 after
+its in-train-step compile wedged the remote service twice and its
+validation slot never got a live chip; git history has it.)
 """
 
 from __future__ import annotations
@@ -52,8 +53,6 @@ def main(argv=None) -> int:
     ap.add_argument("--n", type=int, default=12000)
     ap.add_argument("--out", type=int, default=600)
     ap.add_argument("--thresh", type=float, default=0.7)
-    ap.add_argument("--pallas", action="store_true",
-                    help="also time the Pallas kernel (TPU only; see module docstring)")
     args = ap.parse_args(argv)
 
     from replication_faster_rcnn_tpu.ops.nms import nms_fixed
@@ -66,13 +65,6 @@ def main(argv=None) -> int:
             jax.vmap(lambda b, s: nms_fixed_tiled(b, s, args.thresh, args.out))
         ),
     }
-    if args.pallas:
-        from replication_faster_rcnn_tpu.ops.nms_pallas import nms_fixed_pallas
-
-        backends["pallas"] = jax.jit(
-            jax.vmap(lambda b, s: nms_fixed_pallas(b, s, args.thresh, args.out))
-        )
-
     results = {}
     for name, fn in backends.items():
         ms, idx, valid = _time(fn, boxes, scores)

@@ -117,8 +117,8 @@ def select_proposals(
     top_boxes = props[top_idx]
 
     # tiled exact NMS by default on every backend; FRCNN_NMS=loop (serial
-    # selection loop) or =pallas (TPU kernel) opt in — see nms_fixed_auto
-    from replication_faster_rcnn_tpu.ops.nms_pallas import nms_fixed_auto
+    # selection loop) opts in — see nms_fixed_auto
+    from replication_faster_rcnn_tpu.ops.nms import nms_fixed_auto
 
     idx, valid = nms_fixed_auto(
         top_boxes,
