@@ -128,6 +128,44 @@ class TestMiniTreeLoads:
 
 class TestCliEndToEnd:
     @pytest.mark.slow
+    def test_full_epoch_saves_then_eval_restores(self, voc_root, tmp_path,
+                                                 capsys):
+        """The real-VOC recipe end to end INCLUDING the checkpoint hop:
+        unbounded `cli train --epochs 1` (runs Trainer.train + save) then
+        `cli eval` restoring that checkpoint — the exact command pair
+        PARITY.md §"what remains" prescribes for a real VOC07 tree."""
+        workdir = str(tmp_path / "ckpts")
+        rc = cli.main(
+            [
+                "train",
+                "--config", "voc_resnet18",
+                "--data-root", voc_root,
+                "--image-size", "64",
+                "--batch-size", "2",
+                "--epochs", "1",
+                "--log-every", "1",
+                "--workdir", workdir,
+            ]
+        )
+        assert rc == 0
+        import glob
+
+        assert glob.glob(os.path.join(workdir, "*")), "no checkpoint saved"
+        rc = cli.main(
+            [
+                "eval",
+                "--config", "voc_resnet18",
+                "--data-root", voc_root,
+                "--image-size", "64",
+                "--batch-size", "2",
+                "--split", "val",
+                "--workdir", workdir,
+            ]
+        )
+        assert rc == 0
+        assert "mAP@0.5" in capsys.readouterr().out
+
+    @pytest.mark.slow
     def test_train_then_eval_on_tree(self, voc_root, tmp_path, capsys):
         """The real-VOC recipe's exact CLI surface: bounded-step train then
         eval, both against --data-root pointing at an on-disk VOC tree."""
