@@ -268,6 +268,20 @@ EXPERIMENTS = [
         "args": ["--norm", "group", "--batch-size", "16"],
         "why": "GroupNorm backbone: the BN-free point on the BN-density axis",
     },
+    {
+        # index 18 — the device-resident feed (round 5,
+        # data/device_cache.py): same fed loop as experiments 8/9 but the
+        # dataset lives in HBM and the host ships only indices per step.
+        # The triple (fed, ram-cached, device-cached) in one record
+        # attributes the fed loop's gap to the host->device transfer.
+        "name": "loader_trainer_600_devcache",
+        "env": {"LOADER_BENCH_U8": "1", "LOADER_BENCH_DEVICE_CACHE": "1"},
+        "cmd": [sys.executable, "benchmarks/loader_throughput.py"],
+        "success_key": "trainer_loop_device_cache",
+        "require_backend": "tpu",
+        "why": "device-cache fed trainer at 600x600 vs the 11 img/s host feed",
+        "deadline": 2400,
+    },
 ]
 
 

@@ -57,6 +57,8 @@ def _build_config(args):
         data_kw["augment_scale_device"] = True
     if getattr(args, "cache_ram", False):
         data_kw["loader_cache_ram"] = True
+    if getattr(args, "cache_device", False):
+        data_kw["cache_device"] = True
     if getattr(args, "device_normalize", False):
         data_kw["device_normalize"] = True
     if data_kw:
@@ -169,6 +171,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="cache decoded samples in host RAM (epoch 1 pays "
                         "the decode, later epochs are memcpy; bounded by "
                         "FRCNN_CACHE_MAX_BYTES, default 64 GiB)")
+    p.add_argument("--cache-device", action="store_true",
+                   help="device-resident dataset: upload all samples to "
+                        "HBM once, ship only batch indices per step and "
+                        "gather/augment inside the jitted step (pair with "
+                        "--device-normalize; bounded by "
+                        "FRCNN_DEVICE_CACHE_MAX_BYTES, default 8 GiB)")
     p.add_argument("--augment-hflip", action="store_true",
                    help="50%% horizontal-flip train augmentation "
                         "(deterministic per seed/epoch/index; the VOC "
@@ -207,10 +215,12 @@ def cmd_train(args) -> int:
     from replication_faster_rcnn_tpu.utils.profiling import trace
 
     if args.steps:
-        # bounded-step mode (smoke/CI): iterate the loader cyclically
+        # bounded-step mode (smoke/CI): iterate the feed cyclically
+        # (the index sampler in --cache-device mode, the loader otherwise)
         import itertools
 
-        it = itertools.cycle(iter(trainer.loader))
+        feed = trainer.sampler if trainer.device_cache is not None else trainer.loader
+        it = itertools.cycle(iter(feed))
         with trace(args.profile):
             for i in range(args.steps):
                 metrics = trainer.train_one_batch(next(it))
@@ -283,6 +293,7 @@ def cmd_bench(args) -> int:
         args.spatial or args.remat or args.shard_opt or args.augment_hflip
         or args.frozen_bn or args.augment_scale_device
         or args.no_augment_hflip or args.cache_ram or args.device_normalize
+        or getattr(args, "cache_device", False)
         or args.config != "voc_resnet18"
     )
     bench_main(_build_config(args) if flagged else None, profile_dir=args.profile)

@@ -179,6 +179,36 @@ def _splitmix(z: int) -> int:
     return z ^ (z >> 31)
 
 
+def draw_decisions(seed: int, epoch: int, idx: int, scale_range=None):
+    """The per-(seed, epoch, idx) augmentation draws: (flip_bit, scale,
+    off_y, off_x); the last three are None without a scale range.
+
+    Shared by :class:`AugmentedView` (host pipeline) and the
+    device-resident cache sampler (`data/device_cache.py`) so both feed
+    paths make IDENTICAL decisions for the same sample — the counter-mix
+    is order-free, so any worker/process/backend agrees."""
+    z = _splitmix(
+        (
+            seed * 0x9E3779B97F4A7C15
+            + epoch * 0xBF58476D1CE4E5B9
+            + idx * 0x94D049BB133111EB
+        )
+        & 0xFFFFFFFFFFFFFFFF
+    )
+    flip = bool(z & 1)
+    if scale_range is None:
+        return flip, None, None, None
+    lo, hi = scale_range
+    z2 = _splitmix(z + 0x9E3779B97F4A7C15)
+    z3 = _splitmix(z2 + 0x9E3779B97F4A7C15)
+    z4 = _splitmix(z3 + 0x9E3779B97F4A7C15)
+    u = (z2 >> 11) / float(1 << 53)
+    scale = lo + (hi - lo) * u
+    off_y = (z3 >> 11) / float(1 << 53)
+    off_x = (z4 >> 11) / float(1 << 53)
+    return flip, scale, off_y, off_x
+
+
 class AugmentedView:
     """Map-style view applying per-sample train augmentations: a 50%
     horizontal flip and/or a scale jitter drawn from ``scale_range``.
@@ -224,13 +254,8 @@ class AugmentedView:
         # splitmix64 finalizer chain on the (seed, epoch, idx) mix; one
         # output bit is the flip coin, further outputs drive the jitter —
         # no per-sample Mersenne Twister construction on the ingest path
-        z = _splitmix(
-            (
-                self.seed * 0x9E3779B97F4A7C15
-                + self.epoch * 0xBF58476D1CE4E5B9
-                + idx * 0x94D049BB133111EB
-            )
-            & 0xFFFFFFFFFFFFFFFF
+        flip, scale, off_y, off_x = draw_decisions(
+            self.seed, self.epoch, idx, self.scale_range
         )
         # Order is mode-dependent ON PURPOSE. Host mode keeps the
         # original jitter-then-flip so a fixed (seed, epoch, idx) still
@@ -240,17 +265,9 @@ class AugmentedView:
         # host view), so the on-chip resample always acts on the flipped
         # frame. The two orders are distributionally identical (the
         # placement offsets are uniform and mirror-symmetric).
-        if self.scale_on_device and self.hflip and (z & 1):
+        if self.scale_on_device and self.hflip and flip:
             sample = hflip_sample(sample)
         if self.scale_range is not None:
-            lo, hi = self.scale_range
-            z2 = _splitmix(z + 0x9E3779B97F4A7C15)
-            z3 = _splitmix(z2 + 0x9E3779B97F4A7C15)
-            z4 = _splitmix(z3 + 0x9E3779B97F4A7C15)
-            u = (z2 >> 11) / float(1 << 53)
-            scale = lo + (hi - lo) * u
-            off_y = (z3 >> 11) / float(1 << 53)
-            off_x = (z4 >> 11) / float(1 << 53)
             # "did this draw move any pixels?" is decided by the ROUNDED
             # integer geometry, not a deadband on the continuous scale: a
             # scale of 1.0009 at 600 px rounds to a 601-px canvas and IS a
@@ -266,6 +283,6 @@ class AugmentedView:
                 sample = out
             elif jittered:
                 sample = scale_jitter_sample(sample, scale, off_y, off_x)
-        if not self.scale_on_device and self.hflip and (z & 1):
+        if not self.scale_on_device and self.hflip and flip:
             sample = hflip_sample(sample)
         return sample

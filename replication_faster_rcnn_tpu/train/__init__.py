@@ -3,6 +3,7 @@ from replication_faster_rcnn_tpu.train.train_step import (  # noqa: F401
     TrainState,
     compute_losses,
     create_train_state,
+    make_cached_train_step,
     make_optimizer,
     make_train_step,
 )

@@ -227,6 +227,35 @@ def make_train_step(
     return train_step
 
 
+def make_cached_train_step(
+    model: FasterRCNN,
+    config: FasterRCNNConfig,
+    tx: optax.GradientTransformation,
+):
+    """The device-cache variant: (state, cache, sel) -> (state, metrics).
+
+    ``cache`` is a :class:`data.device_cache.DeviceCache`'s array dict
+    (device-resident, replicated); ``sel`` the per-step batch selection
+    (indices + augmentation decisions, ~bytes). Batch materialization
+    (`data/device_cache.py::materialize_batch`) runs inside the same
+    compiled program as the step, so the host->device traffic per step is
+    the selection alone — the answer to the measured feed-bound trainer
+    (11 vs 215 img/s, `benchmarks/loader_throughput.json`).
+
+    Jit with donate_argnums=(0,) ONLY — the cache must NOT be donated.
+    """
+    base = make_train_step(model, config, tx)
+
+    def cached_step(state, cache: Dict[str, Array], sel: Dict[str, Array]):
+        from replication_faster_rcnn_tpu.data.device_cache import (
+            materialize_batch,
+        )
+
+        return base(state, materialize_batch(cache, sel))
+
+    return cached_step
+
+
 def make_optimizer(config: FasterRCNNConfig, steps_per_epoch: int):
     """Adam + per-epoch cosine annealing (reference `train.py:139-140`:
     Adam(lr, weight_decay=5e-6) + CosineAnnealingLR(T_max=n_epoch)).

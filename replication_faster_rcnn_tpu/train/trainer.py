@@ -102,20 +102,52 @@ class Trainer:
         self.dataset = dataset if dataset is not None else make_dataset(
             config.data, "train"
         )
-        self.loader = DataLoader(
-            self.dataset,
-            batch_size=config.train.batch_size,
-            shuffle=True,
-            seed=config.train.seed,
-            prefetch=config.data.loader_prefetch,
-            num_workers=config.data.loader_workers,
-            worker_mode=config.data.loader_mode,
-            augment_hflip=config.data.augment_hflip,
-            augment_scale=config.data.augment_scale,
-            augment_scale_device=config.data.augment_scale_device,
-            cache_ram=config.data.loader_cache_ram,
-        )
-        steps_per_epoch = max(len(self.loader), 1)
+        self.device_cache = None
+        self.sampler = None
+        if config.data.cache_device:
+            # device-resident feed: dataset lives in HBM, the step gathers
+            # and augments on device, the host ships only per-step indices
+            # (data/device_cache.py — the route past a transfer-bound
+            # loader). The jitter resample necessarily runs on device in
+            # this mode, the path already proven at training quality
+            # (0.591 vs host 0.592 val mAP, PARITY.md).
+            if config.train.backend == "spmd":
+                raise ValueError(
+                    "cache_device currently pairs with the jit auto-"
+                    "partitioned backend only (train.backend='auto'); the "
+                    "explicit shard_map backend feeds host batches"
+                )
+            from replication_faster_rcnn_tpu.data.device_cache import (
+                CachedSampler,
+                DeviceCache,
+            )
+
+            self.device_cache = DeviceCache(self.dataset, mesh=self.mesh)
+            self.sampler = CachedSampler(
+                len(self.dataset),
+                self.device_cache.image_hw,
+                batch_size=config.train.batch_size,
+                seed=config.train.seed,
+                hflip=config.data.augment_hflip,
+                scale_range=config.data.augment_scale,
+            )
+            self.loader = None
+            steps_per_epoch = max(len(self.sampler), 1)
+        else:
+            self.loader = DataLoader(
+                self.dataset,
+                batch_size=config.train.batch_size,
+                shuffle=True,
+                seed=config.train.seed,
+                prefetch=config.data.loader_prefetch,
+                num_workers=config.data.loader_workers,
+                worker_mode=config.data.loader_mode,
+                augment_hflip=config.data.augment_hflip,
+                augment_scale=config.data.augment_scale,
+                augment_scale_device=config.data.augment_scale_device,
+                cache_ram=config.data.loader_cache_ram,
+            )
+            steps_per_epoch = max(len(self.loader), 1)
         self.tx, self.schedule = make_optimizer(config, steps_per_epoch)
         self.model, state = create_train_state(
             config, jax.random.PRNGKey(config.train.seed), self.tx
@@ -139,6 +171,18 @@ class Trainer:
             # parameter tree is identical, so eval/checkpoints are unchanged
             self.jitted_step, _ = make_shard_map_train_step(
                 config, self.tx, self.mesh
+            )
+        elif config.data.cache_device:
+            from replication_faster_rcnn_tpu.train.train_step import (
+                make_cached_train_step,
+            )
+
+            # (state, cache, sel) step; the cache argument is the same
+            # device-resident buffers every call — never donated
+            self.jitted_step = jax.jit(
+                make_cached_train_step(self.model, config, self.tx),
+                donate_argnums=(0,),
+                out_shardings=(self._state_shardings, None),
             )
         else:
             step_fn = make_train_step(self.model, config, self.tx)
@@ -246,6 +290,14 @@ class Trainer:
     # ---------------------------------------------------------------- train
 
     def train_one_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        if self.device_cache is not None:
+            # `batch` is a selection dict (idx/flip/jitter — bytes, not
+            # megabytes); the images never leave the device
+            sel = shard_batch(batch, self.mesh, self.config.mesh)
+            self.state, metrics = self.jitted_step(
+                self.state, self.device_cache.arrays, sel
+            )
+            return metrics
         device_batch = shard_batch(batch, self.mesh, self.config.mesh)
         self.state, metrics = self.jitted_step(self.state, device_batch)
         return metrics
@@ -277,19 +329,22 @@ class Trainer:
         """
         cfg = self.config.train
         start_step = self.restore() if resume else 0
-        steps_per_epoch = max(len(self.loader), 1)
+        steps_per_epoch = max(
+            len(self.sampler if self.device_cache is not None else self.loader), 1
+        )
         start_epoch = start_step // steps_per_epoch
         step = start_step  # host-side mirror: no device sync to read it
 
         last: Dict[str, float] = {}
         eval_result: Dict[str, float] = {}
+        feed = self.sampler if self.device_cache is not None else self.loader
         for epoch in range(start_epoch, cfg.n_epoch):
-            self.loader.set_epoch(epoch)
+            feed.set_epoch(epoch)
             t_epoch = time.time()
             n_images = 0
-            for batch in self.loader:
+            for batch in feed:
                 metrics = self.train_one_batch(batch)
-                n_images += batch["image"].shape[0]
+                n_images += batch["idx" if "idx" in batch else "image"].shape[0]
                 step += 1
                 if step % log_every == 0:
                     # fail fast on NaN/inf instead of training on garbage
