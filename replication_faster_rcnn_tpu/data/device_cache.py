@@ -94,6 +94,10 @@ class DeviceCache:
         self.nbytes = nbytes
         self.n = len(dataset)
         self.image_hw = tuple(stacked["image"].shape[1:3])
+        # host-side copy of the small per-sample arrays (boxes, labels,
+        # mask, difficult, ...): eval scoring reads ground truth on the
+        # host, and keeping these spares a second full decode pass
+        self.host_meta = {k: v for k, v in stacked.items() if k != "image"}
         if mesh is not None:
             from replication_faster_rcnn_tpu.parallel.mesh import replicated
 

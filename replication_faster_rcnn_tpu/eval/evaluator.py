@@ -62,6 +62,13 @@ class Evaluator:
 
         self._jit_infer = jax.jit(infer)
 
+        def infer_cached(variables: Any, image_cache, idx):
+            # device-resident val images (data/device_cache.py): gather
+            # inside the compiled program; the host ships indices only
+            return infer(variables, jnp.take(image_cache, idx, axis=0))
+
+        self._jit_infer_cached = jax.jit(infer_cached)
+
     def _eval_sharding(self, batch_size: int):
         """(image sharding, replicated sharding) for a data-parallel eval
         mesh, or (None, None) when only one device would be used."""
