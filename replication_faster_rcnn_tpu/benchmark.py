@@ -19,7 +19,9 @@ comes from XLA's own HloCostAnalysis on the *lowered* (pre-compile) module
 — a host-side analysis that never touches the device, so it is safe even
 through the fragile remote-TPU tunnel; it undercounts post-fusion FLOPs by
 a few percent, which makes the reported MFU slightly conservative. Peak is
-per-chip bf16 (v5e: 197 TFLOP/s) x mesh size.
+per-chip bf16 (v5e: 197 TFLOP/s) x mesh size on TPU, or a measured-matmul
+host peak on CPU (telemetry/mfu.py); "mfu_basis" labels which regime a
+number came from so a CPU-fallback MFU can't be mistaken for chip MFU.
 
 Stage breakdown (SURVEY.md §5 tracing plan): wall-time of jitted prefixes
 of the step — trunk, +RPN heads, +proposal NMS, full forward+loss — whose
@@ -637,10 +639,14 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
         watchdog.cancel()
     flops_per_step = _step_flops(cfg, batch_size)
     mfu = None
+    mfu_basis = None
     if flops_per_step:
-        peak = _peak_flops_per_sec(n_dev)
-        if peak:
-            mfu = (flops_per_step * images_per_sec / batch_size) / peak
+        from replication_faster_rcnn_tpu.telemetry.mfu import compute_mfu
+
+        peak, mfu_basis = _peak_flops_per_sec(n_dev)
+        mfu = compute_mfu(flops_per_step, images_per_sec / batch_size, peak)
+        if mfu is None:
+            mfu_basis = None
 
     out = {
         "metric": _METRIC,
@@ -649,6 +655,7 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
         "vs_baseline": round(vs_baseline, 3) if np.isfinite(vs_baseline) else None,
         "flops_per_step": flops_per_step,
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "mfu_basis": mfu_basis,
     }
     if trace_status is not None:
         out["trace"] = trace_status
@@ -917,30 +924,14 @@ def _flops_child():
 
 
 def _peak_flops_per_sec(n_dev: int):
-    """Aggregate peak bf16 FLOP/s of the mesh, or None off-TPU (an MFU
-    against a CPU's peak would be meaningless for a TPU framework) or on an
-    unrecognized TPU generation (a silently-wrong peak would distort MFU).
+    """(aggregate peak FLOP/s, basis label) for the current backend —
+    thin wrapper over `telemetry.mfu.peak_flops_per_sec`, which owns the
+    TPU datasheet table (device_kind-keyed, PALLAS_AXON_TPU_GEN fallback
+    for opaque plugin backends) and the measured-matmul CPU peak that
+    keeps MFU non-null on the CPU-fallback path."""
+    from replication_faster_rcnn_tpu.telemetry.mfu import peak_flops_per_sec
 
-    The chip generation comes from the device's own ``device_kind``; the
-    PALLAS_AXON_TPU_GEN env var is only a fallback for plugin backends
-    whose device_kind string is opaque."""
-    dev = jax.devices()[0]
-    if dev.platform != "tpu":
-        return None
-    kind = getattr(dev, "device_kind", "").lower()
-    if not any(g in kind for g in ("v4", "v5", "v6")):
-        kind = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    if "v5 lite" in kind or "v5e" in kind or "v5lite" in kind:
-        peak = 197e12
-    elif "v5p" in kind or "v5" in kind:
-        peak = 459e12
-    elif "v6 lite" in kind or "v6e" in kind:
-        peak = 918e12
-    elif "v4" in kind:
-        peak = 275e12
-    else:
-        return None
-    return peak * n_dev
+    return peak_flops_per_sec(n_dev)
 
 
 def _stage_breakdown(model, cfg, state, device_batch, step_ms: float, tx=None):

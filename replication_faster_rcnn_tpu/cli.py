@@ -4,9 +4,11 @@
 ``--device=tpu`` path).
 
 Subcommands:
-  train  — run the jitted SPMD trainer
-  eval   — run inference + VOC mAP over a dataset split
-  bench  — train-step throughput (same measurement as bench.py)
+  train      — run the jitted SPMD trainer (--telemetry enables the
+               span-trace/health/watchdog observability layer)
+  eval       — run inference + VOC mAP over a dataset split
+  bench      — train-step throughput (same measurement as bench.py)
+  telemetry  — summarize a --telemetry run dir (phase times + health)
 
 ``--config`` selects one of the five BASELINE presets (config.CONFIGS);
 individual flags override preset fields.
@@ -209,7 +211,12 @@ def cmd_train(args) -> int:
     from replication_faster_rcnn_tpu.train import Trainer
 
     cfg = _build_config(args)
-    trainer = Trainer(cfg, workdir=args.workdir)
+    trainer = Trainer(
+        cfg,
+        workdir=args.workdir,
+        telemetry_dir=args.telemetry,
+        stall_timeout_s=args.stall_timeout,
+    )
     if args.pretrained_backbone:
         trainer.load_pretrained_backbone(args.pretrained_backbone)
     from replication_faster_rcnn_tpu.utils.profiling import trace
@@ -221,15 +228,26 @@ def cmd_train(args) -> int:
 
         feed = trainer.sampler if trainer.device_cache is not None else trainer.loader
         it = itertools.cycle(iter(feed))
-        with trace(args.profile):
-            for i in range(args.steps):
-                metrics = trainer.train_one_batch(next(it))
-                if i % max(1, args.log_every) == 0:
-                    import jax
+        if trainer.watchdog is not None:
+            trainer.watchdog.start()
+        try:
+            with trace(args.profile):
+                for i in range(args.steps):
+                    with trainer.tracer.span("data/fetch", cat="data"):
+                        batch = next(it)
+                    metrics = trainer.train_one_batch(batch)
+                    if trainer.watchdog is not None:
+                        trainer.watchdog.beat(step=i + 1, phase="train")
+                    if i % max(1, args.log_every) == 0:
+                        import jax
 
-                    from replication_faster_rcnn_tpu.utils.debug import finite_or_raise
+                        from replication_faster_rcnn_tpu.utils.debug import finite_or_raise
 
-                    trainer.logger.log(i, finite_or_raise(jax.device_get(metrics), i))
+                        with trainer.tracer.span("step/sync", cat="sync"):
+                            host_metrics = jax.device_get(metrics)
+                        trainer.logger.log(i, finite_or_raise(host_metrics, i))
+        finally:
+            trainer.flush_telemetry()
         return 0
     with trace(args.profile):
         trainer.train(resume=args.resume, log_every=args.log_every)
@@ -362,6 +380,26 @@ def cmd_trace_summary(args) -> int:
     return 0
 
 
+def cmd_telemetry(args) -> int:
+    """Phase-time + train-health report from a --telemetry run dir. Pure
+    host-side parsing (telemetry/report.py) — no jax import, safe with a
+    dead TPU tunnel, runnable on a laptop holding only the artifacts."""
+    import json
+
+    from replication_faster_rcnn_tpu.telemetry.report import (
+        format_report,
+        summarize_run,
+    )
+
+    summary = summarize_run(args.run_dir)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=2)
+        print(f"summary written to {args.json}")
+    print(format_report(summary))
+    return 0 if summary["artifacts"] else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(prog="replication_faster_rcnn_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -379,6 +417,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                          help="run val mAP every N epochs (0 = never)")
     p_train.add_argument("--profile", default=None, metavar="DIR",
                          help="jax.profiler trace of the training loop")
+    p_train.add_argument("--telemetry", default=None, metavar="DIR",
+                         help="write run telemetry here: trace.json "
+                              "(Chrome-trace spans), metrics.jsonl (step "
+                              "metrics + train-health scalars), "
+                              "watchdog.jsonl + progress.json (stall "
+                              "watchdog); summarize with the 'telemetry' "
+                              "subcommand")
+    p_train.add_argument("--stall-timeout", type=float, default=300.0,
+                         help="seconds without step progress before the "
+                              "telemetry watchdog records a stall snapshot "
+                              "(needs --telemetry)")
     p_train.add_argument("--debug-nans", action="store_true",
                          help="enable jax_debug_nans (every jit output "
                               "checked; errors pinpoint the emitting op)")
@@ -443,6 +492,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_trace.add_argument("--json", default=None, metavar="PATH",
                          help="also write the table as JSON")
     p_trace.set_defaults(fn=cmd_trace_summary)
+
+    p_tel = sub.add_parser(
+        "telemetry",
+        help="phase-time + train-health report from a --telemetry run dir",
+    )
+    p_tel.add_argument("run_dir")
+    p_tel.add_argument("--json", default=None, metavar="PATH",
+                       help="also write the summary as JSON")
+    p_tel.set_defaults(fn=cmd_telemetry)
 
     args = parser.parse_args(argv)
     return args.fn(args)

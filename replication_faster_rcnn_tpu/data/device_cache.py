@@ -46,6 +46,7 @@ from replication_faster_rcnn_tpu.data.augment import (
     jitter_geometry,
 )
 from replication_faster_rcnn_tpu.data.loader import collate
+from replication_faster_rcnn_tpu.telemetry import spans as tspans
 
 # Above this the constructor refuses and points at --cache-ram / the
 # host loader instead. v5e-1 has 16 GB HBM; model+optimizer+activations
@@ -58,9 +59,22 @@ class DeviceCache:
 
     ``mesh`` (optional) replicates the arrays over a `jax.sharding.Mesh`;
     without it the arrays land on the default device.
+
+    ``keep_host_meta`` additionally retains a host-side copy of the small
+    non-image arrays (boxes, labels, mask, difficult, ...) as
+    ``self.host_meta``. Training never reads ground truth on the host, so
+    the trainer leaves this off; the cached-eval path turns it on because
+    mAP scoring consumes GT host-side and a second full decode pass to
+    re-derive it would defeat the cache.
     """
 
-    def __init__(self, dataset, mesh=None, max_bytes: Optional[int] = None):
+    def __init__(
+        self,
+        dataset,
+        mesh=None,
+        max_bytes: Optional[int] = None,
+        keep_host_meta: bool = False,
+    ):
         if max_bytes is None:
             max_bytes = int(
                 os.environ.get("FRCNN_DEVICE_CACHE_MAX_BYTES", DEFAULT_MAX_BYTES)
@@ -85,27 +99,32 @@ class DeviceCache:
         est = sum(np.asarray(v).nbytes for v in first.values()) * len(dataset)
         if est > max_bytes:
             raise _over_cap(est)
-        stacked = collate([dataset[i] for i in range(len(dataset))])
-        # jitter geometry attaches per-step via sel, never via the cache
-        stacked.pop("jitter", None)
-        nbytes = sum(v.nbytes for v in stacked.values())
-        if nbytes > max_bytes:  # exact check (paranoia; shapes are fixed)
-            raise _over_cap(nbytes)
-        self.nbytes = nbytes
-        self.n = len(dataset)
-        self.image_hw = tuple(stacked["image"].shape[1:3])
-        # host-side copy of the small per-sample arrays (boxes, labels,
-        # mask, difficult, ...): eval scoring reads ground truth on the
-        # host, and keeping these spares a second full decode pass
-        self.host_meta = {k: v for k, v in stacked.items() if k != "image"}
-        if mesh is not None:
-            from replication_faster_rcnn_tpu.parallel.mesh import replicated
+        with tspans.current_tracer().span(
+            "data/cache_upload", cat="data", n=len(dataset)
+        ):
+            stacked = collate([dataset[i] for i in range(len(dataset))])
+            # jitter geometry attaches per-step via sel, never via the cache
+            stacked.pop("jitter", None)
+            nbytes = sum(v.nbytes for v in stacked.values())
+            if nbytes > max_bytes:  # exact check (paranoia; shapes are fixed)
+                raise _over_cap(nbytes)
+            self.nbytes = nbytes
+            self.n = len(dataset)
+            self.image_hw = tuple(stacked["image"].shape[1:3])
+            self.host_meta = (
+                {k: v for k, v in stacked.items() if k != "image"}
+                if keep_host_meta
+                else None
+            )
+            if mesh is not None:
+                from replication_faster_rcnn_tpu.parallel.mesh import replicated
 
-            self.arrays = {
-                k: jax.device_put(v, replicated(mesh)) for k, v in stacked.items()
-            }
-        else:
-            self.arrays = {k: jax.device_put(v) for k, v in stacked.items()}
+                self.arrays = {
+                    k: jax.device_put(v, replicated(mesh))
+                    for k, v in stacked.items()
+                }
+            else:
+                self.arrays = {k: jax.device_put(v) for k, v in stacked.items()}
 
     def __len__(self) -> int:
         return self.n
@@ -237,6 +256,18 @@ def materialize_batch(
         shift_y = geom[:, 2][:, None]
         shift_x = geom[:, 3][:, None]
         valid = labels >= 0
+        # Per-row identity guard: the host path (`AugmentedView.__getitem__`)
+        # skips jitter_boxes entirely when the rounded geometry is
+        # (h, w, 0, 0) — a draw that resolves to no-op. Without the same
+        # skip here the <1px collapse below would kill a raw GT box that is
+        # already sub-pixel, even though no geometry was applied to it.
+        identity = (
+            (geom[:, 0] == h)
+            & (geom[:, 1] == w)
+            & (geom[:, 2] == 0.0)
+            & (geom[:, 3] == 0.0)
+        )[:, None]
+        applied = valid & ~identity
         jb = jnp.stack(
             [
                 boxes[..., 0] * sy - shift_y,
@@ -251,9 +282,9 @@ def materialize_batch(
         collapsed = ((jb[..., 2] - jb[..., 0]) < 1.0) | (
             (jb[..., 3] - jb[..., 1]) < 1.0
         )
-        dead = valid & collapsed
+        dead = applied & collapsed
         jb = jnp.where(dead[..., None], -1.0, jb)
-        boxes = jnp.where(valid[..., None], jb, boxes)
+        boxes = jnp.where(applied[..., None], jb, boxes)
         labels = jnp.where(dead, -1, labels)
         mask = jnp.where(dead, False, mask)
 

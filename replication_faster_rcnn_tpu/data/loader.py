@@ -32,6 +32,8 @@ from typing import Dict, Iterator, Optional, Sequence
 
 import numpy as np
 
+from replication_faster_rcnn_tpu.telemetry import spans as tspans
+
 
 def collate(samples: Sequence[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
     """Stack per-sample dicts into one batch dict."""
@@ -131,9 +133,19 @@ class DataLoader:
         self.seed = seed
         self.worker_mode = worker_mode
         self.epoch = 0
+        self._q: Optional["queue.Queue"] = None  # live prefetch queue
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
+
+    def queue_depth(self) -> Optional[int]:
+        """Batches currently buffered ahead of the consumer (thread-mode
+        prefetch only; None before iteration or in process mode). A depth
+        pinned at 0 under load means the feed can't keep up — the number
+        the watchdog snapshots to tell feed-starvation from a wedged
+        device."""
+        q = self._q
+        return q.qsize() if q is not None else None
 
     def _order(self) -> np.ndarray:
         n = len(self.dataset)
@@ -174,9 +186,15 @@ class DataLoader:
     def _build(
         self, idxs: np.ndarray, pool: Optional[futures.ThreadPoolExecutor], ds
     ) -> Dict[str, np.ndarray]:
-        if pool is None or len(idxs) == 1:
-            return collate([ds[int(i)] for i in idxs])
-        return collate(list(pool.map(lambda i: ds[int(i)], idxs)))
+        # decode+augment+collate for one batch; runs on the producer thread,
+        # so under healthy prefetch these spans OVERLAP step spans in the
+        # trace — visibly parallel lanes, not a serial pipeline
+        with tspans.current_tracer().span(
+            "data/build", cat="data", batch=len(idxs)
+        ):
+            if pool is None or len(idxs) == 1:
+                return collate([ds[int(i)] for i in idxs])
+            return collate(list(pool.map(lambda i: ds[int(i)], idxs)))
 
     def _iter_processes(self) -> Iterator[Dict[str, np.ndarray]]:
         """Process-worker iteration: whole batches farmed to forked
@@ -292,6 +310,7 @@ class DataLoader:
             return
 
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        self._q = q
         stop = threading.Event()
         err: list = []
 
@@ -322,6 +341,7 @@ class DataLoader:
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
+        tracer = tspans.current_tracer()
         try:
             while True:
                 batch = q.get()
@@ -329,9 +349,11 @@ class DataLoader:
                     if err:
                         raise err[0]
                     return
+                tracer.counter("loader/queue_depth", q.qsize())
                 yield batch
         finally:
             stop.set()
+            self._q = None
             while not q.empty():
                 q.get_nowait()
 

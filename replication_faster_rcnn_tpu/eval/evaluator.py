@@ -14,6 +14,7 @@ import dataclasses
 from typing import Any, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from replication_faster_rcnn_tpu.config import FasterRCNNConfig
@@ -24,6 +25,7 @@ from replication_faster_rcnn_tpu.eval.detect import (
 )
 from replication_faster_rcnn_tpu.eval.voc_eval import coco_map, voc_ap
 from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
+from replication_faster_rcnn_tpu.telemetry import spans as tspans
 
 
 class Evaluator:
@@ -68,6 +70,8 @@ class Evaluator:
             return infer(variables, jnp.take(image_cache, idx, axis=0))
 
         self._jit_infer_cached = jax.jit(infer_cached)
+        self._device_cache_base = None
+        self._device_cache = None
 
     def _eval_sharding(self, batch_size: int):
         """(image sharding, replicated sharding) for a data-parallel eval
@@ -98,6 +102,88 @@ class Evaluator:
             images = jax.device_put(np.asarray(images), sharding)
         return jax.device_get(self._jit_infer(variables, images))
 
+    def _score(
+        self,
+        detections: List[Dict[str, np.ndarray]],
+        gts: List[Dict[str, np.ndarray]],
+    ) -> Dict[str, float]:
+        if self.config.eval.metric == "coco":
+            return coco_map(detections, gts, self.config.model.num_classes)
+        return voc_ap(
+            detections,
+            gts,
+            self.config.model.num_classes,
+            iou_thresh=self.config.eval.iou_thresh,
+            use_07_metric=self.config.eval.use_07_metric,
+        )
+
+    def _evaluate_cached(
+        self,
+        variables: Any,
+        dataset,
+        batch_size: int,
+        max_images: Optional[int],
+    ) -> Dict[str, float]:
+        """Device-resident val sweep: images uploaded to HBM once per
+        dataset (reused across in-training eval epochs), each batch then
+        costs the host an index vector instead of a decoded image batch.
+        Ground truth comes from the cache's ``host_meta`` — mAP scoring
+        runs on host and must not pay a second decode pass. Runs on the
+        default device (no eval mesh): the feed savings, not eval data-
+        parallelism, is what this path is for."""
+        tracer = tspans.current_tracer()
+        if self._device_cache_base is not dataset:
+            from replication_faster_rcnn_tpu.data.device_cache import DeviceCache
+
+            self._device_cache_base = dataset
+            self._device_cache = DeviceCache(dataset, keep_host_meta=True)
+        cache = self._device_cache
+        meta = cache.host_meta
+        images = cache.arrays["image"]
+        detections: List[Dict[str, np.ndarray]] = []
+        gts: List[Dict[str, np.ndarray]] = []
+        seen = 0
+        for start in range(0, len(cache), batch_size):
+            idxs = np.arange(
+                start, min(start + batch_size, len(cache)), dtype=np.int32
+            )
+            k = len(idxs)
+            if k < batch_size:  # pad the tail to the compiled shape
+                idxs = np.concatenate(
+                    [idxs, np.full(batch_size - k, idxs[-1], np.int32)]
+                )
+            with tracer.span("eval/infer", cat="eval", feed="device_cache"):
+                out = jax.device_get(
+                    self._jit_infer_cached(
+                        variables, images, jnp.asarray(idxs)
+                    )
+                )
+            for i in range(k):
+                j = start + i
+                valid = out["valid"][i]
+                detections.append(
+                    {
+                        "boxes": out["boxes"][i][valid],
+                        "scores": out["scores"][i][valid],
+                        "classes": out["classes"][i][valid],
+                    }
+                )
+                lab = meta["labels"][j]
+                diff = meta.get("difficult")
+                diff = diff[j] if diff is not None else np.zeros_like(lab, bool)
+                real = lab >= 0
+                gts.append(
+                    {
+                        "boxes": meta["boxes"][j][real],
+                        "labels": lab[real],
+                        "ignore": diff[real],
+                    }
+                )
+            seen += k
+            if max_images is not None and seen >= max_images:
+                break
+        return self._score(detections, gts)
+
     def evaluate(
         self,
         variables: Any,
@@ -105,6 +191,10 @@ class Evaluator:
         batch_size: int = 8,
         max_images: Optional[int] = None,
     ) -> Dict[str, float]:
+        if self.config.data.cache_device:
+            return self._evaluate_cached(
+                variables, dataset, batch_size, max_images
+            )
         img_sharding, rep_sharding = self._eval_sharding(batch_size)
         if rep_sharding is not None:
             # device-side reshard (no host round-trip of the weights)
@@ -134,6 +224,7 @@ class Evaluator:
             num_workers=self.config.data.loader_workers,
             worker_mode="thread",
         )
+        tracer = tspans.current_tracer()
         detections: List[Dict[str, np.ndarray]] = []
         gts: List[Dict[str, np.ndarray]] = []
         seen = 0
@@ -145,7 +236,10 @@ class Evaluator:
                     k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
                     for k, v in batch.items()
                 }
-            out = self.predict_batch(variables, batch["image"], img_sharding)
+            with tracer.span("eval/infer", cat="eval", feed="loader"):
+                out = self.predict_batch(
+                    variables, batch["image"], img_sharding
+                )
             for i in range(n):
                 valid = out["valid"][i]
                 detections.append(
@@ -173,12 +267,4 @@ class Evaluator:
             seen += n
             if max_images is not None and seen >= max_images:
                 break
-        if self.config.eval.metric == "coco":
-            return coco_map(detections, gts, self.config.model.num_classes)
-        return voc_ap(
-            detections,
-            gts,
-            self.config.model.num_classes,
-            iou_thresh=self.config.eval.iou_thresh,
-            use_07_metric=self.config.eval.use_07_metric,
-        )
+        return self._score(detections, gts)

@@ -44,7 +44,20 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from replication_faster_rcnn_tpu.config import FasterRCNNConfig
 from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
+from replication_faster_rcnn_tpu.telemetry.health import health_metrics
 from replication_faster_rcnn_tpu.train.train_step import TrainState, compute_losses
+
+# jax >= 0.6 promotes shard_map to the top level and renames the
+# replication-check kwarg check_rep -> check_vma; 0.4.x only has the
+# experimental module. Resolve once at import so the builder below works
+# on both.
+if hasattr(jax, "shard_map"):  # pragma: no cover - jax >= 0.6 only
+    _shard_map = jax.shard_map
+    _NO_CHECK = {"check_vma": False}
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _NO_CHECK = {"check_rep": False}
 
 Array = jnp.ndarray
 
@@ -96,10 +109,12 @@ def make_shard_map_train_step(
         # loss/count metrics are local-contribution / global-normalizer (or
         # plain local counts), so psum yields the batch-global values.
         metrics = jax.lax.psum(metrics, axis)
-        metrics["grad_norm"] = optax.global_norm(grads)
 
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        # health scalars AFTER the psum: grads are global here and params
+        # replicated, so the values match the auto-partitioned backend's
+        metrics.update(health_metrics(grads, state.params, updates))
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
@@ -108,11 +123,11 @@ def make_shard_map_train_step(
         )
         return new_state, metrics
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         per_shard,
         mesh=mesh,
         in_specs=(P(), P(axis)),
         out_specs=(P(), P()),
-        check_vma=False,
+        **_NO_CHECK,
     )
     return jax.jit(sharded, donate_argnums=(0,)), model

@@ -1,0 +1,29 @@
+"""Zero-dependency observability layer.
+
+Four pillars, each usable on its own:
+
+- :mod:`.spans` — host-side span tracer emitting Chrome-trace JSON
+  (``chrome://tracing`` / Perfetto loadable) so feed-vs-compute time is
+  directly visible per pipeline phase.
+- :mod:`.health` — on-device train-health metrics (grad/param/update
+  norms, update ratio, non-finite counts) folded into the jitted step so
+  they ride the existing metrics sync instead of adding one.
+- :mod:`.mfu` — model FLOPs utilisation from the step FLOPs the bench
+  already derives, with a measured-matmul CPU peak so MFU is non-null
+  even off-TPU.
+- :mod:`.watchdog` — heartbeat daemon that detects a wedged device or
+  tunnel and dumps a diagnostic snapshot (last span, queue depth,
+  elapsed-since-progress) instead of leaving a hung process to guess at.
+
+:mod:`.report` turns a run directory (trace.json + metrics.jsonl +
+watchdog.jsonl) into a phase-time and health report; surfaced as the
+``telemetry`` CLI subcommand.
+"""
+
+from replication_faster_rcnn_tpu.telemetry.spans import (  # noqa: F401
+    NULL_TRACER,
+    SpanTracer,
+    current_tracer,
+    set_tracer,
+)
+from replication_faster_rcnn_tpu.telemetry.watchdog import StallWatchdog  # noqa: F401

@@ -1,0 +1,69 @@
+"""On-device train-health metrics, computed inside the jitted step.
+
+Everything here is a handful of reductions over trees the step already
+holds (grads, params, optimizer updates), so the scalars ride the
+existing metrics device→host transfer at the log boundary — no extra
+sync, no extra dispatch.
+
+The signals and why they matter for a multi-loss detector:
+
+- ``grad_norm`` / ``param_norm`` — global L2 norms. A grad norm orders
+  of magnitude above its running level is the classic pre-divergence
+  signature; Faster R-CNN's four summed losses make it easy for one
+  head to blow up while the total loss still looks plausible.
+- ``update_norm`` / ``update_ratio`` — the optimizer's actual step size
+  and its size relative to the params (``|Δθ| / |θ|``). Healthy training
+  sits around 1e-3; ~1 means the optimizer is rewriting the network
+  each step, ~1e-7 means it has stalled.
+- ``nonfinite_count`` — total NaN/Inf entries across the grad tree.
+  Catches the poisoned-gradient case *before* params go NaN, which
+  ``finite_or_raise`` on the loss alone only catches one step later.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+HEALTH_KEYS = (
+    "grad_norm",
+    "param_norm",
+    "update_norm",
+    "update_ratio",
+    "nonfinite_count",
+)
+
+
+def nonfinite_count(tree: Any) -> jnp.ndarray:
+    """Total number of non-finite entries across all leaves of ``tree``."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    counts = [
+        jnp.sum(~jnp.isfinite(leaf)).astype(jnp.int32)
+        for leaf in leaves
+        if jnp.issubdtype(leaf.dtype, jnp.inexact)
+    ]
+    if not counts:
+        return jnp.int32(0)
+    return sum(counts)
+
+
+def health_metrics(grads: Any, params: Any, updates: Any) -> Dict[str, jnp.ndarray]:
+    """Train-health scalars from the trees a step already holds.
+
+    Call after ``tx.update`` with the *global* grads (post-psum under
+    shard_map; under jit auto-partitioning the grads are already global)
+    so both parallel backends report identical values.
+    """
+    grad_norm = optax.global_norm(grads)
+    param_norm = optax.global_norm(params)
+    update_norm = optax.global_norm(updates)
+    return {
+        "grad_norm": grad_norm,
+        "param_norm": param_norm,
+        "update_norm": update_norm,
+        "update_ratio": update_norm / (param_norm + 1e-12),
+        "nonfinite_count": nonfinite_count(grads),
+    }

@@ -1,0 +1,209 @@
+"""Summarize a telemetry run directory into a phase-time + health report.
+
+Input is whatever a telemetry-enabled run left behind:
+``trace.json`` (Chrome-trace spans), ``metrics.jsonl`` (MetricLogger
+rows, now including the health scalars), ``watchdog.jsonl`` (stall
+incidents), ``progress.json`` (last heartbeat). All optional — the
+report covers what exists. Pure stdlib on purpose: the ``telemetry``
+CLI subcommand must work on a laptop holding only the artifacts,
+without importing jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+TRACE_FILE = "trace.json"
+METRICS_FILE = "metrics.jsonl"
+WATCHDOG_FILE = "watchdog.jsonl"
+PROGRESS_FILE = "progress.json"
+
+# Health/throughput keys worth surfacing from the JSONL, in display order.
+_HEALTH_KEYS = (
+    "loss",
+    "rpn_cls_loss",
+    "rpn_reg_loss",
+    "head_cls_loss",
+    "head_reg_loss",
+    "grad_norm",
+    "param_norm",
+    "update_norm",
+    "update_ratio",
+    "nonfinite_count",
+)
+
+
+def load_trace_events(path: str) -> List[Dict[str, Any]]:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):  # bare-array Chrome-trace variant
+        return doc
+    return doc.get("traceEvents", [])
+
+
+def load_jsonl(path: str) -> List[Dict[str, Any]]:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # a torn tail line from a killed run is expected
+    return rows
+
+
+def phase_table(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Aggregate complete (ph=X) spans by name: count / total / mean / max
+    ms, sorted by total time descending."""
+    agg: Dict[str, Dict[str, float]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "?")
+        dur_ms = float(ev.get("dur", 0.0)) / 1e3
+        row = agg.setdefault(name, {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
+        row["count"] += 1
+        row["total_ms"] += dur_ms
+        row["max_ms"] = max(row["max_ms"], dur_ms)
+    out = []
+    for name, row in agg.items():
+        out.append(
+            {
+                "name": name,
+                "count": int(row["count"]),
+                "total_ms": round(row["total_ms"], 3),
+                "mean_ms": round(row["total_ms"] / row["count"], 3),
+                "max_ms": round(row["max_ms"], 3),
+            }
+        )
+    out.sort(key=lambda r: -r["total_ms"])
+    return out
+
+
+def health_summary(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Last value + max of each health key across step rows."""
+    step_rows = [r for r in rows if "step" in r]
+    out: Dict[str, Any] = {"rows": len(step_rows)}
+    if not step_rows:
+        return out
+    out["first_step"] = step_rows[0].get("step")
+    out["last_step"] = step_rows[-1].get("step")
+    keys: Dict[str, Dict[str, float]] = {}
+    for key in _HEALTH_KEYS:
+        vals = [
+            float(r[key])
+            for r in step_rows
+            if isinstance(r.get(key), (int, float))
+        ]
+        if vals:
+            keys[key] = {"last": vals[-1], "max": max(vals), "min": min(vals)}
+    out["metrics"] = keys
+    return out
+
+
+def summarize_run(run_dir: str) -> Dict[str, Any]:
+    summary: Dict[str, Any] = {"run_dir": run_dir, "artifacts": []}
+    trace_path = os.path.join(run_dir, TRACE_FILE)
+    if os.path.exists(trace_path):
+        summary["artifacts"].append(TRACE_FILE)
+        summary["phases"] = phase_table(load_trace_events(trace_path))
+    metrics_path = os.path.join(run_dir, METRICS_FILE)
+    if os.path.exists(metrics_path):
+        summary["artifacts"].append(METRICS_FILE)
+        summary["health"] = health_summary(load_jsonl(metrics_path))
+    wd_path = os.path.join(run_dir, WATCHDOG_FILE)
+    if os.path.exists(wd_path):
+        summary["artifacts"].append(WATCHDOG_FILE)
+        incidents = load_jsonl(wd_path)
+        summary["incidents"] = {
+            "stalls": sum(1 for i in incidents if i.get("kind") == "stall"),
+            "recoveries": sum(1 for i in incidents if i.get("kind") == "recovered"),
+            "events": incidents,
+        }
+    progress_path = os.path.join(run_dir, PROGRESS_FILE)
+    if os.path.exists(progress_path):
+        summary["artifacts"].append(PROGRESS_FILE)
+        with open(progress_path) as f:
+            summary["progress"] = json.load(f)
+    try:  # a --profile device capture next to the host spans?
+        from replication_faster_rcnn_tpu.utils.xplane import has_device_trace
+
+        summary["device_trace"] = has_device_trace(run_dir)
+    except Exception:  # pragma: no cover - report must survive without it
+        summary["device_trace"] = False
+    return summary
+
+
+def format_report(summary: Dict[str, Any]) -> str:
+    """Human-readable rendering of :func:`summarize_run`."""
+    lines = [f"telemetry report: {summary['run_dir']}"]
+    if not summary["artifacts"]:
+        lines.append("  no telemetry artifacts found "
+                     f"({TRACE_FILE}/{METRICS_FILE}/{WATCHDOG_FILE})")
+        return "\n".join(lines)
+
+    phases = summary.get("phases")
+    if phases is not None:
+        lines.append("")
+        lines.append("phase time (from trace.json):")
+        header = f"  {'span':<26}{'count':>7}{'total_ms':>12}{'mean_ms':>10}{'max_ms':>10}"
+        lines.append(header)
+        for row in phases:
+            lines.append(
+                f"  {row['name']:<26}{row['count']:>7}"
+                f"{row['total_ms']:>12.1f}{row['mean_ms']:>10.2f}{row['max_ms']:>10.1f}"
+            )
+
+    health = summary.get("health")
+    if health is not None:
+        lines.append("")
+        lines.append(
+            f"train health (from metrics.jsonl, {health['rows']} rows"
+            + (
+                f", steps {health.get('first_step')}..{health.get('last_step')})"
+                if health["rows"]
+                else ")"
+            )
+        )
+        for key, vals in health.get("metrics", {}).items():
+            lines.append(
+                f"  {key:<18} last {vals['last']:<12.5g} "
+                f"min {vals['min']:<12.5g} max {vals['max']:<12.5g}"
+            )
+
+    incidents = summary.get("incidents")
+    if incidents is not None:
+        lines.append("")
+        lines.append(
+            f"watchdog: {incidents['stalls']} stall(s), "
+            f"{incidents['recoveries']} recovery(ies)"
+        )
+        for ev in incidents["events"]:
+            if ev.get("kind") != "stall":
+                continue
+            span = ev.get("last_span") or {}
+            lines.append(
+                f"  stall at step={ev.get('last_step')} phase={ev.get('last_phase')} "
+                f"after {ev.get('elapsed_since_progress_s')}s "
+                f"(last span: {span.get('name') if isinstance(span, dict) else span})"
+            )
+
+    progress = summary.get("progress")
+    if progress is not None:
+        lines.append("")
+        lines.append(
+            f"last heartbeat: step={progress.get('step')} "
+            f"phase={progress.get('phase')} at {progress.get('utc')}"
+        )
+    if summary.get("device_trace"):
+        lines.append("")
+        lines.append(
+            "device profiler capture present — per-op table: "
+            f"cli trace-summary {summary['run_dir']}"
+        )
+    return "\n".join(lines)

@@ -34,6 +34,7 @@ from replication_faster_rcnn_tpu.targets import (
     batched_anchor_targets,
     batched_proposal_targets,
 )
+from replication_faster_rcnn_tpu.telemetry.health import health_metrics
 from replication_faster_rcnn_tpu.train import losses
 
 Array = jnp.ndarray
@@ -221,7 +222,9 @@ def make_train_step(
             batch_stats=new_stats,
             opt_state=new_opt,
         )
-        metrics["grad_norm"] = optax.global_norm(grads)
+        # health scalars (grad/param/update norms, update ratio, non-finite
+        # count) ride the metrics transfer — no extra device sync
+        metrics.update(health_metrics(grads, state.params, updates))
         return new_state, metrics
 
     return train_step

@@ -39,6 +39,8 @@ from replication_faster_rcnn_tpu.train.train_step import (
     make_optimizer,
     make_train_step,
 )
+from replication_faster_rcnn_tpu.telemetry import spans as tspans
+from replication_faster_rcnn_tpu.telemetry.watchdog import StallWatchdog
 from replication_faster_rcnn_tpu.utils.debug import finite_or_raise
 from replication_faster_rcnn_tpu.utils.logging import MetricLogger
 
@@ -76,6 +78,8 @@ class Trainer:
         workdir: str = "checkpoints",
         dataset=None,
         devices=None,
+        telemetry_dir: Optional[str] = None,
+        stall_timeout_s: float = 300.0,
     ) -> None:
         self.config = config
         self.workdir = workdir
@@ -97,7 +101,38 @@ class Trainer:
             )
             self.config = config
         self.mesh = make_mesh(config.mesh, devices)
-        self.logger = MetricLogger()
+
+        # --- telemetry: span tracer + JSONL metrics + stall watchdog.
+        # With no telemetry_dir everything collapses to no-ops (NULL
+        # tracer spans, stream-only logger, no watchdog thread).
+        self.telemetry_dir = telemetry_dir
+        self.watchdog: Optional[StallWatchdog] = None
+        if telemetry_dir:
+            os.makedirs(telemetry_dir, exist_ok=True)
+            self.tracer = tspans.SpanTracer(
+                os.path.join(telemetry_dir, "trace.json")
+            )
+            # install process-wide so the loader/evaluator/device-cache
+            # span call sites (which take no tracer parameter) attach here
+            tspans.set_tracer(self.tracer)
+            self.logger = MetricLogger(
+                jsonl_path=os.path.join(telemetry_dir, "metrics.jsonl")
+            )
+            self.watchdog = StallWatchdog(
+                timeout_s=stall_timeout_s,
+                snapshot_path=os.path.join(telemetry_dir, "watchdog.jsonl"),
+                progress_path=os.path.join(telemetry_dir, "progress.json"),
+                tracer=self.tracer,
+                on_stall=lambda snap: self.logger.event(
+                    "stall",
+                    elapsed_s=snap.get("elapsed_since_progress_s"),
+                    last_step=snap.get("last_step"),
+                    last_phase=snap.get("last_phase"),
+                ),
+            )
+        else:
+            self.tracer = tspans.NULL_TRACER
+            self.logger = MetricLogger()
 
         self.dataset = dataset if dataset is not None else make_dataset(
             config.data, "train"
@@ -116,6 +151,15 @@ class Trainer:
                     "cache_device currently pairs with the jit auto-"
                     "partitioned backend only (train.backend='auto'); the "
                     "explicit shard_map backend feeds host batches"
+                )
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "cache_device requires a single-process runtime: "
+                    "DeviceCache device_puts the full dataset from this "
+                    "host to a replicated sharding, which one process "
+                    "cannot place across a multi-host mesh. Drop "
+                    "--cache-device (use the host loader, optionally with "
+                    "device_normalize) on multi-host runs."
                 )
             from replication_faster_rcnn_tpu.data.device_cache import (
                 CachedSampler,
@@ -290,17 +334,30 @@ class Trainer:
     # ---------------------------------------------------------------- train
 
     def train_one_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        tracer = self.tracer
         if self.device_cache is not None:
             # `batch` is a selection dict (idx/flip/jitter — bytes, not
             # megabytes); the images never leave the device
-            sel = shard_batch(batch, self.mesh, self.config.mesh)
-            self.state, metrics = self.jitted_step(
-                self.state, self.device_cache.arrays, sel
-            )
+            with tracer.span("data/device_put", cat="data", feed="device_cache"):
+                sel = shard_batch(batch, self.mesh, self.config.mesh)
+            with tracer.span("step/dispatch", cat="step"):
+                self.state, metrics = self.jitted_step(
+                    self.state, self.device_cache.arrays, sel
+                )
             return metrics
-        device_batch = shard_batch(batch, self.mesh, self.config.mesh)
-        self.state, metrics = self.jitted_step(self.state, device_batch)
+        with tracer.span("data/device_put", cat="data", feed="loader"):
+            device_batch = shard_batch(batch, self.mesh, self.config.mesh)
+        with tracer.span("step/dispatch", cat="step"):
+            self.state, metrics = self.jitted_step(self.state, device_batch)
         return metrics
+
+    def flush_telemetry(self) -> None:
+        """Write the trace file and stop the watchdog. Called by the CLI's
+        bounded --steps mode, which drives :meth:`train_one_batch` directly
+        and so never reaches :meth:`train`'s own flush."""
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        self.tracer.flush()
 
     def evaluate(self, max_images: Optional[int] = None) -> Dict[str, float]:
         """mAP on the val split with the CURRENT training parameters
@@ -317,10 +374,12 @@ class Trainer:
             "params": self.state.params,
             "batch_stats": self.state.batch_stats,
         }
-        return self._evaluator.evaluate(
-            variables, self._val_dataset, batch_size=self.config.train.batch_size,
-            max_images=max_images,
-        )
+        with self.tracer.span("eval/evaluate", cat="eval"):
+            return self._evaluator.evaluate(
+                variables, self._val_dataset,
+                batch_size=self.config.train.batch_size,
+                max_images=max_images,
+            )
 
     def train(self, log_every: int = 10, resume: bool = False) -> Dict[str, float]:
         """Run cfg.train.n_epoch epochs. The epoch count lives in the config
@@ -338,29 +397,62 @@ class Trainer:
         last: Dict[str, float] = {}
         eval_result: Dict[str, float] = {}
         feed = self.sampler if self.device_cache is not None else self.loader
-        for epoch in range(start_epoch, cfg.n_epoch):
-            feed.set_epoch(epoch)
-            t_epoch = time.time()
-            n_images = 0
-            for batch in feed:
-                metrics = self.train_one_batch(batch)
-                n_images += batch["idx" if "idx" in batch else "image"].shape[0]
-                step += 1
-                if step % log_every == 0:
-                    # fail fast on NaN/inf instead of training on garbage
-                    # (SURVEY.md §5 sanitizers; utils/debug.py)
-                    last = finite_or_raise(jax.device_get(metrics), step)
-                    last["lr"] = float(self.schedule(step))
-                    self.logger.log(step, last)
-            # epoch-boundary sync for an honest throughput number
-            jax.device_get(jax.tree_util.tree_leaves(self.state.params)[0])
-            dt = time.time() - t_epoch
-            self.logger.log_epoch(epoch, n_images / dt if dt > 0 else 0.0)
-            if cfg.eval_every_epochs and (epoch + 1) % cfg.eval_every_epochs == 0:
-                eval_result = {"mAP": float(self.evaluate()["mAP"])}
-                self.logger.log(step, eval_result)
-            if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
-                self.save()
+        tracer = self.tracer
+        if self.watchdog is not None:
+            if self.loader is not None:
+                self.watchdog.providers.setdefault(
+                    "loader_queue_depth", self.loader.queue_depth
+                )
+            self.watchdog.start()
+        try:
+            for epoch in range(start_epoch, cfg.n_epoch):
+                feed.set_epoch(epoch)
+                t_epoch = time.time()
+                n_images = 0
+                it = iter(feed)
+                while True:
+                    # the fetch span covers host-side batch production
+                    # (decode/collate or selection draw) — the feed half of
+                    # the feed-vs-compute question
+                    with tracer.span("data/fetch", cat="data"):
+                        try:
+                            batch = next(it)
+                        except StopIteration:
+                            break
+                    metrics = self.train_one_batch(batch)
+                    n_images += batch["idx" if "idx" in batch else "image"].shape[0]
+                    step += 1
+                    if self.watchdog is not None:
+                        self.watchdog.beat(step=step, phase="train")
+                    if step % log_every == 0:
+                        # fail fast on NaN/inf instead of training on garbage
+                        # (SURVEY.md §5 sanitizers; utils/debug.py) — the sync
+                        # span is where async dispatch drains, i.e. device
+                        # compute time for the interval
+                        with tracer.span("step/sync", cat="sync"):
+                            host_metrics = jax.device_get(metrics)
+                        last = finite_or_raise(host_metrics, step)
+                        last["lr"] = float(self.schedule(step))
+                        self.logger.log(step, last)
+                # epoch-boundary sync for an honest throughput number
+                with tracer.span("step/sync", cat="sync", boundary="epoch"):
+                    jax.device_get(jax.tree_util.tree_leaves(self.state.params)[0])
+                dt = time.time() - t_epoch
+                self.logger.log_epoch(epoch, n_images / dt if dt > 0 else 0.0)
+                if cfg.eval_every_epochs and (epoch + 1) % cfg.eval_every_epochs == 0:
+                    if self.watchdog is not None:
+                        self.watchdog.beat(phase="eval")
+                    eval_result = {"mAP": float(self.evaluate()["mAP"])}
+                    self.logger.log(step, eval_result)
+                if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
+                    if self.watchdog is not None:
+                        self.watchdog.beat(phase="checkpoint")
+                    with tracer.span("checkpoint/save", cat="checkpoint"):
+                        self.save()
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.stop()
+            tracer.flush()
         if last:
             last = {k: float(v) for k, v in last.items()}
         # merged last so step-metric logging cannot wipe the eval result

@@ -10,7 +10,23 @@ from __future__ import annotations
 import json
 import sys
 import time
-from typing import Dict, Optional, TextIO
+from typing import Any, Dict, Optional, TextIO
+
+
+def _fmt(v: Any) -> str:
+    try:
+        return f"{float(v):.4g}"
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def _jsonable(v: Any) -> Any:
+    """json.dumps ``default``: numpy/jax scalars → Python numbers, anything
+    else → repr, so one odd metric value cannot kill the logging path."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return repr(v)
 
 
 class MetricLogger:
@@ -30,13 +46,22 @@ class MetricLogger:
     def _write_jsonl(self, record: Dict) -> None:
         if self.jsonl_path:
             with open(self.jsonl_path, "a") as f:
-                f.write(json.dumps(record) + "\n")
+                f.write(json.dumps(record, default=_jsonable) + "\n")
 
     def log(self, step: int, metrics: Dict[str, float]) -> None:
-        parts = " ".join(f"{k}={v:.4g}" for k, v in sorted(metrics.items()))
+        parts = " ".join(f"{k}={_fmt(v)}" for k, v in sorted(metrics.items()))
         self.stream.write(f"[step {step:>6}] {parts}\n")
         self.stream.flush()
         self._write_jsonl({"step": step, "t": time.time() - self._t0, **metrics})
+
+    def event(self, kind: str, **fields) -> None:
+        """Out-of-band run event (stall, recovery, ...) — one stream line
+        plus a ``{"event": kind, ...}`` JSONL row, distinguishable from
+        step rows by the absence of a ``step`` key."""
+        parts = " ".join(f"{k}={_fmt(v)}" for k, v in sorted(fields.items()))
+        self.stream.write(f"[event {kind}] {parts}\n")
+        self.stream.flush()
+        self._write_jsonl({"event": kind, "t": time.time() - self._t0, **fields})
 
     def log_epoch(self, epoch: int, images_per_sec: float) -> None:
         self.stream.write(

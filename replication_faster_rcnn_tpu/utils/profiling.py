@@ -37,11 +37,17 @@ def trace(logdir: Optional[str]) -> Iterator[None]:
     if logdir is None:
         yield
         return
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+    from replication_faster_rcnn_tpu.telemetry import spans as tspans
+
+    # mirrored as a telemetry span so the host-side trace.json shows when
+    # (and for how long) the device profiler was recording
+    with tspans.current_tracer().span("profiler/trace", cat="profile",
+                                      logdir=logdir):
+        jax.profiler.start_trace(logdir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
 
 
 class StepTimer:

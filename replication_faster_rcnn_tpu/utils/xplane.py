@@ -154,6 +154,13 @@ def find_xplane_files(trace_dir: str) -> List[str]:
     )
 
 
+def has_device_trace(trace_dir: str) -> bool:
+    """True when ``trace_dir`` holds a device profiler capture. Used by
+    `telemetry.report` to point a run summary at ``trace-summary`` when a
+    --profile capture sits next to the host-side span trace."""
+    return bool(find_xplane_files(trace_dir))
+
+
 def op_table(
     trace_dir: str,
     plane_filter: Optional[str] = None,

@@ -90,9 +90,11 @@ class TestBenchSuccess:
         assert line["value"] > 0
         assert "error" not in line
         # VERDICT r1 weak #4: the bench must report the step's FLOPs and a
-        # per-stage wall-time attribution (mfu itself is None off-TPU)
+        # per-stage wall-time attribution; off-TPU the peak comes from the
+        # measured-matmul basis, so mfu must be non-null even here
         assert line["flops_per_step"] > 0
-        assert line["mfu"] is None  # CPU backend: no meaningful peak
+        assert line["mfu"] is not None and line["mfu"] > 0
+        assert line["mfu_basis"] == "cpu_measured_matmul"
         bd = line["breakdown"]
         assert bd["trunk_ms"] > 0 and bd["step_ms"] > 0
         required = {

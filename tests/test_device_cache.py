@@ -102,6 +102,42 @@ class TestMaterializeEquivalence:
         host = _host_batch(ds, self.idxs, hflip=True, scale_range=(0.75, 1.25))
         self._compare(host, materialize_batch(cache.arrays, sel))
 
+    def test_identity_jitter_preserves_subpixel_gt_box(self):
+        """Regression: a raw GT box that is already <1px must survive a
+        jitter draw resolving to identity geometry (h, w, 0, 0) — the host
+        path skips jitter_boxes entirely there, so the device path must not
+        apply its <1px collapse. A real (non-identity) draw still collapses
+        it."""
+        cache = {
+            "image": jnp.zeros((1, H, W, 3), jnp.float32),
+            "boxes": jnp.asarray(
+                [[[10.0, 10.0, 10.4, 20.0],  # 0.4px tall raw GT box
+                  [5.0, 5.0, 25.0, 30.0]]], jnp.float32
+            ),
+            "labels": jnp.asarray([[1, 2]], jnp.int32),
+            "mask": jnp.asarray([[True, True]]),
+        }
+        ident = {
+            "idx": jnp.asarray([0], jnp.int32),
+            "jitter": jnp.asarray([[H, W, 0, 0]], jnp.int32),
+        }
+        out = materialize_batch(cache, ident)
+        np.testing.assert_array_equal(np.asarray(out["labels"]), [[1, 2]])
+        np.testing.assert_allclose(
+            np.asarray(out["boxes"]), np.asarray(cache["boxes"])
+        )
+        np.testing.assert_array_equal(np.asarray(out["mask"]), [[True, True]])
+
+        real = {
+            "idx": jnp.asarray([0], jnp.int32),
+            "jitter": jnp.asarray([[H + 2, W + 2, 1, 1]], jnp.int32),
+        }
+        out2 = materialize_batch(cache, real)
+        labels2 = np.asarray(out2["labels"])
+        assert labels2[0, 0] == -1  # sub-pixel box collapsed by a real draw
+        assert not np.asarray(out2["mask"])[0, 0]
+        assert labels2[0, 1] == 2  # the normal box survives the same draw
+
     def test_uint8_samples(self):
         ds = _dataset(device_normalize=True)
         cache = DeviceCache(ds)
@@ -178,6 +214,19 @@ class TestCachedStep:
                 float(m_fed[k]), float(m_cached[k]), rtol=2e-4, atol=2e-5,
                 err_msg=k,
             )
+        # the telemetry health scalars ride the same metrics dict — sanity
+        # on a healthy step, piggybacked here to spare the fast tier
+        # another full-step compile
+        from replication_faster_rcnn_tpu.telemetry.health import HEALTH_KEYS
+
+        assert set(HEALTH_KEYS) <= set(m_fed)
+        assert float(m_fed["grad_norm"]) > 0
+        assert int(m_fed["nonfinite_count"]) == 0
+        np.testing.assert_allclose(
+            float(m_fed["update_ratio"]),
+            float(m_fed["update_norm"]) / float(m_fed["param_norm"]),
+            rtol=1e-4,
+        )
 
     def test_trainer_cache_device_end_to_end(self, tmp_path):
         """Trainer(cache_device=True) trains, checkpoints, and its loss
@@ -205,6 +254,19 @@ class TestCachedStep:
         )
         ds = SyntheticDataset(cfg.data, length=N)
         with pytest.raises(ValueError, match="cache_device"):
+            Trainer(cfg, dataset=ds)
+
+    def test_multiprocess_runtime_rejected(self, monkeypatch):
+        """A multi-host runtime must fail loudly before the cache upload:
+        one process cannot place a replicated dataset across a multi-host
+        mesh, and a cryptic device_put error 5 GB in is the wrong way to
+        learn that."""
+        from replication_faster_rcnn_tpu.train.trainer import Trainer
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        cfg = _tiny_cfg(cache_device=True)
+        ds = SyntheticDataset(cfg.data, length=N)
+        with pytest.raises(ValueError, match="single-process"):
             Trainer(cfg, dataset=ds)
 
 

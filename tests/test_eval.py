@@ -4,6 +4,7 @@ end-to-end Evaluator sweep."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from replication_faster_rcnn_tpu.config import (
     DataConfig,
@@ -169,6 +170,59 @@ def test_evaluator_data_parallel_matches_single_device():
     np.testing.assert_allclose(r1["mAP"], r8["mAP"], rtol=1e-6, equal_nan=True)
     np.testing.assert_allclose(
         r1["ap_per_class"], r8["ap_per_class"], rtol=1e-5, equal_nan=True
+    )
+
+
+@pytest.mark.slow  # compiles both eval feed paths
+def test_evaluator_cached_feed_matches_fed_path():
+    """--cache-device eval: the device-resident sweep (gather-by-index
+    inside the jitted infer, GT from the cache's host_meta) must score
+    identically to the loader-fed sweep — and must demonstrably take the
+    cached path, not silently fall back to the loader."""
+    import dataclasses
+
+    from replication_faster_rcnn_tpu.data import SyntheticDataset
+    from replication_faster_rcnn_tpu.eval import Evaluator
+    from replication_faster_rcnn_tpu.models import faster_rcnn
+    from replication_faster_rcnn_tpu.telemetry.spans import SpanTracer, set_tracer
+
+    cfg = FasterRCNNConfig(
+        model=ModelConfig(backbone="resnet18", roi_op="align", compute_dtype="float32"),
+        data=DataConfig(dataset="synthetic", image_size=(64, 64), max_boxes=8),
+        eval=EvalConfig(max_detections=20),
+    )
+    model, variables = faster_rcnn.init_variables(cfg, jax.random.PRNGKey(0))
+    # length=6 with batch_size=4 exercises the padded tail on both paths
+    ds = SyntheticDataset(cfg.data, split="val", length=6)
+
+    fed = Evaluator(cfg, model, devices=jax.devices()[:1]).evaluate(
+        variables, ds, batch_size=4
+    )
+
+    cached_cfg = cfg.replace(
+        data=dataclasses.replace(cfg.data, cache_device=True)
+    )
+    ev = Evaluator(cached_cfg, model)
+    tracer = SpanTracer()
+    prev = set_tracer(tracer)
+    try:
+        cached = ev.evaluate(variables, ds, batch_size=4)
+    finally:
+        set_tracer(prev)
+
+    infer_spans = [
+        e for e in tracer.to_dict()["traceEvents"] if e["name"] == "eval/infer"
+    ]
+    assert infer_spans, "cached eval emitted no eval/infer spans"
+    assert all(e["args"]["feed"] == "device_cache" for e in infer_spans)
+    assert ev._device_cache is not None
+    assert ev._device_cache.host_meta is not None  # GT scored from host_meta
+
+    np.testing.assert_allclose(
+        fed["mAP"], cached["mAP"], rtol=1e-6, equal_nan=True
+    )
+    np.testing.assert_allclose(
+        fed["ap_per_class"], cached["ap_per_class"], rtol=1e-5, equal_nan=True
     )
 
 

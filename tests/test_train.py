@@ -262,6 +262,9 @@ class TestFeaturesWall:
         assert trunk_norm_full > 0.0
         assert head_norm_wall > 0.0  # head/rpn backward still runs
 
+    @pytest.mark.slow  # compiles six full/partial train graphs (~5 min on
+    # one CPU core — a third of the fast tier's whole wall-clock budget);
+    # the fast tier keeps the in-process wall semantics test above
     def test_grad_breakdown_script_cpu(self, tmp_path, monkeypatch):
         # end-to-end at tiny shape on CPU (GRAD_BREAKDOWN_CPU gate)
         import importlib.util
