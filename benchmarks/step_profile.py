@@ -1,0 +1,454 @@
+"""Step-profile regression harness: per-phase time + cost records, banked.
+
+One command measures a config's train step, attributes wall time to the
+pipeline phases (``dispatch`` floor, ``fwd``, ``bwd``, ``update``) via
+the telemetry span tracer (`telemetry/spans.py`), attaches the analytic
+per-phase FLOPs/bytes from XLA's HloCostAnalysis of the same lowered
+programs (`benchmark.lowered_cost`), computes MFU against the measured
+host peak (`telemetry/mfu.py`), and checks the result against the
+committed record for the same (config, backend, platform) under
+``benchmarks/records/``:
+
+    python benchmarks/step_profile.py --preset tiny            # check
+    python benchmarks/step_profile.py --preset tiny --update   # re-bank
+
+A run whose throughput lands >15% below the banked value on the SAME
+backend+platform exits nonzero with a loud report — a perf regression
+fails like a test failure instead of rotting silently in a JSON nobody
+rereads. Cross-platform comparisons are skipped (a CPU run can never
+"regress" a TPU record). ``benchmarks/bank_records.py`` stays the home
+of raw throughput history; this file owns the per-phase shape of a step.
+
+Why spans and not bare ``time.time()``: the trainer's own hot loop is
+instrumented with the same tracer (``step/dispatch``, ``step/sync``), so
+profiling through spans keeps one timing vocabulary across the trainer,
+the telemetry report CLI, and this harness — the record's ``spans``
+table is exactly `telemetry.report.phase_table` output.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+RECORDS_DIR = os.path.join(_REPO, "benchmarks", "records")
+SCHEMA = "step_profile/v1"
+DEFAULT_TOL = 0.15
+
+# throughput is the hard gate; phase means on a shared CPU jitter well
+# past 15%, so per-phase regressions are reported but only fail under
+# --strict-phases
+GATE_KEY = "images_per_sec"
+
+
+# ---------------------------------------------------------------------------
+# pure record logic (no jax): unit-testable without timing anything
+
+
+def record_key(config_token: str, backend: str, platform: str, k: int = 1) -> str:
+    """Identity of a banked record: what must match for a comparison to
+    be meaningful. ``k`` is train.steps_per_dispatch — a fused-dispatch
+    profile is a different record, not a regression of the k=1 one."""
+    token = f"{config_token}_{backend}_{platform}"
+    if k > 1:
+        token += f"_k{k}"
+    return token
+
+
+def record_path(key: str, records_dir: str = RECORDS_DIR) -> str:
+    return os.path.join(records_dir, f"step_profile_{key}.json")
+
+
+def check_regression(current, banked, tol: float = DEFAULT_TOL,
+                     strict_phases: bool = False):
+    """Compare a fresh profile against its banked record.
+
+    Returns (failures, warnings): lists of human-readable strings. A
+    failure means the harness must exit nonzero. Only records with the
+    same key are comparable — the caller guarantees that by construction
+    (the banked record is looked up BY key)."""
+    failures, warnings = [], []
+    if banked.get("schema") != SCHEMA:
+        warnings.append(
+            f"banked record has schema {banked.get('schema')!r}, "
+            f"expected {SCHEMA!r}; skipping comparison"
+        )
+        return failures, warnings
+
+    old = banked.get(GATE_KEY)
+    new = current.get(GATE_KEY)
+    if old and new:
+        drop = 1.0 - new / old
+        if drop > tol:
+            failures.append(
+                f"{GATE_KEY} regressed {drop:+.1%}: {new:.3f} vs banked "
+                f"{old:.3f} (tolerance {tol:.0%})"
+            )
+        elif drop > tol / 2:
+            warnings.append(
+                f"{GATE_KEY} within tolerance but slipping {drop:+.1%}: "
+                f"{new:.3f} vs banked {old:.3f}"
+            )
+    for phase, row in (banked.get("phases") or {}).items():
+        old_ms = (row or {}).get("mean_ms")
+        new_ms = ((current.get("phases") or {}).get(phase) or {}).get("mean_ms")
+        if not old_ms or not new_ms:
+            continue
+        growth = new_ms / old_ms - 1.0
+        if growth > tol:
+            msg = (
+                f"phase {phase!r} slowed {growth:+.1%}: {new_ms:.2f} ms vs "
+                f"banked {old_ms:.2f} ms"
+            )
+            (failures if strict_phases else warnings).append(msg)
+    return failures, warnings
+
+
+def load_record(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_record(record, path: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# measurement
+
+
+def tiny_config(batch_size: int = 2, image_size: int = 64, backend: str = "auto",
+                steps_per_dispatch: int = 1):
+    """The trimmed-budget profile config: same shape family the fast test
+    tier compiles (64x64 synthetic, pre_nms 128 / post_nms 32 / n_sample
+    8), so a committed CPU record prices the exact graphs CI exercises."""
+    from replication_faster_rcnn_tpu.config import (
+        DataConfig,
+        FasterRCNNConfig,
+        MeshConfig,
+        ModelConfig,
+        ProposalConfig,
+        ROITargetConfig,
+        TrainConfig,
+    )
+
+    return FasterRCNNConfig(
+        model=ModelConfig(
+            backbone="resnet18", roi_op="align", compute_dtype="float32"
+        ),
+        data=DataConfig(
+            dataset="synthetic", image_size=(image_size, image_size), max_boxes=8
+        ),
+        train=TrainConfig(
+            batch_size=batch_size,
+            n_epoch=4,
+            backend=backend,
+            steps_per_dispatch=steps_per_dispatch,
+        ),
+        mesh=MeshConfig(num_data=1),
+        proposals=ProposalConfig(pre_nms_train=128, post_nms_train=32),
+        roi_targets=ROITargetConfig(n_sample=8),
+    )
+
+
+def _phase_fns(model, cfg, tx):
+    """The four jitted phase programs. fwd/grad mirror the bench's stage
+    prefixes (`benchmark._stage_breakdown`) so the two harnesses can never
+    attribute different pipelines; update/null run on materialized grads."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from replication_faster_rcnn_tpu.train.train_step import compute_losses
+
+    @jax.jit
+    def fwd_fn(state, batch):
+        rng = jax.random.fold_in(state.rng, state.step)
+        total, _ = compute_losses(
+            model, cfg, state.params, state.batch_stats, batch, rng, True
+        )
+        return total
+
+    @jax.jit
+    def grad_fn(state, batch):
+        rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            return compute_losses(
+                model, cfg, params, state.batch_stats, batch, rng, True
+            )
+
+        (total, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        return total + optax.global_norm(grads)
+
+    @jax.jit
+    def update_fn(state, grads):
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        return optax.apply_updates(state.params, updates), opt_state
+
+    @jax.jit
+    def null_fn(state, grads):
+        # dispatch + completion-sync floor: same inputs, near-empty program
+        return jax.tree_util.tree_leaves(grads)[0].ravel()[0] + jnp.float32(
+            state.step
+        )
+
+    return fwd_fn, grad_fn, update_fn, null_fn
+
+
+def profile(cfg, config_token: str, n_steps: int = 5):
+    """Measure one config's step profile; returns the record dict."""
+    import jax
+    import numpy as np  # noqa: F401 — keeps parity with bench imports
+
+    from replication_faster_rcnn_tpu.benchmark import (
+        abstract_step_inputs,
+        lowered_cost,
+    )
+    from replication_faster_rcnn_tpu.data import SyntheticDataset
+    from replication_faster_rcnn_tpu.data.loader import collate
+    from replication_faster_rcnn_tpu.telemetry.mfu import (
+        compute_mfu,
+        peak_flops_per_sec,
+    )
+    from replication_faster_rcnn_tpu.telemetry.report import phase_table
+    from replication_faster_rcnn_tpu.telemetry.spans import SpanTracer
+    from replication_faster_rcnn_tpu.train.train_step import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    batch_size = cfg.train.batch_size
+    k = max(1, cfg.train.steps_per_dispatch)
+    tx, _ = make_optimizer(cfg, steps_per_epoch=100)
+    model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
+    ds = SyntheticDataset(cfg.data, length=batch_size)
+    batch = collate([ds[i] for i in range(batch_size)])
+
+    step = make_train_step(model, cfg, tx)
+    if k > 1:
+        from replication_faster_rcnn_tpu.train.train_step import build_multi_step
+
+        step = build_multi_step(step, k)
+        batch = {key: np.stack([v] * k) for key, v in batch.items()}
+    step = jax.jit(step)
+
+    fwd_fn, grad_fn, update_fn, null_fn = _phase_fns(model, cfg, tx)
+    phase_batch = collate([ds[i] for i in range(batch_size)])
+
+    # materialized grads for the update/null programs: one grad_fn's worth
+    # of real values, shaped like params
+    grads = jax.tree_util.tree_map(lambda p: jax.numpy.ones_like(p), state.params)
+
+    tracer = SpanTracer()
+
+    def timed(name, fn, *args):
+        for _ in range(2):  # compile + stabilize, outside any span
+            out = fn(*args)
+        jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        for _ in range(n_steps):
+            with tracer.span(f"profile/{name}", cat="profile"):
+                out = fn(*args)
+                jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+
+    timed("dispatch", null_fn, state, grads)
+    timed("fwd", fwd_fn, state, phase_batch)
+    timed("grad", grad_fn, state, phase_batch)
+    timed("update", update_fn, state, grads)
+    timed("step", step, state, batch)
+
+    table = {row["name"]: row for row in phase_table(tracer.to_dict()["traceEvents"])}
+
+    def mean_ms(name):
+        row = table.get(f"profile/{name}")
+        return float(row["mean_ms"]) if row else None
+
+    dispatch_ms = mean_ms("dispatch")
+    fwd_ms = mean_ms("fwd")
+    grad_ms = mean_ms("grad")
+    update_ms = mean_ms("update")
+    step_ms = mean_ms("step") / k  # per TRAIN step under fused dispatch
+    bwd_ms = max(0.0, grad_ms - fwd_ms) if grad_ms and fwd_ms else None
+
+    images_per_sec = batch_size / (step_ms / 1e3)
+
+    # analytic per-phase cost: HloCostAnalysis of the SAME programs,
+    # lowered on abstract inputs. Safe in-process only on a non-plugin
+    # backend (the axon TPU tunnel wedges inside cost_analysis).
+    analytic = None
+    flops_per_step = None
+    if jax.default_backend() == "cpu":
+        _, state_abs, batch_abs = abstract_step_inputs(cfg, tx)
+        grads_abs = jax.tree_util.tree_map(
+            lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), state_abs.params
+        )
+        fwd_cost = lowered_cost(fwd_fn, state_abs, batch_abs)
+        grad_cost = lowered_cost(grad_fn, state_abs, batch_abs)
+        update_cost = lowered_cost(update_fn, state_abs, grads_abs)
+        analytic = {
+            "fwd": fwd_cost,
+            "bwd": {
+                key: max(0.0, grad_cost[key] - fwd_cost[key]) for key in fwd_cost
+            },
+            "update": update_cost,
+        }
+        flops_per_step = grad_cost["flops"] + update_cost["flops"]
+    else:
+        from replication_faster_rcnn_tpu.benchmark import _step_flops
+
+        flops_per_step = _step_flops(cfg, batch_size)
+
+    peak, basis = peak_flops_per_sec(jax.device_count())
+    mfu = compute_mfu(flops_per_step, images_per_sec / batch_size, peak)
+    if mfu is None or basis is None:
+        raise SystemExit(
+            "step_profile: could not derive a non-null MFU "
+            f"(flops={flops_per_step}, peak={peak}, basis={basis}) — "
+            "refusing to bank a record with an MFU hole"
+        )
+
+    dev = jax.devices()[0]
+    record = {
+        "schema": SCHEMA,
+        "config": config_token,
+        "backend": cfg.train.backend,
+        "steps_per_dispatch": k,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", None),
+        "n_dev": jax.device_count(),
+        "batch_size": batch_size,
+        "image_size": list(cfg.data.image_size),
+        "n_steps_timed": n_steps,
+        "step_ms": round(step_ms, 3),
+        "images_per_sec": round(images_per_sec, 3),
+        "phases": {
+            "dispatch": {"mean_ms": round(dispatch_ms, 3)},
+            "fwd": {"mean_ms": round(fwd_ms, 3)},
+            "bwd": {"mean_ms": round(bwd_ms, 3)},
+            "update": {"mean_ms": round(update_ms, 3)},
+        },
+        "analytic": analytic,
+        "flops_per_step": flops_per_step,
+        "mfu": round(mfu, 4),
+        "mfu_basis": basis,
+        "spans": sorted(table.values(), key=lambda r: r["name"]),
+        "measured": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    return record
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--preset",
+        default="tiny",
+        help="'tiny' (trimmed CI-shape config) or a name from config.CONFIGS",
+    )
+    p.add_argument("--batch-size", type=int, default=2)
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--backend", default="auto", choices=["auto", "spmd"])
+    p.add_argument("--steps-per-dispatch", type=int, default=1)
+    p.add_argument("--steps", type=int, default=5, help="timed reps per phase")
+    p.add_argument(
+        "--update", action="store_true", help="write/overwrite the banked record"
+    )
+    p.add_argument(
+        "--no-check", action="store_true", help="measure + print only"
+    )
+    p.add_argument(
+        "--strict-phases",
+        action="store_true",
+        help="per-phase slowdowns >tol fail too (default: warn)",
+    )
+    p.add_argument("--tol", type=float, default=DEFAULT_TOL)
+    p.add_argument("--records-dir", default=RECORDS_DIR)
+    args = p.parse_args(argv)
+
+    if args.preset == "tiny":
+        cfg = tiny_config(
+            batch_size=args.batch_size,
+            image_size=args.image_size,
+            backend=args.backend,
+            steps_per_dispatch=args.steps_per_dispatch,
+        )
+        token = f"tiny{args.image_size}b{args.batch_size}"
+    else:
+        import dataclasses
+
+        from replication_faster_rcnn_tpu.config import CONFIGS
+
+        if args.preset not in CONFIGS:
+            p.error(f"unknown preset {args.preset!r}; have {sorted(CONFIGS)}")
+        cfg = CONFIGS[args.preset]
+        cfg = cfg.replace(
+            data=dataclasses.replace(
+                cfg.data,
+                dataset="synthetic",
+                image_size=(args.image_size, args.image_size),
+            ),
+            train=dataclasses.replace(
+                cfg.train,
+                batch_size=args.batch_size,
+                backend=args.backend,
+                steps_per_dispatch=args.steps_per_dispatch,
+            ),
+        )
+        token = f"{args.preset}{args.image_size}b{args.batch_size}"
+
+    record = profile(cfg, token, n_steps=args.steps)
+    key = record_key(
+        token, record["backend"], record["platform"], record["steps_per_dispatch"]
+    )
+    path = record_path(key, args.records_dir)
+    print(json.dumps(record, indent=1, sort_keys=True))
+
+    if args.update:
+        save_record(record, path)
+        print(f"step_profile: banked {path}", file=sys.stderr)
+        return 0
+    if args.no_check:
+        return 0
+    if not os.path.exists(path):
+        print(
+            f"step_profile: no banked record at {path} — run with --update "
+            "to create one (not checking)",
+            file=sys.stderr,
+        )
+        return 0
+    failures, warnings = check_regression(
+        record, load_record(path), tol=args.tol, strict_phases=args.strict_phases
+    )
+    for w in warnings:
+        print(f"step_profile: WARN {w}", file=sys.stderr)
+    for f in failures:
+        print(f"step_profile: FAIL {f}", file=sys.stderr)
+    if failures:
+        print(
+            f"step_profile: REGRESSION vs {path} — if intentional, re-bank "
+            "with --update",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"step_profile: OK vs {path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

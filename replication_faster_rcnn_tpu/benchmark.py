@@ -119,6 +119,17 @@ def _cpu_fallback(reason: str, config=None) -> None:
         obj = json.loads(r.stdout.strip().splitlines()[-1])
         if not obj.get("value"):
             raise RuntimeError(f"fallback produced no throughput: {obj}")
+        # A fallback record must never ship "mfu": null silently again
+        # (pre-telemetry binaries did): the child derives it on-host
+        # (telemetry/mfu.py, cpu_measured_matmul basis). If it could not,
+        # keep the honest throughput line but fail the process loudly so
+        # the driver sees a broken record, not a quiet hole.
+        if obj.get("mfu") is None or not obj.get("mfu_basis"):
+            obj["mfu_error"] = "fallback child produced no MFU/basis"
+            obj["fallback_backend"] = "cpu"
+            obj["fallback_reason"] = reason
+            print(json.dumps(obj), flush=True)
+            os._exit(3)
         obj["fallback_backend"] = "cpu"
         obj["fallback_reason"] = reason
         obj["last_recorded_tpu"] = _last_recorded_tpu(
@@ -475,9 +486,11 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
     from replication_faster_rcnn_tpu.parallel import (
         make_mesh,
         shard_batch,
+        shard_stacked_batch,
         validate_parallel,
     )
     from replication_faster_rcnn_tpu.train import (
+        build_multi_step,
         create_train_state,
         make_optimizer,
         make_train_step,
@@ -546,11 +559,24 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
     batch = collate([ds[i] for i in range(batch_size)])
     device_batch = shard_batch(batch, mesh, cfg.mesh)
 
+    # fused multi-step dispatch (train.steps_per_dispatch > 1): the timed
+    # program scans K steps per jitted call. The fed/spmd paths stack the
+    # same host batch K times on a new leading axis (identical per-step
+    # work, 1/K the dispatches); the cache path pre-stages K distinct
+    # selections. `device_batch` stays single-step for the stage breakdown.
+    k = max(1, cfg.train.steps_per_dispatch)
+    timed_batch = device_batch
+    if k > 1 and not cfg.data.cache_device:
+        chunk = {kk: np.stack([v] * k) for kk, v in batch.items()}
+        timed_batch = shard_stacked_batch(chunk, mesh, cfg.mesh)
+
     if cfg.train.backend == "spmd":
         # measure the explicit shard_map backend (already jitted + donated)
         from replication_faster_rcnn_tpu.parallel import make_shard_map_train_step
 
-        step, _ = make_shard_map_train_step(cfg, tx, mesh)
+        step, _ = make_shard_map_train_step(
+            cfg, tx, mesh, steps_per_dispatch=k
+        )
     elif cfg.data.cache_device:
         # --cache-device: the timed step is the CACHED one — on-device
         # gather + flip/jitter + train step; per-step host traffic is the
@@ -568,22 +594,44 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
             len(base_ds), cache.image_hw, batch_size=batch_size, seed=0,
             hflip=cfg.data.augment_hflip, scale_range=cfg.data.augment_scale,
         )
-        sel = shard_batch(
-            sampler.selection(np.arange(batch_size) % len(base_ds)),
-            mesh, cfg.mesh,
-        )
-        cached = jax.jit(
-            make_cached_train_step(model, cfg, tx),
-            donate_argnums=(0,),
-            out_shardings=(shardings, None),
-        )
+        if k > 1:
+            from replication_faster_rcnn_tpu.data.device_cache import (
+                stack_selections,
+            )
+            from replication_faster_rcnn_tpu.train import (
+                make_cached_multi_step,
+            )
+
+            sels = stack_selections([
+                sampler.selection(
+                    (np.arange(batch_size) + i * batch_size) % len(base_ds)
+                )
+                for i in range(k)
+            ])
+            sel = shard_stacked_batch(sels, mesh, cfg.mesh)
+            cached = jax.jit(
+                make_cached_multi_step(model, cfg, tx, k),
+                donate_argnums=(0,),
+                out_shardings=(shardings, None),
+            )
+        else:
+            sel = shard_batch(
+                sampler.selection(np.arange(batch_size) % len(base_ds)),
+                mesh, cfg.mesh,
+            )
+            cached = jax.jit(
+                make_cached_train_step(model, cfg, tx),
+                donate_argnums=(0,),
+                out_shardings=(shardings, None),
+            )
 
         def step(state, _batch, _c=cached, _arrays=cache.arrays, _sel=sel):
             return _c(state, _arrays, _sel)
 
     else:
+        base_step = make_train_step(model, cfg, tx)
         step = jax.jit(
-            make_train_step(model, cfg, tx),
+            build_multi_step(base_step, k) if k > 1 else base_step,
             donate_argnums=(0,),
             out_shardings=(shardings, None),
         )
@@ -593,13 +641,17 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
     # this image returns from block_until_ready before execution finishes,
     # which inflated throughput ~100x; a host transfer genuinely waits.
     for _ in range(3):
-        state, metrics = step(state, device_batch)
+        state, metrics = step(state, timed_batch)
     jax.device_get(metrics)
 
+    # BENCH_STEPS counts TRAIN steps; a fused program runs k per dispatch,
+    # so round up to whole dispatches and report per-step throughput
     n_steps = int(os.environ.get("BENCH_STEPS", "10"))
+    n_dispatch = max(1, -(-n_steps // k))
+    n_steps = n_dispatch * k
     t0 = time.time()
-    for _ in range(n_steps):
-        state, metrics = step(state, device_batch)
+    for _ in range(n_dispatch):
+        state, metrics = step(state, timed_batch)
     jax.device_get(metrics)  # forces the whole dependency chain
     dt = time.time() - t0
     images_per_sec = n_steps * batch_size / dt
@@ -615,7 +667,7 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
         if watchdog is not None:
             watchdog.cancel()
         trace_status = _capture_trace(
-            profile_dir, step, state, device_batch,
+            profile_dir, step, state, timed_batch,
             images_per_sec=images_per_sec, metric=_METRIC,
         )
 
@@ -657,6 +709,8 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
         "mfu": round(mfu, 4) if mfu is not None else None,
         "mfu_basis": mfu_basis,
     }
+    if k > 1:
+        out["steps_per_dispatch"] = k
     if trace_status is not None:
         out["trace"] = trace_status
     if os.environ.get("BENCH_BREAKDOWN", "1") != "0":

@@ -311,6 +311,24 @@ class TrainConfig:
     # backward+update at >50% of the step (VERDICT r2 weak #2); nu and
     # the params stay float32 (nu's magnitudes underflow bf16)
     adam_mu_dtype: str = "float32"  # float32 | bfloat16
+    # fused multi-step dispatch: one jitted call trains K steps via
+    # lax.scan over K device-resident batches (train/train_step.py::
+    # build_multi_step, parallel/spmd.py), amortizing per-step Python
+    # dispatch + pytree flattening. Metrics come back stacked [K, ...];
+    # the Trainer reads them on host only at log boundaries, so async
+    # dispatch overlaps across the whole chunk. 1 = the plain per-step
+    # path (default).
+    steps_per_dispatch: int = 1
+    # dtype the gradient all-reduce rides in ("Extremely Large Minibatch
+    # SGD", arXiv:1711.04325 — half-precision gradient exchange). On the
+    # explicit shard_map backend grads are cast to this dtype BEFORE the
+    # lax.psum and de-cast for the fp32 optimizer math, halving
+    # all-reduce bytes; on the auto-partitioning backend (where XLA's
+    # all-reduces live inside the fused backward and cannot be re-dtyped
+    # from here) the summed grads take the same bf16 round-trip, keeping
+    # the two backends within bf16 rounding of each other (pre- vs
+    # post-sum quantization). float32 = off (default).
+    grad_allreduce_dtype: str = "float32"  # float32 | bfloat16
 
     def __post_init__(self):
         if self.backend not in ("auto", "spmd"):
@@ -318,6 +336,15 @@ class TrainConfig:
         if self.adam_mu_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"adam_mu_dtype must be float32|bfloat16, got {self.adam_mu_dtype!r}"
+            )
+        if self.grad_allreduce_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                "grad_allreduce_dtype must be float32|bfloat16, got "
+                f"{self.grad_allreduce_dtype!r}"
+            )
+        if self.steps_per_dispatch < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, got {self.steps_per_dispatch}"
             )
 
 

@@ -217,6 +217,16 @@ class CachedSampler:
             yield self.selection(order[i : i + bs])
 
 
+def stack_selections(sels) -> Dict[str, np.ndarray]:
+    """Stack K per-step selection dicts into one [K, B, ...] chunk for the
+    fused multi-step dispatch (`train/train_step.py::make_cached_multi_step`
+    scans over the leading axis). All selections must carry the same keys —
+    they come from one `CachedSampler`, so they do."""
+    if not sels:
+        raise ValueError("stack_selections needs at least one selection")
+    return {k: np.stack([s[k] for s in sels]) for k in sels[0]}
+
+
 def materialize_batch(
     cache: Dict[str, jax.Array], sel: Dict[str, jax.Array]
 ) -> Dict[str, jax.Array]:

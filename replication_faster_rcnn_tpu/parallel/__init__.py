@@ -8,6 +8,8 @@ from replication_faster_rcnn_tpu.parallel.mesh import (  # noqa: F401
     replicate_tree,
     replicated,
     shard_batch,
+    shard_stacked_batch,
+    stacked_batch_sharding,
     validate_parallel,
     validate_spatial,
 )

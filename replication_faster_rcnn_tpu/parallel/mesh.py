@@ -174,6 +174,35 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def stacked_batch_sharding(mesh: Mesh, cfg: MeshConfig) -> NamedSharding:
+    """Sharding for a fused-dispatch chunk stacked [K, B, ...]: the NEW
+    leading step axis is replicated (every chip runs all K fused steps),
+    the axis-1 batch dim shards over the data axis."""
+    return NamedSharding(mesh, P(None, cfg.data_axis))
+
+
+def shard_stacked_batch(
+    batch: Dict[str, np.ndarray], mesh: Mesh, cfg: MeshConfig
+) -> Dict[str, jax.Array]:
+    """`shard_batch` for a K-step fused-dispatch chunk: host arrays are
+    stacked [K, B, ...] (K per-step batches or device-cache selections),
+    so the batch dim to shard is axis 1, not the leading axis. Image
+    tensors additionally shard rows (now axis 2) over the model axis when
+    spatial partitioning is on."""
+    sharding = stacked_batch_sharding(mesh, cfg)
+    if cfg.spatial and mesh.shape[cfg.model_axis] > 1:
+        img_sharding = NamedSharding(
+            mesh, P(None, cfg.data_axis, cfg.model_axis)
+        )
+    else:
+        img_sharding = sharding
+
+    def put(k: str, x: np.ndarray) -> jax.Array:
+        return jax.device_put(x, img_sharding if k == "image" else sharding)
+
+    return {k: put(k, v) for k, v in batch.items()}
+
+
 def shard_batch(
     batch: Dict[str, np.ndarray], mesh: Mesh, cfg: MeshConfig
 ) -> Dict[str, jax.Array]:

@@ -63,17 +63,33 @@ Array = jnp.ndarray
 
 
 def make_shard_map_train_step(
-    config: FasterRCNNConfig, tx: optax.GradientTransformation, mesh: Mesh
+    config: FasterRCNNConfig,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    steps_per_dispatch: int = 1,
 ):
     """Build the explicitly-collectivized (state, batch) -> (state, metrics)
     step. State must be replicated on ``mesh``; batch arrays sharded on
     their leading dim over the data axis (`parallel.shard_batch`).
+
+    ``steps_per_dispatch`` > 1 fuses K steps into the one shard_map call:
+    the per-shard body `lax.scan`s over batches stacked on a NEW leading
+    [K] axis (shard with `parallel.shard_stacked_batch` — the batch dim is
+    then axis 1), psum'ing grads/metrics every fused step; metrics return
+    stacked [K, ...]. The carry state never leaves the program between the
+    fused steps — one dispatch, K updates.
+
+    ``config.train.grad_allreduce_dtype`` = "bfloat16" casts the gradient
+    tree to bf16 BEFORE the psum — THE all-reduce then moves half the
+    bytes — and de-casts for the fp32 optimizer math (arXiv:1711.04325's
+    half-precision gradient exchange).
 
     Returns (step_fn, model): the model is constructed with sync-BN bound
     to the data axis; its parameter tree is identical to the default
     model's, so states are interchangeable between the two backends.
     """
     axis = config.mesh.data_axis
+    allreduce_dt = jnp.dtype(config.train.grad_allreduce_dtype)
     # sync-BN binds batch statistics to the data axis; GroupNorm is
     # per-sample and needs no axis (the config layer rejects the combo)
     cfg = config.replace(
@@ -104,8 +120,23 @@ def make_shard_map_train_step(
         )(state.params)
 
         # THE allreduce: local grads of (local numerator / global normalizer)
-        # sum to the global gradient.
-        grads = jax.lax.psum(grads, axis)
+        # sum to the global gradient. grad_allreduce_dtype=bfloat16 halves
+        # the bytes this collective moves; the de-cast right after keeps
+        # the optimizer math in the params' fp32.
+        if allreduce_dt != jnp.float32:
+            dtypes = jax.tree_util.tree_map(lambda g: g.dtype, grads)
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(allreduce_dt)
+                if jnp.issubdtype(g.dtype, jnp.floating)
+                else g,
+                grads,
+            )
+            grads = jax.lax.psum(grads, axis)
+            grads = jax.tree_util.tree_map(
+                lambda g, dt: g.astype(dt), grads, dtypes
+            )
+        else:
+            grads = jax.lax.psum(grads, axis)
         # loss/count metrics are local-contribution / global-normalizer (or
         # plain local counts), so psum yields the batch-global values.
         metrics = jax.lax.psum(metrics, axis)
@@ -123,10 +154,29 @@ def make_shard_map_train_step(
         )
         return new_state, metrics
 
+    if steps_per_dispatch > 1:
+        # fused K-step body: scan INSIDE the shard_map so the psums run
+        # once per fused step while the carry state stays in-program. The
+        # stacked [K, B, ...] batch shards its axis-1 batch dim over the
+        # data axis (P(None, axis)); each scan slice is one local batch.
+        def per_shard_multi(state, batches):
+            from replication_faster_rcnn_tpu.train.train_step import (
+                fused_scan_unroll,
+            )
+
+            return jax.lax.scan(
+                per_shard, state, batches, length=steps_per_dispatch,
+                unroll=fused_scan_unroll(steps_per_dispatch),
+            )
+
+        body, batch_spec = per_shard_multi, P(None, axis)
+    else:
+        body, batch_spec = per_shard, P(axis)
+
     sharded = _shard_map(
-        per_shard,
+        body,
         mesh=mesh,
-        in_specs=(P(), P(axis)),
+        in_specs=(P(), batch_spec),
         out_specs=(P(), P()),
         **_NO_CHECK,
     )

@@ -1,8 +1,10 @@
 from replication_faster_rcnn_tpu.train import losses  # noqa: F401
 from replication_faster_rcnn_tpu.train.train_step import (  # noqa: F401
     TrainState,
+    build_multi_step,
     compute_losses,
     create_train_state,
+    make_cached_multi_step,
     make_cached_train_step,
     make_optimizer,
     make_train_step,

@@ -192,6 +192,29 @@ def compute_losses(
     return total, (metrics, mut2.get("batch_stats", {}))
 
 
+def quantize_grads(grads: Any, dtype_str: str) -> Any:
+    """Round-trip the gradient tree through ``dtype_str`` (no-op for
+    "float32").
+
+    This is the numerics of `train.grad_allreduce_dtype`: the explicit
+    shard_map backend casts before its `lax.psum` so the collective
+    itself moves half the bytes (`parallel/spmd.py`); under jit
+    auto-partitioning the all-reduces are fused inside the backward where
+    their dtype cannot be chosen from here, so the same quantization is
+    applied to the summed grads — both backends then apply the optimizer
+    to identically-rounded gradients.
+    """
+    if dtype_str == "float32":
+        return grads
+    dt = jnp.dtype(dtype_str)
+    return jax.tree_util.tree_map(
+        lambda g: g.astype(dt).astype(g.dtype)
+        if jnp.issubdtype(g.dtype, jnp.floating)
+        else g,
+        grads,
+    )
+
+
 def make_train_step(
     model: FasterRCNN,
     config: FasterRCNNConfig,
@@ -214,6 +237,7 @@ def make_train_step(
         (_, (metrics, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
+        grads = quantize_grads(grads, config.train.grad_allreduce_dtype)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
@@ -257,6 +281,79 @@ def make_cached_train_step(
         return base(state, materialize_batch(cache, sel))
 
     return cached_step
+
+
+def fused_scan_unroll(k: int) -> int:
+    """Unroll factor for the fused multi-step `lax.scan`.
+
+    XLA:CPU compiles a while-loop body without the top-level conv/fusion
+    treatment — measured 4.5x slower per step than the same step outside
+    the loop — so on CPU the scan is fully unrolled into straight-line
+    code (compile time grows ~linearly with k). On TPU the loop body
+    compiles at full quality and the compact scan keeps the executable
+    small and the (tunnel-fragile) compile short, so it stays a real loop.
+    """
+    return k if jax.default_backend() == "cpu" else 1
+
+
+def build_multi_step(step_fn, k: int):
+    """Fuse ``k`` steps of a (state, batch) -> (state, metrics) step into
+    ONE jittable call via `lax.scan` over batches stacked on a new leading
+    [K] axis.
+
+    One dispatch then trains k steps: the carry (TrainState) stays on
+    device between the fused iterations (donate it when jitting) and the
+    per-step metrics come back stacked [K, ...], read by the host only at
+    log boundaries. The scan body IS the single step — same fold_in(rng,
+    step) keying, same optimizer — so a fused run is step-for-step
+    identical to k sequential dispatches (pinned by
+    tests/test_multi_step.py).
+    """
+    if k < 1:
+        raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
+
+    def multi_step(state: TrainState, batches: Dict[str, Array]):
+        def body(s, b):
+            return step_fn(s, b)
+
+        return jax.lax.scan(
+            body, state, batches, length=k, unroll=fused_scan_unroll(k)
+        )
+
+    return multi_step
+
+
+def make_cached_multi_step(
+    model: FasterRCNN,
+    config: FasterRCNNConfig,
+    tx: optax.GradientTransformation,
+    k: int,
+):
+    """Fused device-cache variant: (state, cache, sels) -> (state, metrics)
+    where ``sels`` holds k per-step selections stacked to [K, B, ...]
+    (`data.device_cache.stack_selections`). Each scan iteration gathers +
+    augments its batch from the cache and trains one step; the host ships
+    only the stacked selection bytes per k steps.
+
+    Jit with donate_argnums=(0,) ONLY — the cache must NOT be donated.
+    """
+    if k < 1:
+        raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
+    base = make_train_step(model, config, tx)
+
+    def fused(state: TrainState, cache: Dict[str, Array], sels: Dict[str, Array]):
+        from replication_faster_rcnn_tpu.data.device_cache import (
+            materialize_batch,
+        )
+
+        def body(s, sel):
+            return base(s, materialize_batch(cache, sel))
+
+        return jax.lax.scan(
+            body, state, sels, length=k, unroll=fused_scan_unroll(k)
+        )
+
+    return fused
 
 
 def make_optimizer(config: FasterRCNNConfig, steps_per_epoch: int):

@@ -31,11 +31,14 @@ from replication_faster_rcnn_tpu.parallel import (
     gather_replicated,
     replicate_tree,
     shard_batch,
+    shard_stacked_batch,
     validate_parallel,
 )
 from replication_faster_rcnn_tpu.train.train_step import (
     TrainState,
+    build_multi_step,
     create_train_state,
+    make_cached_multi_step,
     make_optimizer,
     make_train_step,
 )
@@ -237,6 +240,37 @@ class Trainer:
                 donate_argnums=(0,),
                 out_shardings=(self._state_shardings, None),
             )
+        # fused multi-step dispatch (train.steps_per_dispatch > 1): one
+        # jitted call trains K steps via lax.scan (train_chunk). The plain
+        # per-step function above stays — jit compiles lazily, so it only
+        # costs a compile if an epoch tail (steps_per_epoch % K != 0) or a
+        # direct train_one_batch caller actually runs it.
+        self.steps_per_dispatch = max(1, config.train.steps_per_dispatch)
+        self.jitted_multi_step = None
+        if self.steps_per_dispatch > 1:
+            k = self.steps_per_dispatch
+            if config.train.backend == "spmd":
+                from replication_faster_rcnn_tpu.parallel import (
+                    make_shard_map_train_step,
+                )
+
+                self.jitted_multi_step, _ = make_shard_map_train_step(
+                    config, self.tx, self.mesh, steps_per_dispatch=k
+                )
+            elif config.data.cache_device:
+                self.jitted_multi_step = jax.jit(
+                    make_cached_multi_step(self.model, config, self.tx, k),
+                    donate_argnums=(0,),
+                    out_shardings=(self._state_shardings, None),
+                )
+            else:
+                self.jitted_multi_step = jax.jit(
+                    build_multi_step(
+                        make_train_step(self.model, config, self.tx), k
+                    ),
+                    donate_argnums=(0,),
+                    out_shardings=(self._state_shardings, None),
+                )
         self._ckpt_mgr = None
 
     # ---------------------------------------------------------- checkpoints
@@ -351,6 +385,51 @@ class Trainer:
             self.state, metrics = self.jitted_step(self.state, device_batch)
         return metrics
 
+    def train_chunk(self, batches) -> Dict[str, np.ndarray]:
+        """Train ``len(batches)`` steps in ONE fused jitted dispatch.
+
+        ``batches`` must hold exactly ``steps_per_dispatch`` host batches
+        (selection dicts in --cache-device mode) — the fused program was
+        compiled for that K. Returns stacked [K, ...] metrics, still on
+        device: callers sync them only at log boundaries so the whole
+        chunk's dispatch overlaps device compute.
+        """
+        k = len(batches)
+        if k != self.steps_per_dispatch:
+            raise ValueError(
+                f"train_chunk got {k} batches; the fused step was compiled "
+                f"for steps_per_dispatch={self.steps_per_dispatch}"
+            )
+        tracer = self.tracer
+        if self.device_cache is not None:
+            from replication_faster_rcnn_tpu.data.device_cache import (
+                stack_selections,
+            )
+
+            with tracer.span(
+                "data/device_put", cat="data", feed="device_cache", steps=k
+            ):
+                sels = shard_stacked_batch(
+                    stack_selections(batches), self.mesh, self.config.mesh
+                )
+            with tracer.span("step/dispatch", cat="step", steps=k):
+                self.state, metrics = self.jitted_multi_step(
+                    self.state, self.device_cache.arrays, sels
+                )
+            return metrics
+        stacked = {
+            key: np.stack([b[key] for b in batches]) for key in batches[0]
+        }
+        with tracer.span("data/device_put", cat="data", feed="loader", steps=k):
+            device_chunk = shard_stacked_batch(
+                stacked, self.mesh, self.config.mesh
+            )
+        with tracer.span("step/dispatch", cat="step", steps=k):
+            self.state, metrics = self.jitted_multi_step(
+                self.state, device_chunk
+            )
+        return metrics
+
     def flush_telemetry(self) -> None:
         """Write the trace file and stop the watchdog. Called by the CLI's
         bounded --steps mode, which drives :meth:`train_one_batch` directly
@@ -405,11 +484,13 @@ class Trainer:
                 )
             self.watchdog.start()
         try:
+            k = self.steps_per_dispatch
             for epoch in range(start_epoch, cfg.n_epoch):
                 feed.set_epoch(epoch)
                 t_epoch = time.time()
                 n_images = 0
                 it = iter(feed)
+                chunk = []  # pending batches of a partially-filled dispatch
                 while True:
                     # the fetch span covers host-side batch production
                     # (decode/collate or selection draw) — the feed half of
@@ -419,6 +500,35 @@ class Trainer:
                             batch = next(it)
                         except StopIteration:
                             break
+                    if k > 1:
+                        chunk.append(batch)
+                        if len(chunk) < k:
+                            continue
+                        metrics = self.train_chunk(chunk)
+                        first = step + 1
+                        step += k
+                        n_images += sum(
+                            b["idx" if "idx" in b else "image"].shape[0]
+                            for b in chunk
+                        )
+                        chunk = []
+                        if self.watchdog is not None:
+                            self.watchdog.beat(step=step, phase="train")
+                        # chunk-aware log cadence: sync the stacked [K]
+                        # metrics only when a log boundary falls inside
+                        # this chunk, and log that boundary's own row
+                        boundary = (step // log_every) * log_every
+                        if boundary >= first:
+                            with tracer.span("step/sync", cat="sync"):
+                                host_metrics = jax.device_get(metrics)
+                            row = {
+                                key: v[boundary - first]
+                                for key, v in host_metrics.items()
+                            }
+                            last = finite_or_raise(row, boundary)
+                            last["lr"] = float(self.schedule(boundary))
+                            self.logger.log(boundary, last)
+                        continue
                     metrics = self.train_one_batch(batch)
                     n_images += batch["idx" if "idx" in batch else "image"].shape[0]
                     step += 1
@@ -429,6 +539,21 @@ class Trainer:
                         # (SURVEY.md §5 sanitizers; utils/debug.py) — the sync
                         # span is where async dispatch drains, i.e. device
                         # compute time for the interval
+                        with tracer.span("step/sync", cat="sync"):
+                            host_metrics = jax.device_get(metrics)
+                        last = finite_or_raise(host_metrics, step)
+                        last["lr"] = float(self.schedule(step))
+                        self.logger.log(step, last)
+                # epoch tail: a feed length not divisible by K leaves <K
+                # batches pending — run them through the per-step path
+                # (its jit compiles lazily, only when a tail exists)
+                for batch in chunk:
+                    metrics = self.train_one_batch(batch)
+                    n_images += batch["idx" if "idx" in batch else "image"].shape[0]
+                    step += 1
+                    if self.watchdog is not None:
+                        self.watchdog.beat(step=step, phase="train")
+                    if step % log_every == 0:
                         with tracer.span("step/sync", cat="sync"):
                             host_metrics = jax.device_get(metrics)
                         last = finite_or_raise(host_metrics, step)

@@ -1,0 +1,118 @@
+"""Step-profile harness machinery (`benchmarks/step_profile.py`): the
+record identity and the >15% regression gate, exercised on synthetic
+records — no compiles, no timing, so the checks are deterministic and
+fast-tier cheap. The committed CPU records under benchmarks/records/
+are validated for shape here too (non-null MFU + basis is a PR-2
+acceptance criterion)."""
+
+import glob
+import importlib.util
+import json
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "step_profile", os.path.join(_REPO, "benchmarks", "step_profile.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+sp = _load()
+
+
+def _rec(images_per_sec=10.0, phases=None, schema=None):
+    return {
+        "schema": schema or sp.SCHEMA,
+        "images_per_sec": images_per_sec,
+        "phases": phases
+        or {
+            "dispatch": {"mean_ms": 2.0},
+            "fwd": {"mean_ms": 40.0},
+            "bwd": {"mean_ms": 80.0},
+            "update": {"mean_ms": 5.0},
+        },
+    }
+
+
+class TestRecordKey:
+    def test_key_distinguishes_backend_platform_and_k(self):
+        base = sp.record_key("tiny64b2", "auto", "cpu")
+        assert base == "tiny64b2_auto_cpu"
+        assert sp.record_key("tiny64b2", "spmd", "cpu") != base
+        assert sp.record_key("tiny64b2", "auto", "tpu") != base
+        assert sp.record_key("tiny64b2", "auto", "cpu", k=8) == base + "_k8"
+        assert sp.record_key("tiny64b2", "auto", "cpu", k=1) == base
+
+    def test_record_path_under_records_dir(self):
+        p = sp.record_path("tiny64b2_auto_cpu", "/tmp/records")
+        assert p == "/tmp/records/step_profile_tiny64b2_auto_cpu.json"
+
+
+class TestRegressionGate:
+    def test_within_tolerance_passes(self):
+        fails, _ = sp.check_regression(_rec(9.0), _rec(10.0))  # -10%
+        assert fails == []
+
+    def test_throughput_drop_beyond_tol_fails(self):
+        fails, _ = sp.check_regression(_rec(8.0), _rec(10.0))  # -20%
+        assert len(fails) == 1 and "images_per_sec" in fails[0]
+
+    def test_improvement_never_fails(self):
+        fails, warns = sp.check_regression(_rec(20.0), _rec(10.0))
+        assert fails == [] and warns == []
+
+    def test_slipping_inside_tol_warns(self):
+        _, warns = sp.check_regression(_rec(9.1), _rec(10.0))  # -9%
+        assert any("slipping" in w for w in warns)
+
+    def test_phase_slowdown_warns_by_default_fails_strict(self):
+        slow_bwd = _rec(
+            phases={
+                "dispatch": {"mean_ms": 2.0},
+                "fwd": {"mean_ms": 40.0},
+                "bwd": {"mean_ms": 100.0},  # +25%
+                "update": {"mean_ms": 5.0},
+            }
+        )
+        fails, warns = sp.check_regression(slow_bwd, _rec())
+        assert fails == [] and any("bwd" in w for w in warns)
+        fails, _ = sp.check_regression(slow_bwd, _rec(), strict_phases=True)
+        assert any("bwd" in f for f in fails)
+
+    def test_unknown_schema_skips_comparison(self):
+        fails, warns = sp.check_regression(_rec(1.0), _rec(schema="other/v9"))
+        assert fails == [] and any("schema" in w for w in warns)
+
+    def test_missing_phase_rows_are_tolerated(self):
+        banked = _rec()
+        banked["phases"]["fwd"] = {}
+        current = _rec(9.5)
+        del current["phases"]["update"]
+        fails, _ = sp.check_regression(current, banked)
+        assert fails == []
+
+
+class TestCommittedRecords:
+    def test_committed_records_carry_mfu_and_phases(self):
+        """Every committed step-profile record must have the PR-2
+        acceptance shape: non-null MFU + basis and the 4-phase
+        breakdown. An MFU hole in a committed record is the exact bug
+        this PR fixes — never let one back in."""
+        paths = glob.glob(
+            os.path.join(_REPO, "benchmarks", "records", "step_profile_*.json")
+        )
+        assert paths, "no committed step-profile record (PR-2 acceptance)"
+        for path in paths:
+            with open(path) as f:
+                rec = json.load(f)
+            assert rec["schema"] == sp.SCHEMA, path
+            assert rec["mfu"] is not None and rec["mfu"] > 0, path
+            assert rec["mfu_basis"] in ("cpu_measured_matmul", "tpu_datasheet"), path
+            for phase in ("dispatch", "fwd", "bwd", "update"):
+                assert rec["phases"][phase]["mean_ms"] is not None, (path, phase)
+            assert rec["images_per_sec"] > 0, path
