@@ -86,6 +86,10 @@ def _build_config(args):
         train_kw["steps_per_dispatch"] = args.steps_per_dispatch
     if getattr(args, "grad_allreduce_dtype", None):
         train_kw["grad_allreduce_dtype"] = args.grad_allreduce_dtype
+    if getattr(args, "nonfinite_policy", None):
+        train_kw["nonfinite_policy"] = args.nonfinite_policy
+    if getattr(args, "max_consecutive_skips", None) is not None:
+        train_kw["max_consecutive_skips"] = args.max_consecutive_skips
     if train_kw:
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **train_kw))
     if (args.backbone or args.roi_op or getattr(args, "remat", False)
@@ -174,6 +178,17 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="dtype the gradient all-reduce rides in; "
                         "bfloat16 halves the psum bytes on the shard_map "
                         "backend and de-casts for fp32 optimizer math")
+    p.add_argument("--nonfinite-policy", default=None,
+                   choices=[None, "apply", "skip", "halt"],
+                   help="what the jitted step does with a non-finite "
+                        "gradient: skip (default) withholds the update "
+                        "(params/opt state/BN stats unchanged, skipped=1 "
+                        "in metrics), halt raises on the first skip, "
+                        "apply is the unguarded update")
+    p.add_argument("--max-consecutive-skips", type=int, default=None,
+                   help="consecutive nonfinite-gradient skips before "
+                        "training raises instead of free-running on a "
+                        "divergent model (nonfinite-policy=skip)")
     p.add_argument("--loader-workers", type=int, default=None,
                    help="host input-pipeline worker count")
     p.add_argument("--loader-mode", default=None,
@@ -235,6 +250,13 @@ def cmd_train(args) -> int:
         trainer.load_pretrained_backbone(args.pretrained_backbone)
     from replication_faster_rcnn_tpu.utils.profiling import trace
 
+    from replication_faster_rcnn_tpu.train.fault import (
+        EXIT_PREEMPTED,
+        GracefulShutdown,
+        Preempted,
+        check_step_metrics,
+    )
+
     if args.steps:
         # bounded-step mode (smoke/CI): iterate the feed cyclically
         # (the index sampler in --cache-device mode, the loader otherwise)
@@ -242,52 +264,83 @@ def cmd_train(args) -> int:
 
         feed = trainer.sampler if trainer.device_cache is not None else trainer.loader
         it = itertools.cycle(iter(feed))
-        if trainer.watchdog is not None:
-            trainer.watchdog.start()
+
+        # honor --resume here too: the preemption message tells the user to
+        # restart with it, and bounded-step runs are preemptible as well.
+        # --steps N is a global-step target, so a resumed run does the rest.
+        start = trainer.restore() if args.resume else 0
+        if start:
+            print(f"resumed from checkpoint at step {start}", file=sys.stderr)
 
         def _log(i, metrics, row=None):
             import jax
-
-            from replication_faster_rcnn_tpu.utils.debug import finite_or_raise
 
             with trainer.tracer.span("step/sync", cat="sync"):
                 host_metrics = jax.device_get(metrics)
             if row is not None:
                 host_metrics = {k: v[row] for k, v in host_metrics.items()}
-            trainer.logger.log(i, finite_or_raise(host_metrics, i))
+            trainer.logger.log(i, check_step_metrics(host_metrics, i))
+            trainer.skip_monitor.drain()
 
         k = trainer.steps_per_dispatch
         log_every = max(1, args.log_every)
         try:
-            with trace(args.profile):
-                done = 0
-                while done < args.steps:
-                    # full chunks ride the fused dispatch; a remainder
-                    # shorter than K falls back to the per-step path
-                    fused = k > 1 and args.steps - done >= k
-                    take = k if fused else 1
-                    with trainer.tracer.span("data/fetch", cat="data"):
-                        batches = [next(it) for _ in range(take)]
-                    if fused:
-                        metrics = trainer.train_chunk(batches)
-                    else:
-                        metrics = trainer.train_one_batch(batches[0])
-                    if trainer.watchdog is not None:
-                        trainer.watchdog.beat(step=done + take, phase="train")
-                    # same cadence as the per-step loop: log the first
-                    # 0-indexed step i in this dispatch with i % log_every
-                    # == 0 (chunk-aware: index into the stacked metrics)
-                    for i in range(done, done + take):
-                        if i % log_every == 0:
-                            _log(i, metrics, row=(i - done) if fused else None)
-                            break
-                    done += take
-        finally:
-            trainer.flush_telemetry()
+            with trainer.telemetry_session(), GracefulShutdown() as shutdown:
+                with trace(args.profile):
+                    done = start
+                    while done < args.steps:
+                        # full chunks ride the fused dispatch; a remainder
+                        # shorter than K falls back to the per-step path
+                        fused = k > 1 and args.steps - done >= k
+                        take = k if fused else 1
+                        with trainer.tracer.span("data/fetch", cat="data"):
+                            batches = [next(it) for _ in range(take)]
+                        if fused:
+                            metrics = trainer.train_chunk(batches)
+                        else:
+                            metrics = trainer.train_one_batch(batches[0])
+                        if trainer.watchdog is not None:
+                            trainer.watchdog.beat(step=done + take, phase="train")
+                        # same cadence as the per-step loop: log the first
+                        # 0-indexed step i in this dispatch with i % log_every
+                        # == 0 (chunk-aware: index into the stacked metrics)
+                        for i in range(done, done + take):
+                            if i % log_every == 0:
+                                _log(i, metrics, row=(i - done) if fused else None)
+                                break
+                        done += take
+                        if shutdown.requested:
+                            # same dispatch-boundary semantics as the epoch
+                            # loop: emergency checkpoint, then distinct code
+                            trainer._fault_incident(
+                                "preempted", step=done,
+                                reason=shutdown.reason or "signal",
+                            )
+                            trainer.save(kind="emergency")
+                            raise Preempted(done, shutdown.reason or "signal")
+                    trainer.skip_monitor.drain()
+        except Preempted as p:
+            print(f"{p} (exit {EXIT_PREEMPTED})", file=sys.stderr)
+            return EXIT_PREEMPTED
         return 0
-    with trace(args.profile):
-        trainer.train(resume=args.resume, log_every=args.log_every)
-    trainer.save()
+    try:
+        with trace(args.profile):
+            trainer.train(resume=args.resume, log_every=args.log_every)
+    except Preempted as p:
+        print(f"{p} (exit {EXIT_PREEMPTED})", file=sys.stderr)
+        return EXIT_PREEMPTED
+    except BaseException as e:
+        if args.on_crash_checkpoint:
+            # best-effort: persist whatever state survived the crash; the
+            # manifest tags it kind="crash" so restore tooling can tell
+            print(
+                f"crash ({type(e).__name__}); attempting --on-crash-checkpoint "
+                "save",
+                file=sys.stderr,
+            )
+            trainer.save(kind="crash", required=False)
+        raise
+    trainer.save(kind="final")
     return 0
 
 
@@ -343,6 +396,7 @@ def cmd_bench(args) -> int:
             args.num_model, args.backend, args.mu_dtype, args.loader_workers,
             args.loader_mode, args.augment_scale, args.norm,
             args.steps_per_dispatch, args.grad_allreduce_dtype,
+            args.nonfinite_policy, args.max_consecutive_skips,
         )
     ) or (
         args.spatial or args.remat or args.shard_opt or args.augment_hflip
@@ -465,6 +519,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          help="seconds without step progress before the "
                               "telemetry watchdog records a stall snapshot "
                               "(needs --telemetry)")
+    p_train.add_argument("--on-crash-checkpoint", action="store_true",
+                         help="on an unhandled training crash, best-effort "
+                              "save a checkpoint (manifest kind 'crash') "
+                              "before re-raising; SIGTERM/SIGINT preemption "
+                              "always emergency-saves and exits 75")
     p_train.add_argument("--debug-nans", action="store_true",
                          help="enable jax_debug_nans (every jit output "
                               "checked; errors pinpoint the emitting op)")

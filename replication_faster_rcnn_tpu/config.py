@@ -329,6 +329,19 @@ class TrainConfig:
     # the two backends within bf16 rounding of each other (pre- vs
     # post-sum quantization). float32 = off (default).
     grad_allreduce_dtype: str = "float32"  # float32 | bfloat16
+    # what the jitted step does with a non-finite gradient tree
+    # (train/fault.py::guarded_update): "skip" (default) withholds the
+    # optimizer update — params, Adam moments and BN stats carry through
+    # bit-identical, the step's metrics carry skipped=1 — so one poisoned
+    # batch costs one step instead of NaN'ing Adam's moments for the rest
+    # of the run; "halt" gates the same way but the trainer raises on the
+    # first skip; "apply" is the unguarded pre-fault-tolerance behavior.
+    nonfinite_policy: str = "skip"  # apply | skip | halt
+    # consecutive skipped steps before the trainer raises a descriptive
+    # error (and records a watchdog incident) instead of free-running on
+    # a divergent model: transients cost 1-2 steps, persistent NaNs are
+    # a bug to surface, not ride through.
+    max_consecutive_skips: int = 10
 
     def __post_init__(self):
         if self.backend not in ("auto", "spmd"):
@@ -345,6 +358,16 @@ class TrainConfig:
         if self.steps_per_dispatch < 1:
             raise ValueError(
                 f"steps_per_dispatch must be >= 1, got {self.steps_per_dispatch}"
+            )
+        if self.nonfinite_policy not in ("apply", "skip", "halt"):
+            raise ValueError(
+                "nonfinite_policy must be apply|skip|halt, got "
+                f"{self.nonfinite_policy!r}"
+            )
+        if self.max_consecutive_skips < 1:
+            raise ValueError(
+                "max_consecutive_skips must be >= 1, got "
+                f"{self.max_consecutive_skips}"
             )
 
 

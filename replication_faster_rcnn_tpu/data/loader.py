@@ -40,20 +40,72 @@ def collate(samples: Sequence[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
     return {k: np.stack([s[k] for s in samples]) for k in samples[0]}
 
 
-def _mp_worker(dataset, task_q, result_q) -> None:
+def fetch_sample(ds, idx: int, on_skip=None):
+    """``ds[idx]`` with fault containment: one retry (truncated reads and
+    NFS hiccups are transient), then deterministic substitution by the
+    nearest following index that decodes — one rotten JPEG two hours into
+    an epoch must cost one sample, not the run.
+
+    ``on_skip(idx, exc)`` is called once per abandoned index (after the
+    failed retry) and may raise to enforce a skip budget — substitution
+    without a cap would silently train on a collapsing dataset. With no
+    ``on_skip`` the substitution is unbudgeted. Raises the last error only
+    if every index in the dataset fails.
+    """
+    try:
+        return ds[int(idx)]
+    except Exception:
+        try:
+            return ds[int(idx)]  # the one retry
+        except Exception as exc:
+            if on_skip is not None:
+                on_skip(int(idx), exc)
+            n = len(ds)
+            for delta in range(1, n):
+                j = (int(idx) + delta) % n
+                try:
+                    return ds[j]
+                except Exception:
+                    continue
+            raise
+
+
+def _mp_worker(dataset, task_q, result_q, skip_budget: int = 0) -> None:
     """Worker-process loop: build collated batches for index lists.
 
     Runs only dataset/numpy code — no jax, no device ops (a forked child
     must never touch the TPU tunnel). Errors are shipped back as
     formatted tracebacks: exception objects aren't reliably picklable.
+
+    Failing samples get the same retry-then-substitute treatment as the
+    thread path (``fetch_sample``), with a per-worker skip budget —
+    worker counters can't be shared cheaply across processes, and since
+    workers are re-forked each epoch a per-worker cap is the per-epoch
+    cap divided by the worker count, same order of protection.
     """
+    skips = 0
+
+    def on_skip(idx, exc):
+        nonlocal skips
+        skips += 1
+        if skip_budget and skips > skip_budget:
+            raise RuntimeError(
+                f"loader worker sample-skip budget exhausted: {skips} "
+                f"failed samples (> {skip_budget}); last at index {idx}: "
+                f"{exc!r}"
+            )
+
     while True:
         item = task_q.get()
         if item is None:
             return
         seq, idxs = item
         try:
-            result_q.put((seq, collate([dataset[int(i)] for i in idxs])))
+            if skip_budget:
+                batch = collate([fetch_sample(dataset, i, on_skip) for i in idxs])
+            else:  # containment disabled
+                batch = collate([dataset[int(i)] for i in idxs])
+            result_q.put((seq, batch))
         except BaseException:  # noqa: BLE001 — report, don't kill the worker
             result_q.put((seq, ("__error__", traceback.format_exc())))
 
@@ -105,6 +157,7 @@ class DataLoader:
         augment_scale_device: bool = False,
         stall_timeout: float = 120.0,
         cache_ram: bool = False,
+        sample_skip_budget: int = 8,
     ) -> None:
         if worker_mode not in ("thread", "process"):
             raise ValueError(f"worker_mode must be thread|process, got {worker_mode!r}")
@@ -134,9 +187,41 @@ class DataLoader:
         self.worker_mode = worker_mode
         self.epoch = 0
         self._q: Optional["queue.Queue"] = None  # live prefetch queue
+        # sample fault containment (fetch_sample): failed samples are
+        # retried once then substituted, up to this many per epoch — past
+        # it the epoch errors out (a collapsing dataset must not be
+        # silently papered over). 0 disables containment entirely.
+        self.sample_skip_budget = int(sample_skip_budget)
+        self._epoch_skips = 0
+        self._skip_lock = threading.Lock()
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
+        self._epoch_skips = 0  # the skip budget is per-epoch
+
+    def _on_sample_skip(self, idx: int, exc: Exception) -> None:
+        """Budget + telemetry for one abandoned sample (thread path; pool
+        workers land here concurrently, hence the lock)."""
+        with self._skip_lock:
+            self._epoch_skips += 1
+            skips = self._epoch_skips
+        if skips > self.sample_skip_budget:
+            raise RuntimeError(
+                f"loader sample-skip budget exhausted: {skips} failed "
+                f"samples this epoch (> {self.sample_skip_budget}); last "
+                f"at index {idx}: {exc!r}"
+            )
+        import sys
+
+        print(
+            f"warning: sample {idx} failed twice, substituting neighbor "
+            f"({skips}/{self.sample_skip_budget} skips this epoch): {exc!r}",
+            file=sys.stderr,
+        )
+        tspans.current_tracer().instant(
+            "data/sample_skipped", cat="data", idx=int(idx),
+            skips=skips, error=repr(exc)[:200],
+        )
 
     def queue_depth(self) -> Optional[int]:
         """Batches currently buffered ahead of the consumer (thread-mode
@@ -192,9 +277,16 @@ class DataLoader:
         with tspans.current_tracer().span(
             "data/build", cat="data", batch=len(idxs)
         ):
+            if not self.sample_skip_budget:  # containment disabled
+                if pool is None or len(idxs) == 1:
+                    return collate([ds[int(i)] for i in idxs])
+                return collate(list(pool.map(lambda i: ds[int(i)], idxs)))
+            on_skip = self._on_sample_skip
             if pool is None or len(idxs) == 1:
-                return collate([ds[int(i)] for i in idxs])
-            return collate(list(pool.map(lambda i: ds[int(i)], idxs)))
+                return collate([fetch_sample(ds, i, on_skip) for i in idxs])
+            return collate(
+                list(pool.map(lambda i: fetch_sample(ds, i, on_skip), idxs))
+            )
 
     def _iter_processes(self) -> Iterator[Dict[str, np.ndarray]]:
         """Process-worker iteration: whole batches farmed to forked
@@ -217,7 +309,7 @@ class DataLoader:
         procs = [
             ctx.Process(
                 target=_mp_worker,
-                args=(ds, task_q, result_q),
+                args=(ds, task_q, result_q, self.sample_skip_budget),
                 daemon=True,
             )
             for _ in range(self.num_workers)

@@ -44,7 +44,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from replication_faster_rcnn_tpu.config import FasterRCNNConfig
 from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
-from replication_faster_rcnn_tpu.telemetry.health import health_metrics
+from replication_faster_rcnn_tpu.train import fault
 from replication_faster_rcnn_tpu.train.train_step import TrainState, compute_losses
 
 # jax >= 0.6 promotes shard_map to the top level and renames the
@@ -141,17 +141,15 @@ def make_shard_map_train_step(
         # plain local counts), so psum yields the batch-global values.
         metrics = jax.lax.psum(metrics, axis)
 
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        # health scalars AFTER the psum: grads are global here and params
-        # replicated, so the values match the auto-partitioned backend's
-        metrics.update(health_metrics(grads, state.params, updates))
-        new_state = state.replace(
-            step=state.step + 1,
-            params=new_params,
-            batch_stats=new_stats,  # sync-BN already pmean'd these
-            opt_state=new_opt,
+        # guarded update AFTER the psum: the nonfinite gate reads the
+        # GLOBAL gradient, so every shard takes the same branch and the
+        # replicated state stays replicated; health scalars likewise match
+        # the auto-partitioned backend's (new_stats are already sync-BN
+        # pmean'd, and carry through unchanged on a skipped step)
+        new_state, health = fault.guarded_update(
+            tx, state, grads, new_stats, config.train.nonfinite_policy
         )
+        metrics.update(health)
         return new_state, metrics
 
     if steps_per_dispatch > 1:

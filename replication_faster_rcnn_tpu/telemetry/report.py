@@ -32,6 +32,17 @@ _HEALTH_KEYS = (
     "update_norm",
     "update_ratio",
     "nonfinite_count",
+    "skipped",
+)
+
+# Incident kinds the fault layer records (train/fault.py + trainer) beyond
+# the watchdog's own stall/recovered pair.
+_FAULT_KINDS = (
+    "nonfinite_escalation",
+    "preempted",
+    "checkpoint_save_failed",
+    "checkpoint_fallback",
+    "abnormal_exit",
 )
 
 
@@ -123,6 +134,11 @@ def summarize_run(run_dir: str) -> Dict[str, Any]:
         summary["incidents"] = {
             "stalls": sum(1 for i in incidents if i.get("kind") == "stall"),
             "recoveries": sum(1 for i in incidents if i.get("kind") == "recovered"),
+            "faults": {
+                kind: n
+                for kind in _FAULT_KINDS
+                if (n := sum(1 for i in incidents if i.get("kind") == kind))
+            },
             "events": incidents,
         }
     progress_path = os.path.join(run_dir, PROGRESS_FILE)
@@ -183,6 +199,8 @@ def format_report(summary: Dict[str, Any]) -> str:
             f"watchdog: {incidents['stalls']} stall(s), "
             f"{incidents['recoveries']} recovery(ies)"
         )
+        for kind, n in incidents.get("faults", {}).items():
+            lines.append(f"  fault incidents: {n}x {kind}")
         for ev in incidents["events"]:
             if ev.get("kind") != "stall":
                 continue

@@ -153,6 +153,21 @@ class StallWatchdog:
 
     # -- diagnostics -------------------------------------------------------
 
+    def incident(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        """Record a non-stall incident (nonfinite-skip escalation,
+        preemption, checkpoint-save failure, abnormal exit, ...) in the
+        same JSONL stream as stall snapshots: one file answers "what went
+        wrong and where was the process when it did". ``fields`` are
+        merged over the snapshot; the snapshot's standard keys win only
+        for ``kind``."""
+        snap = self.snapshot(reason=kind)
+        for key, value in fields.items():
+            if key != "kind":
+                snap[key] = value
+        self.last_snapshot = snap
+        self._record_incident(snap)
+        return snap
+
     def snapshot(self, reason: str = "manual", elapsed_s: Optional[float] = None) -> Dict[str, Any]:
         """Diagnostic snapshot: what was the process doing, and for how
         long has it not moved."""

@@ -34,8 +34,7 @@ from replication_faster_rcnn_tpu.targets import (
     batched_anchor_targets,
     batched_proposal_targets,
 )
-from replication_faster_rcnn_tpu.telemetry.health import health_metrics
-from replication_faster_rcnn_tpu.train import losses
+from replication_faster_rcnn_tpu.train import fault, losses
 
 Array = jnp.ndarray
 
@@ -238,17 +237,14 @@ def make_train_step(
             loss_fn, has_aux=True
         )(state.params)
         grads = quantize_grads(grads, config.train.grad_allreduce_dtype)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        new_state = state.replace(
-            step=state.step + 1,
-            params=new_params,
-            batch_stats=new_stats,
-            opt_state=new_opt,
+        # guarded update: under nonfinite_policy skip|halt a gradient tree
+        # with any NaN/Inf withholds the whole update (params, opt state,
+        # BN stats carried through bit-identical) and flags skipped=1 in
+        # the health scalars, which ride the metrics transfer as before
+        new_state, health = fault.guarded_update(
+            tx, state, grads, new_stats, config.train.nonfinite_policy
         )
-        # health scalars (grad/param/update norms, update ratio, non-finite
-        # count) ride the metrics transfer — no extra device sync
-        metrics.update(health_metrics(grads, state.params, updates))
+        metrics.update(health)
         return new_state, metrics
 
     return train_step

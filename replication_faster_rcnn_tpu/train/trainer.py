@@ -14,8 +14,10 @@
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
+import sys
 import time
 from typing import Dict, Iterable, Optional
 
@@ -34,6 +36,7 @@ from replication_faster_rcnn_tpu.parallel import (
     shard_stacked_batch,
     validate_parallel,
 )
+from replication_faster_rcnn_tpu.train import fault
 from replication_faster_rcnn_tpu.train.train_step import (
     TrainState,
     build_multi_step,
@@ -44,7 +47,6 @@ from replication_faster_rcnn_tpu.train.train_step import (
 )
 from replication_faster_rcnn_tpu.telemetry import spans as tspans
 from replication_faster_rcnn_tpu.telemetry.watchdog import StallWatchdog
-from replication_faster_rcnn_tpu.utils.debug import finite_or_raise
 from replication_faster_rcnn_tpu.utils.logging import MetricLogger
 
 
@@ -66,11 +68,18 @@ def load_eval_variables(
     )
     if os.path.isdir(workdir):
         mgr = ocp.CheckpointManager(os.path.abspath(workdir))
-        s = mgr.latest_step() if step is None else step
-        if s is not None:
-            state = mgr.restore(
-                s, args=ocp.args.StandardRestore(jax.device_get(state))
-            )
+        try:
+            if mgr.all_steps():
+                # manifest-verified restore with latest-good fallback: a
+                # torn newest step must not make eval unrecoverable either
+                result = fault.verified_restore(
+                    mgr, jax.device_get(state), os.path.abspath(workdir),
+                    step=step,
+                )
+                if result.state is not None:
+                    state = result.state
+        finally:
+            mgr.close()
     return model, {"params": state.params, "batch_stats": state.batch_stats}
 
 
@@ -136,6 +145,17 @@ class Trainer:
         else:
             self.tracer = tspans.NULL_TRACER
             self.logger = MetricLogger()
+
+        # fault-tolerance plumbing (train/fault.py): consecutive-skip
+        # escalation for the guarded update's `skipped` flags, and the
+        # dispatch-boundary shutdown flag train() installs
+        self.skip_monitor = fault.SkipMonitor(
+            policy=config.train.nonfinite_policy,
+            max_consecutive=config.train.max_consecutive_skips,
+            on_escalate=self._fault_incident,
+        )
+        self._host_step = 0  # host mirror of state.step: no sync to read
+        self._shutdown: Optional[fault.GracefulShutdown] = None
 
         self.dataset = dataset if dataset is not None else make_dataset(
             config.data, "train"
@@ -307,49 +327,121 @@ class Trainer:
         """Full state on host (numpy)."""
         return jax.device_get(self._replicated_state())
 
-    def save(self, step: Optional[int] = None) -> None:
+    def _fault_incident(self, kind: str, **fields) -> None:
+        """Route a fault event to the JSONL metric stream AND the watchdog
+        incident log, so `telemetry report` and post-mortems both see it."""
+        self.logger.event(kind, **fields)
+        if self.watchdog is not None:
+            self.watchdog.incident(kind, **fields)
+
+    def save(
+        self,
+        step: Optional[int] = None,
+        kind: str = "scheduled",
+        required: Optional[bool] = None,
+    ) -> bool:
+        """Checkpoint the full state, plus a sidecar manifest (step, config
+        hash, per-leaf checksums, save ``kind``) that restore() verifies.
+
+        A ``scheduled`` (periodic) save that fails is contained: watchdog
+        incident + warning, training continues and the next interval
+        retries — a full disk mid-run should cost a checkpoint, not the
+        run. ``emergency``/``final`` saves (or ``required=True``) raise,
+        because they are the last chance to persist anything. Returns
+        True when a checkpoint for ``step`` is on disk."""
         import orbax.checkpoint as ocp
 
+        if required is None:
+            required = kind in ("emergency", "final")
         step = int(self.state.step) if step is None else step
-        if self.checkpoint_manager.latest_step() == step:
-            return  # already checkpointed (orbax raises on duplicate steps)
-        # Hand orbax the REPLICATED jax arrays, not host numpy: with
-        # jax.Array inputs orbax's replica logic makes process 0 the only
-        # writer in a multi-process run; a device_get'd numpy tree loses
-        # that information and every process tries to write the same files
-        # (observed as a deadlock inside save() in the 2-process test).
-        self.checkpoint_manager.save(
-            step, args=ocp.args.StandardSave(self._replicated_state())
-        )
-        self.checkpoint_manager.wait_until_finished()
+        try:
+            if self.checkpoint_manager.latest_step() == step:
+                return True  # already checkpointed (orbax raises on dupes)
+            # Hand orbax the REPLICATED jax arrays, not host numpy: with
+            # jax.Array inputs orbax's replica logic makes process 0 the
+            # only writer in a multi-process run; a device_get'd numpy tree
+            # loses that information and every process tries to write the
+            # same files (observed as a deadlock inside save() in the
+            # 2-process test).
+            rep_state = self._replicated_state()
+            self.checkpoint_manager.save(
+                step, args=ocp.args.StandardSave(rep_state)
+            )
+            self.checkpoint_manager.wait_until_finished()
+            if jax.process_index() == 0:
+                fault.write_manifest(
+                    self.workdir, step, jax.device_get(rep_state),
+                    self.config, kind=kind,
+                )
+                fault.prune_manifests(
+                    self.workdir, self.checkpoint_manager.all_steps()
+                )
+        except Exception as e:
+            if required:
+                raise
+            print(
+                f"warning: {kind} checkpoint at step {step} failed "
+                f"({type(e).__name__}: {e}); training continues",
+                file=sys.stderr,
+            )
+            self._fault_incident(
+                "checkpoint_save_failed",
+                step=step,
+                ckpt_kind=kind,
+                error=f"{type(e).__name__}: {e}"[:300],
+            )
+            return False
+        return True
 
     def restore(
         self, step: Optional[int] = None, directory: Optional[str] = None
     ) -> int:
-        """Exact resume: params, BN stats, optimizer state AND step.
+        """Exact resume: params, BN stats, optimizer state AND step —
+        manifest-verified, falling back to the newest verifiable step when
+        the latest is torn (fault.verified_restore). Discarded steps are
+        logged, recorded as an incident, and deleted from this trainer's
+        own store so future saves at those steps don't collide.
 
         ``directory`` restores from a different checkpoint dir WITHOUT
-        changing where this trainer saves (warm-start semantics)."""
+        changing where this trainer saves (warm-start semantics; treated
+        read-only — nothing is deleted there)."""
         import orbax.checkpoint as ocp
 
         ephemeral = directory is not None
+        dirpath = os.path.abspath(directory if ephemeral else self.workdir)
         if ephemeral:
-            mgr = ocp.CheckpointManager(os.path.abspath(directory))
+            mgr = ocp.CheckpointManager(dirpath)
         else:
             mgr = self.checkpoint_manager
         try:
-            step = mgr.latest_step() if step is None else step
-            if step is None:
+            if not mgr.all_steps():
                 return 0
             template = self._host_state()
-            restored = mgr.restore(step, args=ocp.args.StandardRestore(template))
+            result = fault.verified_restore(
+                mgr, template, dirpath, step=step
+            )
+            if result.discarded:
+                if not ephemeral:
+                    for bad_step, _ in result.discarded:
+                        try:
+                            mgr.delete(bad_step)
+                        except Exception:
+                            pass  # a torn step may resist deletion too
+                self._fault_incident(
+                    "checkpoint_fallback",
+                    restored_step=result.step,
+                    discarded={s: why for s, why in result.discarded},
+                )
         finally:
             if ephemeral:
                 mgr.close()
+        if result.state is None:
+            return 0
         from replication_faster_rcnn_tpu.parallel.zero import place_train_state
 
-        self.state = place_train_state(restored, self._state_shardings)
-        return int(self.state.step)
+        self.state = place_train_state(result.state, self._state_shardings)
+        self._host_step = int(self.state.step)
+        return self._host_step
 
     def load_pretrained_backbone(self, pth_path: str) -> None:
         """Graft a torch resnet checkpoint into trunk + head tail."""
@@ -378,11 +470,15 @@ class Trainer:
                 self.state, metrics = self.jitted_step(
                     self.state, self.device_cache.arrays, sel
                 )
-            return metrics
-        with tracer.span("data/device_put", cat="data", feed="loader"):
-            device_batch = shard_batch(batch, self.mesh, self.config.mesh)
-        with tracer.span("step/dispatch", cat="step"):
-            self.state, metrics = self.jitted_step(self.state, device_batch)
+        else:
+            with tracer.span("data/device_put", cat="data", feed="loader"):
+                device_batch = shard_batch(batch, self.mesh, self.config.mesh)
+            with tracer.span("step/dispatch", cat="step"):
+                self.state, metrics = self.jitted_step(self.state, device_batch)
+        self._host_step += 1
+        # hand the monitor this step's `skipped` flag as a DEVICE scalar —
+        # it syncs only at drain points, preserving dispatch overlap
+        self.skip_monitor.observe(self._host_step, metrics)
         return metrics
 
     def train_chunk(self, batches) -> Dict[str, np.ndarray]:
@@ -416,27 +512,71 @@ class Trainer:
                 self.state, metrics = self.jitted_multi_step(
                     self.state, self.device_cache.arrays, sels
                 )
-            return metrics
-        stacked = {
-            key: np.stack([b[key] for b in batches]) for key in batches[0]
-        }
-        with tracer.span("data/device_put", cat="data", feed="loader", steps=k):
-            device_chunk = shard_stacked_batch(
-                stacked, self.mesh, self.config.mesh
-            )
-        with tracer.span("step/dispatch", cat="step", steps=k):
-            self.state, metrics = self.jitted_multi_step(
-                self.state, device_chunk
-            )
+        else:
+            stacked = {
+                key: np.stack([b[key] for b in batches]) for key in batches[0]
+            }
+            with tracer.span(
+                "data/device_put", cat="data", feed="loader", steps=k
+            ):
+                device_chunk = shard_stacked_batch(
+                    stacked, self.mesh, self.config.mesh
+                )
+            with tracer.span("step/dispatch", cat="step", steps=k):
+                self.state, metrics = self.jitted_multi_step(
+                    self.state, device_chunk
+                )
+        first = self._host_step + 1
+        self._host_step += k
+        self.skip_monitor.observe(first, metrics)  # stacked [K] device flags
         return metrics
 
     def flush_telemetry(self) -> None:
-        """Write the trace file and stop the watchdog. Called by the CLI's
-        bounded --steps mode, which drives :meth:`train_one_batch` directly
-        and so never reaches :meth:`train`'s own flush."""
+        """Write the trace file and stop the watchdog. For callers driving
+        :meth:`train_one_batch` directly without :meth:`telemetry_session`."""
         if self.watchdog is not None:
             self.watchdog.stop()
         self.tracer.flush()
+
+    @contextlib.contextmanager
+    def telemetry_session(self):
+        """Watchdog running inside, tracer flushed + watchdog stopped on ANY
+        exit — including KeyboardInterrupt and crashes, which additionally
+        record an ``abnormal_exit`` incident so the post-mortem doesn't
+        start from a silently-truncated trace."""
+        if self.watchdog is not None:
+            if self.loader is not None:
+                self.watchdog.providers.setdefault(
+                    "loader_queue_depth", self.loader.queue_depth
+                )
+            self.watchdog.start()
+        try:
+            yield self
+        except BaseException as e:
+            if self.watchdog is not None:
+                self.watchdog.incident(
+                    "abnormal_exit", error=f"{type(e).__name__}: {e}"[:500]
+                )
+            raise
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.stop()
+            self.tracer.flush()
+
+    def _check_preemption(self, step: int) -> None:
+        """Dispatch-boundary shutdown check: on a pending SIGTERM/SIGINT,
+        save a verified emergency checkpoint, record the incident, and
+        leave via :class:`fault.Preempted` (CLI exit code EXIT_PREEMPTED)."""
+        sd = self._shutdown
+        if sd is None or not sd.requested:
+            return
+        reason = sd.reason or "signal"
+        self._fault_incident("preempted", step=step, reason=reason)
+        with self.tracer.span(
+            "checkpoint/save", cat="checkpoint", kind="emergency"
+        ):
+            self.save(kind="emergency")
+        raise fault.Preempted(step, reason)
 
     def evaluate(self, max_images: Optional[int] = None) -> Dict[str, float]:
         """mAP on the val split with the CURRENT training parameters
@@ -471,113 +611,138 @@ class Trainer:
             len(self.sampler if self.device_cache is not None else self.loader), 1
         )
         start_epoch = start_step // steps_per_epoch
+        # mid-epoch resume (emergency checkpoints land at arbitrary steps):
+        # replay the resumed epoch's already-trained prefix through the
+        # feed WITHOUT training on it — set_epoch re-derives the epoch's
+        # deterministic batch order, so skipping the first `replay` batches
+        # puts the feed exactly where the interrupted run stopped and the
+        # loss trajectory matches an uninterrupted run step-for-step
+        replay = start_step - start_epoch * steps_per_epoch
         step = start_step  # host-side mirror: no device sync to read it
+        self._host_step = start_step
 
         last: Dict[str, float] = {}
         eval_result: Dict[str, float] = {}
         feed = self.sampler if self.device_cache is not None else self.loader
         tracer = self.tracer
-        if self.watchdog is not None:
-            if self.loader is not None:
-                self.watchdog.providers.setdefault(
-                    "loader_queue_depth", self.loader.queue_depth
-                )
-            self.watchdog.start()
+        self._shutdown = fault.GracefulShutdown()
         try:
-            k = self.steps_per_dispatch
-            for epoch in range(start_epoch, cfg.n_epoch):
-                feed.set_epoch(epoch)
-                t_epoch = time.time()
-                n_images = 0
-                it = iter(feed)
-                chunk = []  # pending batches of a partially-filled dispatch
-                while True:
-                    # the fetch span covers host-side batch production
-                    # (decode/collate or selection draw) — the feed half of
-                    # the feed-vs-compute question
-                    with tracer.span("data/fetch", cat="data"):
-                        try:
-                            batch = next(it)
-                        except StopIteration:
-                            break
-                    if k > 1:
-                        chunk.append(batch)
-                        if len(chunk) < k:
+            with self.telemetry_session(), self._shutdown:
+                k = self.steps_per_dispatch
+                for epoch in range(start_epoch, cfg.n_epoch):
+                    feed.set_epoch(epoch)
+                    t_epoch = time.time()
+                    n_images = 0
+                    it = iter(feed)
+                    chunk = []  # pending batches of a partially-filled dispatch
+                    while True:
+                        # the fetch span covers host-side batch production
+                        # (decode/collate or selection draw) — the feed half
+                        # of the feed-vs-compute question
+                        with tracer.span("data/fetch", cat="data"):
+                            try:
+                                batch = next(it)
+                            except StopIteration:
+                                break
+                        if replay > 0:
+                            replay -= 1
                             continue
-                        metrics = self.train_chunk(chunk)
-                        first = step + 1
-                        step += k
-                        n_images += sum(
-                            b["idx" if "idx" in b else "image"].shape[0]
-                            for b in chunk
-                        )
-                        chunk = []
+                        if k > 1:
+                            chunk.append(batch)
+                            if len(chunk) < k:
+                                continue
+                            metrics = self.train_chunk(chunk)
+                            first = step + 1
+                            step += k
+                            n_images += sum(
+                                b["idx" if "idx" in b else "image"].shape[0]
+                                for b in chunk
+                            )
+                            chunk = []
+                            if self.watchdog is not None:
+                                self.watchdog.beat(step=step, phase="train")
+                            # chunk-aware log cadence: sync the stacked [K]
+                            # metrics only when a log boundary falls inside
+                            # this chunk, and log that boundary's own row
+                            boundary = (step // log_every) * log_every
+                            if boundary >= first:
+                                with tracer.span("step/sync", cat="sync"):
+                                    host_metrics = jax.device_get(metrics)
+                                row = {
+                                    key: v[boundary - first]
+                                    for key, v in host_metrics.items()
+                                }
+                                last = fault.check_step_metrics(row, boundary)
+                                last["lr"] = float(self.schedule(boundary))
+                                self.logger.log(boundary, last)
+                                self.skip_monitor.drain()
+                            self._check_preemption(step)
+                            continue
+                        metrics = self.train_one_batch(batch)
+                        n_images += batch[
+                            "idx" if "idx" in batch else "image"
+                        ].shape[0]
+                        step += 1
                         if self.watchdog is not None:
                             self.watchdog.beat(step=step, phase="train")
-                        # chunk-aware log cadence: sync the stacked [K]
-                        # metrics only when a log boundary falls inside
-                        # this chunk, and log that boundary's own row
-                        boundary = (step // log_every) * log_every
-                        if boundary >= first:
+                        if step % log_every == 0:
+                            # fail fast on NaN/inf instead of training on
+                            # garbage — unless the guarded update already
+                            # withheld this step (fault.check_step_metrics).
+                            # The sync span is where async dispatch drains,
+                            # i.e. device compute time for the interval
                             with tracer.span("step/sync", cat="sync"):
                                 host_metrics = jax.device_get(metrics)
-                            row = {
-                                key: v[boundary - first]
-                                for key, v in host_metrics.items()
-                            }
-                            last = finite_or_raise(row, boundary)
-                            last["lr"] = float(self.schedule(boundary))
-                            self.logger.log(boundary, last)
-                        continue
-                    metrics = self.train_one_batch(batch)
-                    n_images += batch["idx" if "idx" in batch else "image"].shape[0]
-                    step += 1
-                    if self.watchdog is not None:
-                        self.watchdog.beat(step=step, phase="train")
-                    if step % log_every == 0:
-                        # fail fast on NaN/inf instead of training on garbage
-                        # (SURVEY.md §5 sanitizers; utils/debug.py) — the sync
-                        # span is where async dispatch drains, i.e. device
-                        # compute time for the interval
-                        with tracer.span("step/sync", cat="sync"):
-                            host_metrics = jax.device_get(metrics)
-                        last = finite_or_raise(host_metrics, step)
-                        last["lr"] = float(self.schedule(step))
-                        self.logger.log(step, last)
-                # epoch tail: a feed length not divisible by K leaves <K
-                # batches pending — run them through the per-step path
-                # (its jit compiles lazily, only when a tail exists)
-                for batch in chunk:
-                    metrics = self.train_one_batch(batch)
-                    n_images += batch["idx" if "idx" in batch else "image"].shape[0]
-                    step += 1
-                    if self.watchdog is not None:
-                        self.watchdog.beat(step=step, phase="train")
-                    if step % log_every == 0:
-                        with tracer.span("step/sync", cat="sync"):
-                            host_metrics = jax.device_get(metrics)
-                        last = finite_or_raise(host_metrics, step)
-                        last["lr"] = float(self.schedule(step))
-                        self.logger.log(step, last)
-                # epoch-boundary sync for an honest throughput number
-                with tracer.span("step/sync", cat="sync", boundary="epoch"):
-                    jax.device_get(jax.tree_util.tree_leaves(self.state.params)[0])
-                dt = time.time() - t_epoch
-                self.logger.log_epoch(epoch, n_images / dt if dt > 0 else 0.0)
-                if cfg.eval_every_epochs and (epoch + 1) % cfg.eval_every_epochs == 0:
-                    if self.watchdog is not None:
-                        self.watchdog.beat(phase="eval")
-                    eval_result = {"mAP": float(self.evaluate()["mAP"])}
-                    self.logger.log(step, eval_result)
-                if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
-                    if self.watchdog is not None:
-                        self.watchdog.beat(phase="checkpoint")
-                    with tracer.span("checkpoint/save", cat="checkpoint"):
-                        self.save()
+                            last = fault.check_step_metrics(host_metrics, step)
+                            last["lr"] = float(self.schedule(step))
+                            self.logger.log(step, last)
+                            self.skip_monitor.drain()
+                        self._check_preemption(step)
+                    # epoch tail: a feed length not divisible by K leaves <K
+                    # batches pending — run them through the per-step path
+                    # (its jit compiles lazily, only when a tail exists)
+                    for batch in chunk:
+                        metrics = self.train_one_batch(batch)
+                        n_images += batch[
+                            "idx" if "idx" in batch else "image"
+                        ].shape[0]
+                        step += 1
+                        if self.watchdog is not None:
+                            self.watchdog.beat(step=step, phase="train")
+                        if step % log_every == 0:
+                            with tracer.span("step/sync", cat="sync"):
+                                host_metrics = jax.device_get(metrics)
+                            last = fault.check_step_metrics(host_metrics, step)
+                            last["lr"] = float(self.schedule(step))
+                            self.logger.log(step, last)
+                            self.skip_monitor.drain()
+                        self._check_preemption(step)
+                    # epoch-boundary sync for an honest throughput number
+                    with tracer.span("step/sync", cat="sync", boundary="epoch"):
+                        jax.device_get(
+                            jax.tree_util.tree_leaves(self.state.params)[0]
+                        )
+                    self.skip_monitor.drain()
+                    dt = time.time() - t_epoch
+                    self.logger.log_epoch(epoch, n_images / dt if dt > 0 else 0.0)
+                    if cfg.eval_every_epochs and (
+                        epoch + 1
+                    ) % cfg.eval_every_epochs == 0:
+                        if self.watchdog is not None:
+                            self.watchdog.beat(phase="eval")
+                        eval_result = {"mAP": float(self.evaluate()["mAP"])}
+                        self.logger.log(step, eval_result)
+                    if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
+                        if self.watchdog is not None:
+                            self.watchdog.beat(phase="checkpoint")
+                        with tracer.span("checkpoint/save", cat="checkpoint"):
+                            # periodic saves are contained (kind="scheduled"):
+                            # a failed one logs an incident and the next
+                            # interval retries
+                            self.save()
+                    self._check_preemption(step)
         finally:
-            if self.watchdog is not None:
-                self.watchdog.stop()
-            tracer.flush()
+            self._shutdown = None
         if last:
             last = {k: float(v) for k, v in last.items()}
         # merged last so step-metric logging cannot wipe the eval result

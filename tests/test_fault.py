@@ -1,0 +1,412 @@
+"""Fault-tolerance subsystem (train/fault.py + trainer/loader surgery).
+
+Fast tier: host-side units — guarded-update gating semantics on tiny
+trees (eager, no model compile), SkipMonitor escalation, GracefulShutdown
+signal handling, checkpoint manifests, skip-aware metric checks, loader
+sample containment, config validation, watchdog/report plumbing.
+
+Slow tier (tests/test_fault_train.py): the same semantics through real
+compiled steps — NaN injection on both backends and fused K>1, mid-epoch
+kill-and-resume parity, corrupt-checkpoint fallback.
+"""
+
+import json
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from replication_faster_rcnn_tpu.train import fault
+from replication_faster_rcnn_tpu.train.train_step import TrainState
+
+
+def _tiny_state(tx):
+    params = {"w": jnp.arange(4, dtype=jnp.float32), "b": jnp.ones((2,), jnp.float32)}
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats={"mean": jnp.zeros((2,), jnp.float32)},
+        opt_state=tx.init(params),
+        rng=jax.random.PRNGKey(0),
+    )
+
+
+def _tree_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        if not np.array_equal(np.asarray(x), np.asarray(y)):
+            return False
+    return True
+
+
+CLEAN = {"w": jnp.full((4,), 0.5, jnp.float32), "b": jnp.full((2,), -0.25, jnp.float32)}
+POISON = {"w": jnp.array([0.5, jnp.nan, 0.5, 0.5], jnp.float32),
+          "b": jnp.full((2,), -0.25, jnp.float32)}
+STATS2 = {"mean": jnp.full((2,), 7.0, jnp.float32)}
+
+
+class TestGuardedUpdate:
+    def setup_method(self):
+        self.tx = optax.adam(1e-2)
+        self.state = _tiny_state(self.tx)
+
+    def test_skip_withholds_update_bit_identical(self):
+        new, health = fault.guarded_update(self.tx, self.state, POISON, STATS2, "skip")
+        assert float(health["skipped"]) == 1.0
+        assert int(health["nonfinite_count"]) == 1
+        assert _tree_equal(new.params, self.state.params)
+        assert _tree_equal(new.opt_state, self.state.opt_state)
+        assert _tree_equal(new.batch_stats, self.state.batch_stats)
+        # step still advances: it keys the rng fold_in for the NEXT batch
+        assert int(new.step) == int(self.state.step) + 1
+
+    def test_clean_step_is_bit_identical_to_apply(self):
+        skip, hs = fault.guarded_update(self.tx, self.state, CLEAN, STATS2, "skip")
+        plain, ha = fault.guarded_update(self.tx, self.state, CLEAN, STATS2, "apply")
+        assert float(hs["skipped"]) == 0.0 and float(ha["skipped"]) == 0.0
+        assert _tree_equal(skip.params, plain.params)
+        assert _tree_equal(skip.opt_state, plain.opt_state)
+        assert _tree_equal(skip.batch_stats, plain.batch_stats)
+        assert not _tree_equal(skip.params, self.state.params)  # it DID update
+
+    def test_apply_propagates_nan(self):
+        new, health = fault.guarded_update(self.tx, self.state, POISON, STATS2, "apply")
+        assert float(health["skipped"]) == 0.0
+        assert np.isnan(np.asarray(new.params["w"])).any()
+
+    def test_halt_gates_like_skip(self):
+        new, health = fault.guarded_update(self.tx, self.state, POISON, STATS2, "halt")
+        assert float(health["skipped"]) == 1.0
+        assert _tree_equal(new.params, self.state.params)
+
+    def test_rejects_unknown_policy(self):
+        with pytest.raises(ValueError, match="nonfinite_policy"):
+            fault.guarded_update(self.tx, self.state, CLEAN, STATS2, "yolo")
+
+    def test_inf_counts_as_nonfinite(self):
+        inf = {"w": jnp.array([0.5, jnp.inf, 0.5, 0.5], jnp.float32),
+               "b": CLEAN["b"]}
+        new, health = fault.guarded_update(self.tx, self.state, inf, STATS2, "skip")
+        assert float(health["skipped"]) == 1.0
+        assert _tree_equal(new.params, self.state.params)
+
+
+class TestCheckStepMetrics:
+    def test_skipped_row_tolerates_nonfinite(self):
+        row = {"loss": float("nan"), "grad_norm": float("inf"), "skipped": 1.0}
+        out = fault.check_step_metrics(row, step=7)
+        assert out["skipped"] == 1.0 and np.isnan(out["loss"])
+
+    def test_clean_row_still_fails_fast(self):
+        with pytest.raises(FloatingPointError, match="step 7"):
+            fault.check_step_metrics({"loss": float("nan"), "skipped": 0.0}, 7)
+
+    def test_finite_row_passes(self):
+        out = fault.check_step_metrics({"loss": 1.5, "skipped": 0.0}, 7)
+        assert out == {"loss": 1.5, "skipped": 0.0}
+
+
+class TestSkipMonitor:
+    def test_consecutive_resets_on_clean_step(self):
+        mon = fault.SkipMonitor("skip", max_consecutive=3)
+        mon.observe(1, {"skipped": np.float32(1.0)})
+        mon.observe(2, {"skipped": np.float32(0.0)})
+        mon.observe(3, {"skipped": np.float32(1.0)})
+        mon.drain()
+        assert mon.consecutive == 1 and mon.total_skipped == 2
+        assert mon.last_skipped_step == 3
+
+    def test_escalates_past_budget_with_incident(self):
+        incidents = []
+        mon = fault.SkipMonitor(
+            "skip", max_consecutive=2,
+            on_escalate=lambda kind, **f: incidents.append((kind, f)),
+        )
+        mon.observe(1, {"skipped": np.float32(1.0)})
+        mon.observe(2, {"skipped": np.float32(1.0)})
+        with pytest.raises(fault.NonFiniteEscalation, match="2 consecutive"):
+            mon.drain()
+        assert incidents and incidents[0][0] == "nonfinite_escalation"
+        assert incidents[0][1]["consecutive"] == 2
+
+    def test_stacked_chunk_flags(self):
+        mon = fault.SkipMonitor("skip", max_consecutive=3)
+        # a fused K=4 dispatch: [skip, clean, skip, skip]
+        mon.observe(10, {"skipped": np.asarray([1.0, 0.0, 1.0, 1.0], np.float32)})
+        mon.drain()
+        assert mon.consecutive == 2 and mon.total_skipped == 3
+        assert mon.last_skipped_step == 13
+
+    def test_halt_raises_on_first_skip_without_drain_call(self):
+        mon = fault.SkipMonitor("halt", max_consecutive=99)
+        mon.observe(1, {"skipped": np.float32(0.0)})
+        with pytest.raises(fault.NonFiniteEscalation, match="halt"):
+            mon.observe(2, {"skipped": np.float32(1.0)})
+
+    def test_apply_policy_ignores_flags(self):
+        mon = fault.SkipMonitor("apply", max_consecutive=1)
+        mon.observe(1, {"skipped": np.float32(1.0)})
+        mon.drain()
+        assert mon.total_skipped == 0
+
+    def test_rejects_unknown_policy(self):
+        with pytest.raises(ValueError, match="nonfinite_policy"):
+            fault.SkipMonitor("maybe")
+
+
+class TestGracefulShutdown:
+    def test_sigterm_sets_flag_and_restores_handler(self):
+        before = signal.getsignal(signal.SIGTERM)
+        with fault.GracefulShutdown() as sd:
+            assert not sd.requested
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert sd.requested and sd.reason == "SIGTERM"
+        assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_programmatic_request(self):
+        sd = fault.GracefulShutdown()
+        sd.request("deadline")
+        assert sd.requested and sd.reason == "deadline"
+        sd.request("later")  # first reason wins
+        assert sd.reason == "deadline"
+
+    def test_sigint_sets_flag(self):
+        with fault.GracefulShutdown() as sd:
+            os.kill(os.getpid(), signal.SIGINT)
+            assert sd.requested and sd.reason == "SIGINT"
+
+
+class TestManifest:
+    def _host_tree(self):
+        return {
+            "params": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)},
+            "step": np.int32(4),
+        }
+
+    def test_roundtrip_verifies(self, tmp_path):
+        tree = self._host_tree()
+        manifest = fault.write_manifest(str(tmp_path), 4, tree, kind="scheduled")
+        loaded = fault.load_manifest(str(tmp_path), 4)
+        assert loaded == json.loads(json.dumps(manifest))
+        assert loaded["kind"] == "scheduled" and loaded["step"] == 4
+        assert loaded["leaf_count"] == 2
+        assert fault.verify_state(loaded, tree) == []
+
+    def test_detects_corrupted_leaf(self, tmp_path):
+        tree = self._host_tree()
+        manifest = fault.write_manifest(str(tmp_path), 4, tree)
+        tree["params"]["w"] = tree["params"]["w"] + 1.0
+        problems = fault.verify_state(manifest, tree)
+        assert problems and "checksum mismatch" in problems[0]
+
+    def test_detects_leaf_count_mismatch(self, tmp_path):
+        tree = self._host_tree()
+        manifest = fault.write_manifest(str(tmp_path), 4, tree)
+        tree["extra"] = np.zeros(2, np.float32)
+        problems = fault.verify_state(manifest, tree)
+        assert any("leaf count" in p for p in problems)
+        assert any("unexpected leaf" in p for p in problems)
+
+    def test_missing_manifest_is_none(self, tmp_path):
+        assert fault.load_manifest(str(tmp_path), 9) is None
+
+    def test_prune_drops_dead_steps(self, tmp_path):
+        tree = self._host_tree()
+        for s in (1, 2, 3):
+            fault.write_manifest(str(tmp_path), s, tree)
+        fault.prune_manifests(str(tmp_path), [2, 3])
+        assert fault.load_manifest(str(tmp_path), 1) is None
+        assert fault.load_manifest(str(tmp_path), 2) is not None
+
+    def test_config_hash_stable_and_sensitive(self):
+        from replication_faster_rcnn_tpu.config import get_config
+
+        a = get_config("voc_resnet18")
+        assert fault.config_hash(a) == fault.config_hash(get_config("voc_resnet18"))
+        import dataclasses
+
+        b = a.replace(train=dataclasses.replace(a.train, lr=1e-5))
+        assert fault.config_hash(a) != fault.config_hash(b)
+
+    def test_manifest_records_config_hash(self, tmp_path):
+        from replication_faster_rcnn_tpu.config import get_config
+
+        cfg = get_config("voc_resnet18")
+        m = fault.write_manifest(str(tmp_path), 1, self._host_tree(), config=cfg)
+        assert m["config_hash"] == fault.config_hash(cfg)
+
+
+class TestConfigValidation:
+    def test_rejects_bad_policy(self):
+        from replication_faster_rcnn_tpu.config import TrainConfig
+
+        with pytest.raises(ValueError, match="nonfinite_policy"):
+            TrainConfig(nonfinite_policy="retry")
+
+    def test_rejects_zero_skip_budget(self):
+        from replication_faster_rcnn_tpu.config import TrainConfig
+
+        with pytest.raises(ValueError, match="max_consecutive_skips"):
+            TrainConfig(max_consecutive_skips=0)
+
+    def test_default_policy_is_skip(self):
+        from replication_faster_rcnn_tpu.config import TrainConfig
+
+        tc = TrainConfig()
+        assert tc.nonfinite_policy == "skip" and tc.max_consecutive_skips >= 1
+
+
+class _FlakySample(Exception):
+    pass
+
+
+class FlakyDataset:
+    """Map-style dataset where chosen indices fail once (transient) or
+    always (rotten sample)."""
+
+    def __init__(self, n=8, fail_once=(), always=()):
+        self.n = n
+        self.fail_once = set(fail_once)
+        self.always = set(always)
+        self.attempts = {}
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        i = int(i)
+        self.attempts[i] = self.attempts.get(i, 0) + 1
+        if i in self.always:
+            raise _FlakySample(f"rotten sample {i}")
+        if i in self.fail_once and self.attempts[i] == 1:
+            raise _FlakySample(f"transient failure at {i}")
+        return {
+            "image": np.full((4, 4, 3), i, np.float32),
+            "idx": np.asarray(i, np.int64),
+        }
+
+
+def _loader(ds, **kw):
+    from replication_faster_rcnn_tpu.data.loader import DataLoader
+
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("shuffle", False)
+    kw.setdefault("prefetch", 0)
+    kw.setdefault("num_workers", 1)
+    return DataLoader(ds, **kw)
+
+
+class TestLoaderRobustness:
+    def test_transient_failure_retried_in_place(self):
+        ds = FlakyDataset(n=8, fail_once=(1,))
+        loader = _loader(ds)
+        batches = list(loader)
+        # sample 1 recovered on retry: present, not substituted
+        np.testing.assert_array_equal(batches[0]["idx"], [0, 1, 2, 3])
+        assert loader._epoch_skips == 0
+        assert ds.attempts[1] == 2
+
+    def test_rotten_sample_substituted_with_neighbor(self):
+        ds = FlakyDataset(n=8, always=(2,))
+        loader = _loader(ds)
+        batches = list(loader)
+        # index 2 abandoned after retry; nearest following index fills in
+        np.testing.assert_array_equal(batches[0]["idx"], [0, 1, 3, 3])
+        np.testing.assert_array_equal(batches[1]["idx"], [4, 5, 6, 7])
+        assert loader._epoch_skips == 1
+
+    def test_skip_budget_exhaustion_raises(self):
+        ds = FlakyDataset(n=8, always=(1, 5))
+        loader = _loader(ds, sample_skip_budget=1)
+        with pytest.raises(RuntimeError, match="skip budget exhausted"):
+            list(loader)
+
+    def test_budget_resets_per_epoch(self):
+        ds = FlakyDataset(n=8, always=(2,))
+        loader = _loader(ds, sample_skip_budget=1)
+        list(loader)
+        assert loader._epoch_skips == 1
+        loader.set_epoch(1)
+        assert loader._epoch_skips == 0
+        list(loader)  # epoch 2's single skip fits the refreshed budget
+        assert loader._epoch_skips == 1
+
+    def test_zero_budget_disables_containment(self):
+        ds = FlakyDataset(n=8, always=(2,))
+        loader = _loader(ds, sample_skip_budget=0)
+        with pytest.raises(_FlakySample):
+            list(loader)
+
+    def test_fetch_sample_raises_when_everything_fails(self):
+        from replication_faster_rcnn_tpu.data.loader import fetch_sample
+
+        ds = FlakyDataset(n=3, always=(0, 1, 2))
+        with pytest.raises(_FlakySample):
+            fetch_sample(ds, 1)
+
+
+class TestWatchdogIncident:
+    def test_incident_appends_jsonl_row(self, tmp_path):
+        from replication_faster_rcnn_tpu.telemetry.watchdog import StallWatchdog
+
+        path = str(tmp_path / "watchdog.jsonl")
+        wd = StallWatchdog(timeout_s=60.0, snapshot_path=path)
+        wd.beat(step=3, phase="train")
+        snap = wd.incident("preempted", step=3, reason="SIGTERM")
+        assert snap["kind"] == "preempted" and snap["reason"] == "SIGTERM"
+        rows = [json.loads(l) for l in open(path)]
+        assert rows[-1]["kind"] == "preempted"
+        assert rows[-1]["last_step"] == 3
+
+    def test_report_counts_fault_incidents(self, tmp_path):
+        from replication_faster_rcnn_tpu.telemetry.report import summarize_run
+
+        run = tmp_path / "run"
+        run.mkdir()
+        with open(run / "watchdog.jsonl", "w") as f:
+            for kind in ("stall", "recovered", "preempted",
+                         "nonfinite_escalation", "nonfinite_escalation"):
+                f.write(json.dumps({"kind": kind}) + "\n")
+        summary = summarize_run(str(run))
+        assert summary["incidents"]["stalls"] == 1
+        assert summary["incidents"]["faults"] == {
+            "nonfinite_escalation": 2,
+            "preempted": 1,
+        }
+
+    def test_report_surfaces_skipped_metric(self, tmp_path):
+        from replication_faster_rcnn_tpu.telemetry.report import summarize_run
+
+        run = tmp_path / "run"
+        run.mkdir()
+        with open(run / "metrics.jsonl", "w") as f:
+            f.write(json.dumps({"step": 1, "loss": 1.0, "skipped": 0.0}) + "\n")
+            f.write(json.dumps({"step": 2, "loss": 2.0, "skipped": 1.0}) + "\n")
+        health = summarize_run(str(run))["health"]
+        assert health["metrics"]["skipped"]["max"] == 1.0
+
+
+class TestExitCodes:
+    def test_preempted_carries_step_and_distinct_code(self):
+        p = fault.Preempted(42, "SIGTERM")
+        assert p.step == 42 and "resume" in str(p)
+        assert fault.EXIT_PREEMPTED == 75
+
+    def test_cli_exposes_flags(self):
+        import argparse
+
+        from replication_faster_rcnn_tpu import cli
+
+        parser = argparse.ArgumentParser()
+        cli._add_common(parser)
+        args = parser.parse_args(
+            ["--nonfinite-policy", "halt", "--max-consecutive-skips", "3"]
+        )
+        assert args.nonfinite_policy == "halt"
+        assert args.max_consecutive_skips == 3
+        cfg = cli._build_config(args)
+        assert cfg.train.nonfinite_policy == "halt"
+        assert cfg.train.max_consecutive_skips == 3

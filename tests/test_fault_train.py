@@ -1,0 +1,383 @@
+"""Fault tolerance through real compiled training (slow tier; the
+host-level units live in tests/test_fault.py).
+
+Covers the acceptance criteria end-to-end: a NaN-poisoned batch under
+``nonfinite_policy='skip'`` leaves params/opt-state/BN-stats bitwise
+unchanged on the auto AND shard_map backends and inside a fused
+steps_per_dispatch>1 chunk; SIGTERM produces a verified emergency
+checkpoint whose resume is bitwise-identical to an uninterrupted run
+(including the mid-epoch feed replay); a garbled newest checkpoint
+restores from the newest verifiable step; a failing scheduled save is
+contained while training continues.
+"""
+
+import dataclasses
+import json
+import os
+import pathlib
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from replication_faster_rcnn_tpu.config import (
+    DataConfig,
+    FasterRCNNConfig,
+    MeshConfig,
+    ModelConfig,
+    ProposalConfig,
+    ROITargetConfig,
+    TrainConfig,
+)
+from replication_faster_rcnn_tpu.data import SyntheticDataset
+from replication_faster_rcnn_tpu.data.loader import collate
+from replication_faster_rcnn_tpu.train import Trainer, fault
+
+# fused-vs-sequential comparisons cross compiled programs; see
+# tests/test_multi_step.py for the bound's derivation
+ADAM_ATOL = 2.5e-4
+
+
+def _cfg(n_epoch=1, batch_size=8, ckpt_every=1, **train_kw):
+    return FasterRCNNConfig(
+        model=ModelConfig(
+            backbone="resnet18", roi_op="align", compute_dtype="float32"
+        ),
+        data=DataConfig(dataset="synthetic", image_size=(64, 64), max_boxes=8),
+        train=TrainConfig(
+            batch_size=batch_size,
+            n_epoch=n_epoch,
+            checkpoint_every_epochs=ckpt_every,
+            **train_kw,
+        ),
+        mesh=MeshConfig(num_data=-1),
+        proposals=ProposalConfig(pre_nms_train=128, post_nms_train=32),
+        roi_targets=ROITargetConfig(n_sample=8),
+    )
+
+
+def _batch(ds, idxs):
+    return collate([ds[int(i)] for i in idxs])
+
+
+def _poison(batch):
+    bad = {k: np.array(v, copy=True) for k, v in batch.items()}
+    bad["image"] = np.full_like(bad["image"], np.nan)
+    return bad
+
+
+def _assert_tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _tree_close(a, b, atol=ADAM_ATOL):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=0, atol=atol)
+
+
+class PoisonView:
+    """Dataset wrapper whose every image is NaN — gradients cannot be
+    finite, so every guarded step must skip."""
+
+    def __init__(self, ds):
+        self.ds = ds
+
+    def __len__(self):
+        return len(self.ds)
+
+    def __getitem__(self, i):
+        sample = dict(self.ds[int(i)])
+        sample["image"] = np.full_like(sample["image"], np.nan)
+        return sample
+
+
+class TestNaNInjection:
+    def _run_skip_leg(self, tmp_path, backend):
+        cfg = _cfg(backend=backend)
+        ds = SyntheticDataset(cfg.data, length=16)
+        tr = Trainer(cfg, workdir=str(tmp_path / "w"), dataset=ds)
+        clean = _batch(ds, range(8))
+        tr.train_one_batch(clean)  # move off init first
+        before = jax.device_get(tr.state)
+
+        metrics = jax.device_get(tr.train_one_batch(_poison(clean)))
+        assert float(metrics["skipped"]) == 1.0
+        assert float(metrics["nonfinite_count"]) > 0
+
+        after = jax.device_get(tr.state)
+        _assert_tree_equal(after.params, before.params)
+        _assert_tree_equal(after.opt_state, before.opt_state)
+        _assert_tree_equal(after.batch_stats, before.batch_stats)
+        assert int(after.step) == int(before.step) + 1  # step still counts
+        tr.skip_monitor.drain()  # 1 skip < max_consecutive: no escalation
+        assert tr.skip_monitor.total_skipped == 1
+
+        # the run recovers: the next clean batch trains normally
+        metrics = jax.device_get(tr.train_one_batch(_batch(ds, range(8, 16))))
+        assert float(metrics["skipped"]) == 0.0
+        assert np.isfinite(float(metrics["loss"]))
+        moved = jax.device_get(tr.state)
+        assert any(
+            not np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(
+                jax.tree_util.tree_leaves(moved.params),
+                jax.tree_util.tree_leaves(after.params),
+            )
+        )
+
+    def test_skip_withholds_update_auto(self, tmp_path):
+        self._run_skip_leg(tmp_path, backend="auto")
+
+    def test_skip_withholds_update_spmd(self, tmp_path):
+        self._run_skip_leg(tmp_path, backend="spmd")
+
+    def test_fused_chunk_skips_only_poisoned_step(self, tmp_path):
+        ds = SyntheticDataset(_cfg().data, length=16)
+        poison = _poison(_batch(ds, range(8)))
+        clean = _batch(ds, range(8, 16))
+
+        fused = Trainer(
+            _cfg(steps_per_dispatch=2),
+            workdir=str(tmp_path / "f"),
+            dataset=ds,
+        )
+        metrics = jax.device_get(fused.train_chunk([poison, clean]))
+        np.testing.assert_array_equal(np.asarray(metrics["skipped"]), [1.0, 0.0])
+        fused.skip_monitor.drain()
+        assert fused.skip_monitor.last_skipped_step == 1
+
+        seq = Trainer(_cfg(), workdir=str(tmp_path / "s"), dataset=ds)
+        seq.train_one_batch(poison)
+        seq.train_one_batch(clean)
+
+        fs, ss = jax.device_get(fused.state), jax.device_get(seq.state)
+        assert int(fs.step) == int(ss.step) == 2
+        _tree_close(fs.params, ss.params)
+        _tree_close(fs.batch_stats, ss.batch_stats)
+
+    def test_halt_policy_raises_with_params_clean(self, tmp_path):
+        cfg = _cfg(nonfinite_policy="halt")
+        ds = SyntheticDataset(cfg.data, length=16)
+        tr = Trainer(cfg, workdir=str(tmp_path / "w"), dataset=ds)
+        before = jax.device_get(tr.state)
+        with pytest.raises(fault.NonFiniteEscalation, match="halt"):
+            tr.train_one_batch(_poison(_batch(ds, range(8))))
+        after = jax.device_get(tr.state)
+        _assert_tree_equal(after.params, before.params)
+        _assert_tree_equal(after.opt_state, before.opt_state)
+
+    def test_consecutive_skip_escalation_ends_training(self, tmp_path):
+        cfg = _cfg(max_consecutive_skips=2)
+        ds = PoisonView(SyntheticDataset(cfg.data, length=16))
+        tr = Trainer(cfg, workdir=str(tmp_path / "w"), dataset=ds)
+        with pytest.raises(fault.NonFiniteEscalation, match="consecutive"):
+            tr.train(log_every=1)
+
+
+class TestPreemption:
+    def _straight(self, tmp_path, ds, **train_kw):
+        tr = Trainer(
+            _cfg(n_epoch=2, **train_kw), workdir=str(tmp_path / "a"), dataset=ds
+        )
+        tr.train(log_every=100)
+        return tr
+
+    def _assert_resume_parity(self, straight, resumed):
+        assert int(straight.state.step) == int(resumed.state.step)
+        _assert_tree_equal(
+            jax.device_get(straight.state.params),
+            jax.device_get(resumed.state.params),
+        )
+        _assert_tree_equal(
+            jax.device_get(straight.state.opt_state),
+            jax.device_get(resumed.state.opt_state),
+        )
+
+    def test_sigterm_mid_epoch_emergency_checkpoint_and_exact_resume(
+        self, tmp_path
+    ):
+        ds = SyntheticDataset(_cfg().data, length=16)
+        straight = self._straight(tmp_path, ds)
+
+        workdir = str(tmp_path / "b")
+        victim = Trainer(_cfg(n_epoch=2), workdir=workdir, dataset=ds)
+        orig = victim.train_one_batch
+        dispatched = []
+
+        def preempt_after_first(batch):
+            metrics = orig(batch)
+            dispatched.append(1)
+            if len(dispatched) == 1:  # mid-epoch: 2 steps per epoch
+                os.kill(os.getpid(), signal.SIGTERM)
+            return metrics
+
+        victim.train_one_batch = preempt_after_first
+        with pytest.raises(fault.Preempted, match="SIGTERM"):
+            victim.train(log_every=100)
+        # SIGTERM handler restored after train()'s GracefulShutdown exits
+        assert victim._shutdown is None
+
+        assert victim.checkpoint_manager.latest_step() == 1
+        manifest = fault.load_manifest(workdir, 1)
+        assert manifest is not None and manifest["kind"] == "emergency"
+        assert fault.verify_state(manifest, victim._host_state()) == []
+        del victim
+
+        resumed = Trainer(_cfg(n_epoch=2), workdir=workdir, dataset=ds)
+        resumed.train(resume=True, log_every=100)
+        self._assert_resume_parity(straight, resumed)
+
+    def test_spmd_preemption_resume_parity(self, tmp_path):
+        ds = SyntheticDataset(_cfg().data, length=16)
+        straight = self._straight(tmp_path, ds, backend="spmd")
+
+        workdir = str(tmp_path / "b")
+        victim = Trainer(
+            _cfg(n_epoch=2, backend="spmd"), workdir=workdir, dataset=ds
+        )
+        orig = victim.train_one_batch
+
+        def preempt_after_first(batch):
+            metrics = orig(batch)
+            if victim._host_step == 1:
+                victim._shutdown.request("preemption-notice")
+            return metrics
+
+        victim.train_one_batch = preempt_after_first
+        with pytest.raises(fault.Preempted, match="preemption-notice"):
+            victim.train(log_every=100)
+        assert victim.checkpoint_manager.latest_step() == 1
+        del victim
+
+        resumed = Trainer(
+            _cfg(n_epoch=2, backend="spmd"), workdir=workdir, dataset=ds
+        )
+        resumed.train(resume=True, log_every=100)
+        self._assert_resume_parity(straight, resumed)
+
+    def test_fused_dispatch_preemption_resume_parity(self, tmp_path):
+        # 32 imgs / batch 8 = 4 steps/epoch; K=2 -> 2 chunks. Preempt after
+        # chunk 1 (step 2, mid-epoch): resume must replay the epoch's first
+        # two batches through the feed, re-chunk the rest, and land bitwise
+        # on the uninterrupted trajectory.
+        ds = SyntheticDataset(_cfg().data, length=32)
+        straight = self._straight(tmp_path, ds, steps_per_dispatch=2)
+
+        workdir = str(tmp_path / "b")
+        victim = Trainer(
+            _cfg(n_epoch=2, steps_per_dispatch=2), workdir=workdir, dataset=ds
+        )
+        orig = victim.train_chunk
+
+        def preempt_after_first(batches):
+            metrics = orig(batches)
+            if victim._host_step == 2:
+                victim._shutdown.request("preemption-notice")
+            return metrics
+
+        victim.train_chunk = preempt_after_first
+        with pytest.raises(fault.Preempted):
+            victim.train(log_every=100)
+        assert victim.checkpoint_manager.latest_step() == 2
+        manifest = fault.load_manifest(workdir, 2)
+        assert manifest is not None and manifest["kind"] == "emergency"
+        del victim
+
+        resumed = Trainer(
+            _cfg(n_epoch=2, steps_per_dispatch=2), workdir=workdir, dataset=ds
+        )
+        resumed.train(resume=True, log_every=100)
+        self._assert_resume_parity(straight, resumed)
+
+
+class TestVerifiedRestore:
+    def test_garbled_latest_falls_back_to_newest_verifiable(self, tmp_path):
+        cfg = _cfg(n_epoch=2)
+        ds = SyntheticDataset(cfg.data, length=16)
+        workdir = str(tmp_path / "w")
+        tr = Trainer(cfg, workdir=workdir, dataset=ds)
+        tr.train(log_every=100)  # scheduled saves at steps 2 and 4
+        assert sorted(tr.checkpoint_manager.all_steps()) == [2, 4]
+        del tr
+
+        # garble every file of the newest step directory (torn write)
+        root = pathlib.Path(workdir)
+        step_dirs = [
+            d
+            for d in root.iterdir()
+            if d.is_dir() and d.name != fault.MANIFEST_DIRNAME and "4" in d.name
+        ]
+        assert len(step_dirs) == 1
+        for f in step_dirs[0].rglob("*"):
+            if f.is_file():
+                f.write_bytes(b"not a checkpoint")
+
+        fresh = Trainer(cfg, workdir=workdir, dataset=ds)
+        assert fresh.restore() == 2
+        assert int(fresh.state.step) == 2
+        # the torn step was deleted from the store so a future save at 4
+        # cannot collide with its remains
+        assert 4 not in set(fresh.checkpoint_manager.all_steps())
+        # and the fallback state itself verifies against its manifest
+        manifest = fault.load_manifest(workdir, 2)
+        assert manifest is not None
+        assert fault.verify_state(manifest, fresh._host_state()) == []
+
+    def test_explicit_step_restore_still_works(self, tmp_path):
+        cfg = _cfg(n_epoch=2)
+        ds = SyntheticDataset(cfg.data, length=16)
+        workdir = str(tmp_path / "w")
+        tr = Trainer(cfg, workdir=workdir, dataset=ds)
+        tr.train(log_every=100)
+        fresh = Trainer(cfg, workdir=workdir, dataset=ds)
+        assert fresh.restore(step=2) == 2
+        assert int(fresh.state.step) == 2
+
+
+class TestSaveContainment:
+    def test_scheduled_save_failure_does_not_kill_training(
+        self, tmp_path, monkeypatch
+    ):
+        cfg = _cfg(n_epoch=1)
+        ds = SyntheticDataset(cfg.data, length=16)
+        telemetry_dir = str(tmp_path / "tel")
+        tr = Trainer(
+            cfg,
+            workdir=str(tmp_path / "w"),
+            dataset=ds,
+            telemetry_dir=telemetry_dir,
+        )
+
+        def broken_save(*args, **kwargs):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(tr.checkpoint_manager, "save", broken_save)
+        metrics = tr.train(log_every=1)  # epoch-end save fails, contained
+        assert np.isfinite(metrics["loss"])
+        assert int(tr.state.step) == 2  # both steps ran despite the failure
+        assert tr.checkpoint_manager.latest_step() is None
+        rows = [
+            json.loads(line)
+            for line in open(os.path.join(telemetry_dir, "watchdog.jsonl"))
+        ]
+        assert any(r.get("kind") == "checkpoint_save_failed" for r in rows)
+
+    def test_emergency_save_failure_still_raises(self, tmp_path, monkeypatch):
+        cfg = _cfg(n_epoch=1)
+        ds = SyntheticDataset(cfg.data, length=16)
+        tr = Trainer(cfg, workdir=str(tmp_path / "w"), dataset=ds)
+        tr.train_one_batch(_batch(ds, range(8)))
+
+        def broken_save(*args, **kwargs):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(tr.checkpoint_manager, "save", broken_save)
+        with pytest.raises(OSError, match="disk full"):
+            tr.save(kind="emergency")
