@@ -713,6 +713,19 @@ def _measure(config, profile_dir=None, watchdog=None) -> None:
         out["steps_per_dispatch"] = k
     if trace_status is not None:
         out["trace"] = trace_status
+    if out["mfu"] is None and jax.default_backend() == "cpu":
+        # bench contract: a CPU-side record must carry MFU on the measured-
+        # CPU-matmul basis or fail LOUDLY — "mfu": null with rc 0 is how
+        # BENCH_r05.json shipped a silent hole past the fallback parent's
+        # own check (the parent only vets the child it spawned; a directly-
+        # run CPU bench had no enforcement). Same exit code (3) and
+        # mfu_error key as the parent-side rule, so drivers see one shape.
+        out["mfu_error"] = (
+            "cpu record produced no MFU "
+            "(flops estimate or measured matmul peak unavailable)"
+        )
+        print(json.dumps(out), flush=True)
+        os._exit(3)
     if os.environ.get("BENCH_BREAKDOWN", "1") != "0":
         step_ms = dt / n_steps * 1e3
         # The breakdown is strictly optional decoration on an already-won

@@ -63,6 +63,8 @@ def _build_config(args):
         data_kw["cache_device"] = True
     if getattr(args, "device_normalize", False):
         data_kw["device_normalize"] = True
+    if getattr(args, "prefetch_device", None) is not None:
+        data_kw["prefetch_device"] = args.prefetch_device
     if data_kw:
         cfg = cfg.replace(data=dataclasses.replace(cfg.data, **data_kw))
     train_kw = {}
@@ -90,8 +92,16 @@ def _build_config(args):
         train_kw["nonfinite_policy"] = args.nonfinite_policy
     if getattr(args, "max_consecutive_skips", None) is not None:
         train_kw["max_consecutive_skips"] = args.max_consecutive_skips
+    if getattr(args, "async_checkpoint", False):
+        train_kw["async_checkpoint"] = True
     if train_kw:
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **train_kw))
+    if getattr(args, "compile_cache", None):
+        cfg = cfg.replace(
+            compile=dataclasses.replace(
+                cfg.compile, cache_dir=args.compile_cache
+            )
+        )
     if (args.backbone or args.roi_op or getattr(args, "remat", False)
             or getattr(args, "frozen_bn", False)
             or getattr(args, "norm", None)):
@@ -224,6 +234,24 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="run the jitter's image resample on device (host "
                         "transforms boxes only; removes the per-sample "
                         "host resample cost from ingest)")
+    p.add_argument("--prefetch-device", type=int, default=None, metavar="N",
+                   help="double-buffered DEVICE staging: a producer thread "
+                        "collates and starts the next batch's host->device "
+                        "transfer while the current dispatch runs (N = "
+                        "buffer depth, 2 = classic double buffering, "
+                        "0 = off). Chunk-aware under --steps-per-dispatch; "
+                        "works with every feed incl. --cache-device")
+    p.add_argument("--async-checkpoint", action="store_true",
+                   help="scheduled checkpoints snapshot to host and "
+                        "serialize + CRC-manifest on a background writer "
+                        "(training blocks only if the previous save is "
+                        "still in flight); emergency/final/crash saves "
+                        "stay synchronous. Single-process runtimes only")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache: compiled "
+                        "programs are written here and restarts "
+                        "deserialize instead of re-running XLA (pair with "
+                        "the 'warmup' subcommand to prepopulate)")
     p.add_argument("--num-model", type=int, default=None,
                    help="size of the mesh's model axis")
     p.add_argument("--spatial", action="store_true",
@@ -351,6 +379,9 @@ def cmd_eval(args) -> int:
     from replication_faster_rcnn_tpu.train.trainer import load_eval_variables
 
     cfg = _build_config(args)
+    from replication_faster_rcnn_tpu.train.warmup import maybe_enable_compile_cache
+
+    maybe_enable_compile_cache(cfg)
     model, variables = load_eval_variables(cfg, args.workdir, args.checkpoint_step)
     dataset = make_dataset(cfg.data, args.split)
     ev = Evaluator(cfg, model)
@@ -397,15 +428,56 @@ def cmd_bench(args) -> int:
             args.loader_mode, args.augment_scale, args.norm,
             args.steps_per_dispatch, args.grad_allreduce_dtype,
             args.nonfinite_policy, args.max_consecutive_skips,
+            args.prefetch_device, args.compile_cache,
         )
     ) or (
         args.spatial or args.remat or args.shard_opt or args.augment_hflip
         or args.frozen_bn or args.augment_scale_device
         or args.no_augment_hflip or args.cache_ram or args.device_normalize
         or getattr(args, "cache_device", False)
+        or args.async_checkpoint
         or args.config != "voc_resnet18"
     )
+    if args.compile_cache:
+        from replication_faster_rcnn_tpu.train.warmup import enable_compile_cache
+
+        enable_compile_cache(args.compile_cache)
     bench_main(_build_config(args) if flagged else None, profile_dir=args.profile)
+    return 0
+
+
+def cmd_warmup(args) -> int:
+    """AOT-compile the train (and optionally eval) programs for a config
+    without touching data or parameters — typically with --compile-cache
+    set, so a later real run (same config/mesh/jaxlib) starts with every
+    program already compiled (train/warmup.py)."""
+    _apply_device(args.device)
+    import json
+
+    from replication_faster_rcnn_tpu.telemetry import spans as tspans
+    from replication_faster_rcnn_tpu.train.warmup import (
+        maybe_enable_compile_cache,
+        warmup_compile,
+    )
+
+    cfg = _build_config(args)
+    cache_path = maybe_enable_compile_cache(cfg)
+    tracer = None
+    if args.telemetry:
+        import os
+
+        os.makedirs(args.telemetry, exist_ok=True)
+        tracer = tspans.SpanTracer(os.path.join(args.telemetry, "trace.json"))
+        tspans.set_tracer(tracer)
+    try:
+        times = warmup_compile(cfg, include_eval=not args.train_only)
+    finally:
+        if tracer is not None:
+            tracer.flush()
+    out = {"compile_seconds": times}
+    if cache_path:
+        out["compile_cache"] = cache_path
+    print(json.dumps(out, indent=2))
     return 0
 
 
@@ -555,6 +627,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                          help="write a jax.profiler trace of the timed "
                               "loop (TensorBoard/Perfetto)")
     p_bench.set_defaults(fn=cmd_bench)
+
+    p_warm = sub.add_parser(
+        "warmup",
+        help="AOT-compile the train/eval programs for a config (pair with "
+             "--compile-cache to make later real-run startups compile-free)",
+    )
+    _add_common(p_warm)
+    p_warm.add_argument("--train-only", action="store_true",
+                        help="skip the eval inference program")
+    p_warm.add_argument("--telemetry", default=None, metavar="DIR",
+                        help="write compile/* spans to DIR/trace.json")
+    p_warm.set_defaults(fn=cmd_warmup)
 
     p_pred = sub.add_parser("predict", help="detect objects in one image")
     _add_common(p_pred)

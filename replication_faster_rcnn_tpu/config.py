@@ -261,8 +261,21 @@ class DataConfig:
     # to fit HBM — pair with device_normalize for uint8 samples (VOC
     # trainval ~5.4 GB vs 21.6 GB f32).
     cache_device: bool = False
+    # double-buffered DEVICE staging (data/prefetch_device.py): a producer
+    # thread assembles batch K+1 (stack + shard + device_put) while
+    # dispatch K runs, so the trainer's next dispatch consumes an already
+    # device-resident buffer instead of paying collate+transfer on the
+    # critical path. Value = number of staged batches/chunks held ahead
+    # (2 = classic double buffering; each buffered chunk holds a full
+    # batch in HBM, so keep it small). 0 = off (default): staging happens
+    # synchronously between dispatches, the pre-PR-4 behavior.
+    prefetch_device: int = 0
 
     def __post_init__(self):
+        if self.prefetch_device < 0:
+            raise ValueError(
+                f"prefetch_device must be >= 0, got {self.prefetch_device}"
+            )
         if self.augment_scale is not None:
             lo, hi = self.augment_scale
             # fail at config build, not at the first training epoch
@@ -342,6 +355,15 @@ class TrainConfig:
     # a divergent model: transients cost 1-2 steps, persistent NaNs are
     # a bug to surface, not ride through.
     max_consecutive_skips: int = 10
+    # background scheduled checkpointing (train/async_checkpoint.py): a
+    # scheduled save snapshots state to host once (the only blocking
+    # part), then serialization + CRC manifest + atomic rename run on a
+    # single background writer; the epoch loop blocks only if the
+    # PREVIOUS save is still in flight. Emergency/final/crash saves stay
+    # synchronous, and restore-side manifest verification is unchanged.
+    # Single-process runtimes only (the writer hands orbax a host-numpy
+    # snapshot, which has no multi-host replica story).
+    async_checkpoint: bool = False
 
     def __post_init__(self):
         if self.backend not in ("auto", "spmd"):
@@ -415,6 +437,29 @@ class MeshConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class CompileConfig:
+    """Compilation warm start (train/warmup.py).
+
+    ``cache_dir`` opts into JAX's persistent XLA compilation cache: every
+    compiled program is keyed by its HLO + compile options and written
+    under the directory, so a SECOND process start for the same config
+    deserializes executables instead of re-running XLA (minutes on the
+    big presets). Empty string = off (default; compilation stays
+    per-process). The ``warmup`` CLI subcommand AOT-compiles the
+    train/eval programs for a config to populate the cache ahead of the
+    real run."""
+
+    cache_dir: str = ""  # "" = persistent compilation cache off
+
+    def __post_init__(self):
+        if not isinstance(self.cache_dir, str):
+            raise ValueError(
+                f"compile.cache_dir must be a string path, got "
+                f"{self.cache_dir!r}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class FasterRCNNConfig:
     anchors: AnchorConfig = dataclasses.field(default_factory=AnchorConfig)
     proposals: ProposalConfig = dataclasses.field(default_factory=ProposalConfig)
@@ -425,6 +470,7 @@ class FasterRCNNConfig:
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     eval: EvalConfig = dataclasses.field(default_factory=EvalConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    compile: CompileConfig = dataclasses.field(default_factory=CompileConfig)
 
     def feature_size(self, image_size: Optional[Tuple[int, int]] = None) -> Tuple[int, int]:
         """Spatial size of the stride-16 feature map for a given image size.
@@ -545,6 +591,9 @@ def config_from_dict(d: dict) -> FasterRCNNConfig:
         hints = typing.get_type_hints(cls)
         kw = {}
         for f in dataclasses.fields(cls):
+            if f.name not in dd:
+                continue  # dict from an older binary (e.g. pre-`compile`
+                # section): absent fields keep their defaults
             v = dd[f.name]
             t = hints.get(f.name)
             if dataclasses.is_dataclass(t) and isinstance(v, dict):

@@ -1,3 +1,4 @@
 from replication_faster_rcnn_tpu.data.loader import DataLoader, collate, make_dataset  # noqa: F401
+from replication_faster_rcnn_tpu.data.prefetch_device import DevicePrefetcher  # noqa: F401
 from replication_faster_rcnn_tpu.data.synthetic import SyntheticDataset  # noqa: F401
 from replication_faster_rcnn_tpu.data.voc import VOCDataset  # noqa: F401

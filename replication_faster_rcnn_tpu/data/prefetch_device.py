@@ -1,0 +1,195 @@
+"""Double-buffered device staging — host batch work off the critical path.
+
+The trainer's dispatch loop is asynchronous on the device side (`jit`
+enqueues and returns), but host-side batch production was serialized
+WITH it: collate/stack (`data/fetch` + the np.stack in `train_chunk`)
+and the host→device transfer (`data/device_put`) ran between dispatches,
+so every step paid the feed on the critical path. This module moves that
+work to a producer thread: while dispatch K executes on device, the
+producer assembles batch K+1, starts its `device_put` and *waits for the
+transfer to land* (`parallel.stage_to_devices(wait=True)`), then parks
+the device-resident buffer in a bounded queue. The trainer's next
+dispatch dequeues an already-resident buffer — the host-blocked cost per
+step collapses to a queue pop (measured in benchmarks/step_profile.py's
+``overlap`` section).
+
+Semantics the trainer depends on:
+
+* **Deterministic order.** The producer consumes the feed iterator in
+  exactly the order a synchronous loop would, so training consumes the
+  same batches in the same order — bitwise parity with prefetch off.
+* **Replay skip.** ``skip`` batches are drawn from the feed and
+  discarded WITHOUT staging (mid-epoch resume replays the interrupted
+  epoch's prefix; staged work for already-trained batches would be
+  wasted H2D traffic). The skipped draws still advance the feed's
+  deterministic order, which is the point.
+* **No batch consumed twice.** "Consumed" means trained on. On
+  preemption (`fault.Preempted` at a dispatch boundary) staged-but-
+  undequeued buffers are dropped by :meth:`close`; resume re-derives
+  them from the feed replay. The stager never re-emits an item.
+* **Bounded depth.** At most ``depth`` staged items exist at once
+  (each holds a full batch/chunk in HBM); the producer blocks when the
+  queue is full, providing backpressure.
+* **Error transparency.** A producer-side exception (feed or staging)
+  re-raises in the consumer at the point of the failed item, not as a
+  silent end-of-epoch.
+
+The stager is chunk-aware: with ``chunk=K`` (fused multi-step dispatch)
+it stages full K-batch chunks through ``stage`` and hands an epoch tail
+shorter than K back as raw host batches, mirroring the trainer's
+per-step tail path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Tuple
+
+from replication_faster_rcnn_tpu.telemetry import spans as tspans
+
+# queue item kinds (first tuple element)
+STAGED = "staged"  # (STAGED, staged_obj, n_steps, n_images)
+HOST = "host"  # (HOST, raw_host_batch) — epoch tail shorter than `chunk`
+_END = ("__end__",)
+_ERROR = "__error__"  # (_ERROR, exception)
+
+
+def _batch_images(batch) -> int:
+    """Image count of one host batch (selection dicts carry `idx`)."""
+    return int(batch["idx" if "idx" in batch else "image"].shape[0])
+
+
+class DevicePrefetcher:
+    """Iterator over staged device batches produced by a background thread.
+
+    Parameters
+    ----------
+    source:
+        Iterable of host batches (loader batches or device-cache
+        selection dicts) in deterministic epoch order.
+    stage:
+        Callable mapping a list of ``chunk`` host batches to a
+        device-resident object (e.g. stacked + sharded + transfer-waited;
+        the trainer passes a closure that also owns the
+        ``data/device_put`` telemetry span). For ``chunk == 1`` it is
+        called with a single-element list.
+    depth:
+        Maximum staged items buffered ahead (>= 1).
+    chunk:
+        Batches per staged item (the trainer's ``steps_per_dispatch``).
+    skip:
+        Leading batches to draw-and-discard (mid-epoch resume replay).
+    """
+
+    def __init__(
+        self,
+        source: Iterable[Any],
+        stage: Callable[[List[Any]], Any],
+        depth: int = 2,
+        chunk: int = 1,
+        skip: int = 0,
+    ) -> None:
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        if skip < 0:
+            raise ValueError(f"skip must be >= 0, got {skip}")
+        self._source = iter(source)
+        self._stage = stage
+        self._chunk = chunk
+        self._skip = skip
+        self._q: "queue.Queue[Tuple]" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._done = False
+        # the producer inherits the caller's process-wide tracer: spans
+        # are thread-safe and carry tids, so `data/fetch`/`data/device_put`
+        # emitted here still land in the same trace (now overlapping the
+        # consumer's `step/dispatch` spans instead of serializing with them)
+        self._tracer = tspans.current_tracer()
+        self._thread = threading.Thread(
+            target=self._produce, name="device-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ producer
+
+    def _put(self, item: Tuple) -> bool:
+        """Blocking put with stop-responsiveness; False once stopped."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self) -> None:
+        tracer = self._tracer
+        skip = self._skip
+        pending: List[Any] = []
+        try:
+            while not self._stop.is_set():
+                with tracer.span("data/fetch", cat="data"):
+                    try:
+                        batch = next(self._source)
+                    except StopIteration:
+                        break
+                if skip > 0:
+                    skip -= 1
+                    continue
+                pending.append(batch)
+                if len(pending) < self._chunk:
+                    continue
+                n_images = sum(_batch_images(b) for b in pending)
+                staged = self._stage(pending)
+                if not self._put((STAGED, staged, len(pending), n_images)):
+                    return
+                pending = []
+            # epoch tail (< chunk batches): hand back raw host batches for
+            # the trainer's per-step path — its fused program was compiled
+            # for exactly `chunk` steps
+            for batch in pending:
+                if not self._put((HOST, batch)):
+                    return
+            self._put(_END)
+        except BaseException as e:  # noqa: BLE001 — relay to the consumer
+            self._put((_ERROR, e))
+
+    # ------------------------------------------------------------ consumer
+
+    def __iter__(self) -> Iterator[Tuple]:
+        return self
+
+    def __next__(self) -> Tuple:
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if item[0] == _END[0]:
+            self._done = True
+            raise StopIteration
+        if item[0] == _ERROR:
+            self._done = True
+            raise item[1]
+        return item
+
+    def queue_depth(self) -> Optional[int]:
+        """Staged items currently buffered (telemetry provider)."""
+        return self._q.qsize()
+
+    def close(self) -> None:
+        """Stop the producer and drop staged-but-unconsumed buffers.
+
+        Safe to call at any point (preemption, crash, normal epoch end);
+        idempotent. Dropped items are NOT consumed — on resume the feed
+        replay regenerates them deterministically."""
+        self._stop.set()
+        # drain so a producer blocked on a full queue observes the stop
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=30.0)
+        self._done = True

@@ -15,6 +15,25 @@ import numpy as np
 from replication_faster_rcnn_tpu.config import FasterRCNNConfig, VOC_CLASSES
 from replication_faster_rcnn_tpu.eval.evaluator import Evaluator
 
+# one-entry Evaluator cache for repeated predict_image calls on the same
+# (config, model): the Evaluator holds the jitted inference function, so a
+# fresh instance per call re-traced and re-compiled the whole forward pass
+# for every image — image 2..N each paid image 1's compile
+_cached_evaluator: Optional[Evaluator] = None
+_cached_key = None
+
+
+def get_evaluator(config: FasterRCNNConfig, model) -> Evaluator:
+    """The cached Evaluator for (config, model), built on first use.
+    Config is a frozen dataclass (value-hashable); the model is keyed by
+    identity — a new model instance gets a fresh Evaluator."""
+    global _cached_evaluator, _cached_key
+    key = (config, id(model))
+    if _cached_evaluator is None or _cached_key != key:
+        _cached_evaluator = Evaluator(config, model)
+        _cached_key = key
+    return _cached_evaluator
+
 
 def predict_image(
     config: FasterRCNNConfig,
@@ -22,16 +41,20 @@ def predict_image(
     variables: Any,
     image_path: str,
     score_thresh: Optional[float] = None,
+    evaluator: Optional[Evaluator] = None,
 ) -> List[Dict[str, Any]]:
     """-> list of {'box' [4] in original image coords (row-major),
-    'score', 'class_id', 'class_name'} sorted by score."""
+    'score', 'class_id', 'class_name'} sorted by score.
+
+    ``evaluator`` reuses a caller-owned Evaluator (its jitted inference
+    fn stays warm); otherwise the module-level cache supplies one."""
     from replication_faster_rcnn_tpu.data.voc import _load_image
 
     h, w = config.data.image_size
     image, orig_h, orig_w = _load_image(
         image_path, (h, w), config.data.pixel_mean, config.data.pixel_std
     )
-    ev = Evaluator(config, model)
+    ev = evaluator if evaluator is not None else get_evaluator(config, model)
     out = ev.predict_batch(variables, image[None])
     thresh = config.eval.score_thresh if score_thresh is None else score_thresh
 

@@ -10,6 +10,7 @@ from replication_faster_rcnn_tpu.parallel.mesh import (  # noqa: F401
     shard_batch,
     shard_stacked_batch,
     stacked_batch_sharding,
+    stage_to_devices,
     validate_parallel,
     validate_spatial,
 )

@@ -219,6 +219,30 @@ def shard_batch(
     return {k: put(k, v) for k, v in batch.items()}
 
 
+def stage_to_devices(
+    batch: Dict[str, np.ndarray],
+    mesh: Mesh,
+    cfg: MeshConfig,
+    stacked: bool = False,
+    wait: bool = False,
+) -> Dict[str, jax.Array]:
+    """Ship a host batch to the mesh (`shard_batch`, or
+    `shard_stacked_batch` for a ``stacked`` [K, B, ...] fused-dispatch
+    chunk), optionally blocking until the transfer has landed.
+
+    ``jax.device_put`` only *enqueues* the copy; with ``wait=True`` the
+    call returns once every leaf is device-resident. That is the overlap
+    primitive for the double-buffered stager (data/prefetch_device.py):
+    the producer thread pays the H2D wait, so by the time the trainer
+    dequeues the batch its dispatch consumes resident buffers and the
+    transfer is fully off the critical path."""
+    out = (shard_stacked_batch if stacked else shard_batch)(batch, mesh, cfg)
+    if wait:
+        for leaf in jax.tree_util.tree_leaves(out):
+            leaf.block_until_ready()
+    return out
+
+
 def replicate_tree(tree: Any, mesh: Mesh) -> Any:
     """Place a pytree fully-replicated on the mesh (params, opt state)."""
     sharding = replicated(mesh)

@@ -96,6 +96,43 @@ def phase_table(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return out
 
 
+def overlap_summary(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Feed-vs-dispatch overlap from the span stream.
+
+    Data-pipeline spans (``data/fetch``, ``data/device_put``) emitted on
+    the thread(s) that also emit ``step/dispatch`` are host-BLOCKED feed
+    time — the trainer paid them on the critical path. The same spans on
+    any other thread are the device stager's producer doing that work
+    overlapped (``data.prefetch_device``). Returns None when the trace
+    has no dispatch spans (nothing to be blocked against)."""
+    dispatch_tids = set()
+    dispatch_ms = 0.0
+    for ev in events:
+        if ev.get("ph") == "X" and ev.get("name") == "step/dispatch":
+            dispatch_tids.add(ev.get("tid"))
+            dispatch_ms += float(ev.get("dur", 0.0)) / 1e3
+    if not dispatch_tids:
+        return None
+    blocked_ms = 0.0
+    overlapped_ms = 0.0
+    for ev in events:
+        if ev.get("ph") != "X" or not str(ev.get("name", "")).startswith("data/"):
+            continue
+        dur_ms = float(ev.get("dur", 0.0)) / 1e3
+        if ev.get("tid") in dispatch_tids:
+            blocked_ms += dur_ms
+        else:
+            overlapped_ms += dur_ms
+    return {
+        "dispatch_total_ms": round(dispatch_ms, 3),
+        "host_blocked_ms": round(blocked_ms, 3),
+        "overlapped_ms": round(overlapped_ms, 3),
+        "host_blocked_frac_of_dispatch": (
+            round(blocked_ms / dispatch_ms, 4) if dispatch_ms > 0 else None
+        ),
+    }
+
+
 def health_summary(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Last value + max of each health key across step rows."""
     step_rows = [r for r in rows if "step" in r]
@@ -122,7 +159,11 @@ def summarize_run(run_dir: str) -> Dict[str, Any]:
     trace_path = os.path.join(run_dir, TRACE_FILE)
     if os.path.exists(trace_path):
         summary["artifacts"].append(TRACE_FILE)
-        summary["phases"] = phase_table(load_trace_events(trace_path))
+        events = load_trace_events(trace_path)
+        summary["phases"] = phase_table(events)
+        overlap = overlap_summary(events)
+        if overlap is not None:
+            summary["overlap"] = overlap
     metrics_path = os.path.join(run_dir, METRICS_FILE)
     if os.path.exists(metrics_path):
         summary["artifacts"].append(METRICS_FILE)
@@ -174,6 +215,19 @@ def format_report(summary: Dict[str, Any]) -> str:
                 f"  {row['name']:<26}{row['count']:>7}"
                 f"{row['total_ms']:>12.1f}{row['mean_ms']:>10.2f}{row['max_ms']:>10.1f}"
             )
+
+    overlap = summary.get("overlap")
+    if overlap is not None:
+        frac = overlap.get("host_blocked_frac_of_dispatch")
+        lines.append("")
+        lines.append(
+            "feed overlap: "
+            f"{overlap['host_blocked_ms']:.1f} ms data time on the dispatch "
+            f"thread ({frac:.1%} of dispatch wall), "
+            f"{overlap['overlapped_ms']:.1f} ms overlapped on the stager"
+            if frac is not None
+            else "feed overlap: no dispatch time recorded"
+        )
 
     health = summary.get("health")
     if health is not None:

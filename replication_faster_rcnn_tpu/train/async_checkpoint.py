@@ -1,0 +1,96 @@
+"""Background scheduled checkpointing — the write off the critical path.
+
+PR 3's verified saves serialize the full train state, CRC every leaf
+into the sidecar manifest and fsync/rename — seconds of wall time that
+the epoch loop paid synchronously at every checkpoint interval. The
+split that fixes it without weakening any fault-tolerance guarantee:
+
+* **Trainer thread (blocking, cheap):** snapshot the replicated state to
+  host once (`jax.device_get` — unavoidable, the bytes must leave the
+  device) and hand the snapshot to the writer. If the PREVIOUS save is
+  still in flight, block until it lands first — in-flight depth is
+  bounded at one, so a slow disk applies backpressure instead of
+  accumulating full-model snapshots in RAM.
+* **Writer thread (slow, off-path):** orbax serialize + fsync, then the
+  CRC manifest + atomic rename (`fault.write_manifest`, same function
+  the sync path uses — restore-side verification and the fallback walk
+  are bit-for-bit unchanged), then manifest pruning.
+
+Only ``scheduled`` saves ride the writer. Emergency (preemption), final
+and crash saves stay synchronous on the trainer thread: they are the
+last chance to persist anything and must complete before the process
+exits. The trainer drains the writer before any synchronous save and
+before restore, so the on-disk store is never touched from two threads.
+
+Failure containment matches PR 3's scheduled-save semantics: a writer
+failure is recorded and surfaced at the next drain point (incident +
+stderr warning, training continues, the next interval retries). The
+failed step's manifest was never renamed into place, so a torn orbax
+directory is exactly what `verified_restore`'s fallback walk already
+handles.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Tuple
+
+
+class AsyncCheckpointWriter:
+    """Single background writer with an in-flight bound of one save.
+
+    Not a general thread pool: checkpoints must land in submission order
+    and two concurrent orbax writers on one store would race, so the
+    "queue" is the single in-flight slot — :meth:`submit` first waits
+    for the previous save (the only case where the trainer blocks on
+    checkpoint I/O at all).
+
+    All methods are intended for ONE controlling thread (the trainer);
+    the background thread only runs the submitted work item.
+    """
+
+    def __init__(self) -> None:
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[Tuple[int, BaseException]] = None
+        self._last_step: Optional[int] = None
+
+    @property
+    def in_flight(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    @property
+    def last_submitted_step(self) -> Optional[int]:
+        return self._last_step
+
+    def wait(self) -> Optional[Tuple[int, BaseException]]:
+        """Block until no save is in flight. Returns (step, exception) of
+        a failed background save — once, then the error slot is cleared —
+        or None. Never raises: the caller owns containment policy."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        err, self._error = self._error, None
+        return err
+
+    def submit(
+        self, step: int, work: Callable[[], None]
+    ) -> Optional[Tuple[int, BaseException]]:
+        """Run ``work`` (the serialize+manifest closure for ``step``) on
+        the background writer. Blocks only while a previous save is in
+        flight; returns that save's deferred error, if any, exactly like
+        :meth:`wait`."""
+        err = self.wait()
+        self._last_step = int(step)
+
+        def _run() -> None:
+            try:
+                work()
+            except BaseException as e:  # noqa: BLE001 — surfaced at drain
+                self._error = (int(step), e)
+
+        t = threading.Thread(target=_run, name="ckpt-writer", daemon=True)
+        self._thread = t
+        t.start()
+        return err

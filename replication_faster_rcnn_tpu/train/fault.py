@@ -361,18 +361,25 @@ def write_manifest(
     state: Any,
     config=None,
     kind: str = "scheduled",
+    writer: str = "sync",
 ) -> Dict[str, Any]:
     """Sidecar manifest for the checkpoint at ``step``: leaf count +
     per-leaf CRC32/shape/dtype of the saved tree, the config hash, and
     the save ``kind`` (scheduled | emergency | crash | final). Written
     atomically next to — not inside — the orbax step directory, so orbax
     never sees a foreign file and a manifest for a garbage-collected
-    step is merely stale, not corrupting."""
+    step is merely stale, not corrupting.
+
+    ``writer`` records whether the save ran on the trainer thread
+    ("sync") or the background checkpoint writer ("async",
+    train/async_checkpoint.py) — provenance for post-mortems; restore
+    verification treats both identically."""
     leaves = _leaf_records(state)
     manifest = {
         "schema": MANIFEST_SCHEMA,
         "step": int(step),
         "kind": kind,
+        "writer": writer,
         "saved_utc": datetime.now(timezone.utc).isoformat(),
         "config_hash": config_hash(config) if config is not None else None,
         "leaf_count": len(leaves),

@@ -26,6 +26,11 @@ import numpy as np
 
 from replication_faster_rcnn_tpu.config import FasterRCNNConfig
 from replication_faster_rcnn_tpu.data import DataLoader, make_dataset
+from replication_faster_rcnn_tpu.data.prefetch_device import (
+    HOST,
+    STAGED,
+    DevicePrefetcher,
+)
 from replication_faster_rcnn_tpu.parallel import (
     batch_sharding,
     fit_data_parallelism,
@@ -34,9 +39,14 @@ from replication_faster_rcnn_tpu.parallel import (
     replicate_tree,
     shard_batch,
     shard_stacked_batch,
+    stage_to_devices,
     validate_parallel,
 )
 from replication_faster_rcnn_tpu.train import fault
+from replication_faster_rcnn_tpu.train.async_checkpoint import (
+    AsyncCheckpointWriter,
+)
+from replication_faster_rcnn_tpu.train.warmup import maybe_enable_compile_cache
 from replication_faster_rcnn_tpu.train.train_step import (
     TrainState,
     build_multi_step,
@@ -95,6 +105,10 @@ class Trainer:
     ) -> None:
         self.config = config
         self.workdir = workdir
+        # persistent XLA compilation cache (compile.cache_dir): must be
+        # enabled before the first jitted call traces — jit is lazy, so
+        # doing it here covers every program this trainer compiles
+        maybe_enable_compile_cache(config)
         validate_parallel(
             config, len(devices) if devices is not None else None
         )
@@ -292,6 +306,20 @@ class Trainer:
                     out_shardings=(self._state_shardings, None),
                 )
         self._ckpt_mgr = None
+        # background scheduled-checkpoint writer (train.async_checkpoint):
+        # single-process only — multi-process orbax saves need the live
+        # replicated jax.Arrays for their replica/writer election, which
+        # the async path's host snapshot deliberately discards
+        self._async_writer: Optional[AsyncCheckpointWriter] = None
+        if config.train.async_checkpoint:
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "async_checkpoint requires a single-process runtime: "
+                    "the background writer serializes a host snapshot, "
+                    "which cannot drive orbax's multi-process replica "
+                    "coordination. Drop --async-checkpoint on multi-host."
+                )
+            self._async_writer = AsyncCheckpointWriter()
 
     # ---------------------------------------------------------- checkpoints
 
@@ -334,6 +362,89 @@ class Trainer:
         if self.watchdog is not None:
             self.watchdog.incident(kind, **fields)
 
+    def _handle_async_error(self, err) -> None:
+        """Containment for a failed BACKGROUND scheduled save, surfaced at
+        a drain point: same policy as a failed synchronous scheduled save
+        (stderr warning + incident, training continues, next interval
+        retries). Never raises — only scheduled saves ride the writer."""
+        if err is None:
+            return
+        err_step, exc = err
+        print(
+            f"warning: async scheduled checkpoint at step {err_step} failed "
+            f"({type(exc).__name__}: {exc}); training continues",
+            file=sys.stderr,
+        )
+        self._fault_incident(
+            "checkpoint_save_failed",
+            step=err_step,
+            ckpt_kind="scheduled",
+            writer="async",
+            error=f"{type(exc).__name__}: {exc}"[:300],
+        )
+
+    def _drain_async_saves(self) -> None:
+        """Wait out any in-flight background save (handling its error, if
+        any). Called before every synchronous save, before restore, and at
+        train() exit, so the checkpoint store is never touched from two
+        threads and the newest scheduled save is on disk before anything
+        that depends on it runs."""
+        if self._async_writer is not None:
+            self._handle_async_error(self._async_writer.wait())
+
+    def _save_async(self, step: int) -> bool:
+        """Scheduled save via the background writer: the trainer thread
+        pays only the host snapshot (device_get) — serialize + manifest +
+        prune run on the writer thread (train/async_checkpoint.py). Blocks
+        only while the PREVIOUS save is still in flight."""
+        import orbax.checkpoint as ocp
+
+        writer = self._async_writer
+        # bound in-flight depth at one; a prior failure surfaces here with
+        # scheduled-save containment semantics
+        self._handle_async_error(writer.wait())
+        try:
+            # the writer is drained, so a successful save at `step` is
+            # visible via latest_step(); a FAILED one is not, and falls
+            # through to a retry here
+            if self.checkpoint_manager.latest_step() == step:
+                return True
+            with self.tracer.span(
+                "checkpoint/snapshot", cat="checkpoint", step=step
+            ):
+                host_state = jax.device_get(self._replicated_state())
+        except Exception as e:
+            print(
+                f"warning: scheduled checkpoint at step {step} failed "
+                f"({type(e).__name__}: {e}); training continues",
+                file=sys.stderr,
+            )
+            self._fault_incident(
+                "checkpoint_save_failed",
+                step=step,
+                ckpt_kind="scheduled",
+                writer="async",
+                error=f"{type(e).__name__}: {e}"[:300],
+            )
+            return False
+
+        mgr = self.checkpoint_manager
+        workdir, config = self.workdir, self.config
+
+        def _write() -> None:
+            mgr.save(step, args=ocp.args.StandardSave(host_state))
+            mgr.wait_until_finished()
+            # same manifest writer as the sync path: restore-side
+            # verification and the fallback walk stay bit-for-bit
+            fault.write_manifest(
+                workdir, step, host_state, config,
+                kind="scheduled", writer="async",
+            )
+            fault.prune_manifests(workdir, mgr.all_steps())
+
+        self._handle_async_error(writer.submit(step, _write))
+        return True
+
     def save(
         self,
         step: Optional[int] = None,
@@ -348,12 +459,26 @@ class Trainer:
         retries — a full disk mid-run should cost a checkpoint, not the
         run. ``emergency``/``final`` saves (or ``required=True``) raise,
         because they are the last chance to persist anything. Returns
-        True when a checkpoint for ``step`` is on disk."""
+        True when a checkpoint for ``step`` is on disk (for async
+        scheduled saves: submitted to the background writer).
+
+        With ``train.async_checkpoint`` on, scheduled saves go through
+        :meth:`_save_async`; emergency/final/required saves stay
+        synchronous here — they are the last write before the process
+        exits and must complete, so they first drain the writer."""
         import orbax.checkpoint as ocp
 
         if required is None:
             required = kind in ("emergency", "final")
         step = int(self.state.step) if step is None else step
+        if (
+            self._async_writer is not None
+            and kind == "scheduled"
+            and not required
+        ):
+            return self._save_async(step)
+        # synchronous save: the store must be quiet first
+        self._drain_async_saves()
         try:
             if self.checkpoint_manager.latest_step() == step:
                 return True  # already checkpointed (orbax raises on dupes)
@@ -407,6 +532,7 @@ class Trainer:
         read-only — nothing is deleted there)."""
         import orbax.checkpoint as ocp
 
+        self._drain_async_saves()  # never read a store mid-write
         ephemeral = directory is not None
         dirpath = os.path.abspath(directory if ephemeral else self.workdir)
         if ephemeral:
@@ -459,72 +585,103 @@ class Trainer:
 
     # ---------------------------------------------------------------- train
 
-    def train_one_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
-        tracer = self.tracer
+    def _stage_batch(
+        self, batch: Dict[str, np.ndarray], wait: bool = False
+    ) -> Dict[str, jax.Array]:
+        """One host batch (or --cache-device selection dict) -> sharded
+        device arrays: the ``data/device_put`` half of a step. ``wait``
+        blocks until the transfer lands — used by the device stager's
+        producer thread so the copy itself is off the critical path."""
+        feed = "device_cache" if self.device_cache is not None else "loader"
+        with self.tracer.span("data/device_put", cat="data", feed=feed):
+            return stage_to_devices(
+                batch, self.mesh, self.config.mesh, wait=wait
+            )
+
+    def _stage_chunk(self, batches, wait: bool = False) -> Dict[str, jax.Array]:
+        """K host batches -> one stacked [K, B, ...] sharded device chunk
+        for the fused dispatch (stack_selections in --cache-device mode,
+        np.stack otherwise)."""
+        k = len(batches)
         if self.device_cache is not None:
-            # `batch` is a selection dict (idx/flip/jitter — bytes, not
-            # megabytes); the images never leave the device
-            with tracer.span("data/device_put", cat="data", feed="device_cache"):
-                sel = shard_batch(batch, self.mesh, self.config.mesh)
+            from replication_faster_rcnn_tpu.data.device_cache import (
+                stack_selections,
+            )
+
+            stacked = stack_selections(batches)
+            feed = "device_cache"
+        else:
+            stacked = {
+                key: np.stack([b[key] for b in batches]) for key in batches[0]
+            }
+            feed = "loader"
+        with self.tracer.span(
+            "data/device_put", cat="data", feed=feed, steps=k
+        ):
+            return stage_to_devices(
+                stacked, self.mesh, self.config.mesh, stacked=True, wait=wait
+            )
+
+    def train_one_batch(
+        self,
+        batch: Optional[Dict[str, np.ndarray]] = None,
+        staged: Optional[Dict[str, jax.Array]] = None,
+    ) -> Dict[str, float]:
+        """One optimizer step. Callers pass either a host ``batch`` (staged
+        here, the synchronous pre-PR-4 path) or an already device-resident
+        ``staged`` batch from the DevicePrefetcher."""
+        tracer = self.tracer
+        if staged is None:
+            # in --cache-device mode `batch` is a selection dict (idx/flip/
+            # jitter — bytes, not megabytes); the images never leave device
+            staged = self._stage_batch(batch)
+        if self.device_cache is not None:
             with tracer.span("step/dispatch", cat="step"):
                 self.state, metrics = self.jitted_step(
-                    self.state, self.device_cache.arrays, sel
+                    self.state, self.device_cache.arrays, staged
                 )
         else:
-            with tracer.span("data/device_put", cat="data", feed="loader"):
-                device_batch = shard_batch(batch, self.mesh, self.config.mesh)
             with tracer.span("step/dispatch", cat="step"):
-                self.state, metrics = self.jitted_step(self.state, device_batch)
+                self.state, metrics = self.jitted_step(self.state, staged)
         self._host_step += 1
         # hand the monitor this step's `skipped` flag as a DEVICE scalar —
         # it syncs only at drain points, preserving dispatch overlap
         self.skip_monitor.observe(self._host_step, metrics)
         return metrics
 
-    def train_chunk(self, batches) -> Dict[str, np.ndarray]:
-        """Train ``len(batches)`` steps in ONE fused jitted dispatch.
+    def train_chunk(
+        self,
+        batches=None,
+        staged: Optional[Dict[str, jax.Array]] = None,
+    ) -> Dict[str, np.ndarray]:
+        """Train ``steps_per_dispatch`` steps in ONE fused jitted dispatch.
 
         ``batches`` must hold exactly ``steps_per_dispatch`` host batches
         (selection dicts in --cache-device mode) — the fused program was
-        compiled for that K. Returns stacked [K, ...] metrics, still on
+        compiled for that K. Alternatively ``staged`` is a pre-staged
+        stacked device chunk from the DevicePrefetcher (already sharded,
+        transfer landed). Returns stacked [K, ...] metrics, still on
         device: callers sync them only at log boundaries so the whole
         chunk's dispatch overlaps device compute.
         """
-        k = len(batches)
-        if k != self.steps_per_dispatch:
-            raise ValueError(
-                f"train_chunk got {k} batches; the fused step was compiled "
-                f"for steps_per_dispatch={self.steps_per_dispatch}"
-            )
+        k = self.steps_per_dispatch
+        if staged is None:
+            if len(batches) != k:
+                raise ValueError(
+                    f"train_chunk got {len(batches)} batches; the fused step "
+                    f"was compiled for steps_per_dispatch={k}"
+                )
+            staged = self._stage_chunk(batches)
         tracer = self.tracer
         if self.device_cache is not None:
-            from replication_faster_rcnn_tpu.data.device_cache import (
-                stack_selections,
-            )
-
-            with tracer.span(
-                "data/device_put", cat="data", feed="device_cache", steps=k
-            ):
-                sels = shard_stacked_batch(
-                    stack_selections(batches), self.mesh, self.config.mesh
-                )
             with tracer.span("step/dispatch", cat="step", steps=k):
                 self.state, metrics = self.jitted_multi_step(
-                    self.state, self.device_cache.arrays, sels
+                    self.state, self.device_cache.arrays, staged
                 )
         else:
-            stacked = {
-                key: np.stack([b[key] for b in batches]) for key in batches[0]
-            }
-            with tracer.span(
-                "data/device_put", cat="data", feed="loader", steps=k
-            ):
-                device_chunk = shard_stacked_batch(
-                    stacked, self.mesh, self.config.mesh
-                )
             with tracer.span("step/dispatch", cat="step", steps=k):
                 self.state, metrics = self.jitted_multi_step(
-                    self.state, device_chunk
+                    self.state, staged
                 )
         first = self._host_step + 1
         self._host_step += k
@@ -600,6 +757,43 @@ class Trainer:
                 max_images=max_images,
             )
 
+    def _log_step(
+        self, step: int, metrics, log_every: int
+    ) -> Optional[Dict[str, float]]:
+        """Per-step log cadence: when ``step`` is a log boundary, sync the
+        metrics (fail fast on NaN/inf unless the guarded update already
+        withheld the step — fault.check_step_metrics), log, and drain the
+        skip monitor. The sync span is where async dispatch drains, i.e.
+        device compute time for the interval. Returns the logged row, or
+        None off-boundary."""
+        if step % log_every != 0:
+            return None
+        with self.tracer.span("step/sync", cat="sync"):
+            host_metrics = jax.device_get(metrics)
+        row = fault.check_step_metrics(host_metrics, step)
+        row["lr"] = float(self.schedule(step))
+        self.logger.log(step, row)
+        self.skip_monitor.drain()
+        return row
+
+    def _log_chunk(
+        self, first: int, step: int, metrics, log_every: int
+    ) -> Optional[Dict[str, float]]:
+        """Chunk-aware log cadence: sync the stacked [K] metrics only when
+        a log boundary falls inside [``first``, ``step``], and log that
+        boundary's own row. Returns the logged row, or None."""
+        boundary = (step // log_every) * log_every
+        if boundary < first:
+            return None
+        with self.tracer.span("step/sync", cat="sync"):
+            host_metrics = jax.device_get(metrics)
+        row = {key: v[boundary - first] for key, v in host_metrics.items()}
+        row = fault.check_step_metrics(row, boundary)
+        row["lr"] = float(self.schedule(boundary))
+        self.logger.log(boundary, row)
+        self.skip_monitor.drain()
+        return row
+
     def train(self, log_every: int = 10, resume: bool = False) -> Dict[str, float]:
         """Run cfg.train.n_epoch epochs. The epoch count lives in the config
         (not a parameter) because the cosine schedule was built from it —
@@ -629,94 +823,150 @@ class Trainer:
         try:
             with self.telemetry_session(), self._shutdown:
                 k = self.steps_per_dispatch
+                prefetch = self.config.data.prefetch_device
                 for epoch in range(start_epoch, cfg.n_epoch):
                     feed.set_epoch(epoch)
                     t_epoch = time.time()
                     n_images = 0
-                    it = iter(feed)
-                    chunk = []  # pending batches of a partially-filled dispatch
-                    while True:
-                        # the fetch span covers host-side batch production
-                        # (decode/collate or selection draw) — the feed half
-                        # of the feed-vs-compute question
-                        with tracer.span("data/fetch", cat="data"):
-                            try:
-                                batch = next(it)
-                            except StopIteration:
-                                break
-                        if replay > 0:
-                            replay -= 1
-                            continue
-                        if k > 1:
-                            chunk.append(batch)
-                            if len(chunk) < k:
-                                continue
-                            metrics = self.train_chunk(chunk)
-                            first = step + 1
-                            step += k
-                            n_images += sum(
-                                b["idx" if "idx" in b else "image"].shape[0]
-                                for b in chunk
+                    if prefetch > 0:
+                        # overlap path (data.prefetch_device): a producer
+                        # thread collates + stages batch K+1's device
+                        # transfer while dispatch K runs, so the consumer
+                        # loop below only dequeues resident buffers. The
+                        # resumed epoch's replay prefix is discarded by the
+                        # producer (skip=) BEFORE staging — no batch is
+                        # consumed twice and none is trained out of order.
+                        stage = (
+                            (lambda bs: self._stage_chunk(bs, wait=True))
+                            if k > 1
+                            else (lambda bs: self._stage_batch(bs[0], wait=True))
+                        )
+                        stager = DevicePrefetcher(
+                            iter(feed), stage,
+                            depth=prefetch, chunk=k, skip=replay,
+                        )
+                        replay = 0
+                        if self.watchdog is not None:
+                            self.watchdog.providers["staged_queue_depth"] = (
+                                stager.queue_depth
                             )
-                            chunk = []
+                        try:
+                            for item in stager:
+                                if item[0] == STAGED and k > 1:
+                                    metrics = self.train_chunk(staged=item[1])
+                                    first = step + 1
+                                    step += k
+                                    n_images += item[3]
+                                    if self.watchdog is not None:
+                                        self.watchdog.beat(
+                                            step=step, phase="train"
+                                        )
+                                    row = self._log_chunk(
+                                        first, step, metrics, log_every
+                                    )
+                                    if row is not None:
+                                        last = row
+                                elif item[0] == STAGED:
+                                    metrics = self.train_one_batch(
+                                        staged=item[1]
+                                    )
+                                    step += 1
+                                    n_images += item[3]
+                                    if self.watchdog is not None:
+                                        self.watchdog.beat(
+                                            step=step, phase="train"
+                                        )
+                                    row = self._log_step(
+                                        step, metrics, log_every
+                                    )
+                                    if row is not None:
+                                        last = row
+                                else:
+                                    # HOST item: epoch tail (< K pending
+                                    # batches) through the per-step path
+                                    batch = item[1]
+                                    metrics = self.train_one_batch(batch)
+                                    step += 1
+                                    n_images += batch[
+                                        "idx" if "idx" in batch else "image"
+                                    ].shape[0]
+                                    if self.watchdog is not None:
+                                        self.watchdog.beat(
+                                            step=step, phase="train"
+                                        )
+                                    row = self._log_step(
+                                        step, metrics, log_every
+                                    )
+                                    if row is not None:
+                                        last = row
+                                self._check_preemption(step)
+                        finally:
+                            # drops staged-but-untrained buffers; resume
+                            # replay regenerates them deterministically
+                            stager.close()
+                    else:
+                        it = iter(feed)
+                        chunk = []  # pending batches of a partial dispatch
+                        while True:
+                            # the fetch span covers host-side batch
+                            # production (decode/collate or selection draw)
+                            # — the feed half of feed-vs-compute
+                            with tracer.span("data/fetch", cat="data"):
+                                try:
+                                    batch = next(it)
+                                except StopIteration:
+                                    break
+                            if replay > 0:
+                                replay -= 1
+                                continue
+                            if k > 1:
+                                chunk.append(batch)
+                                if len(chunk) < k:
+                                    continue
+                                metrics = self.train_chunk(chunk)
+                                first = step + 1
+                                step += k
+                                n_images += sum(
+                                    b["idx" if "idx" in b else "image"].shape[0]
+                                    for b in chunk
+                                )
+                                chunk = []
+                                if self.watchdog is not None:
+                                    self.watchdog.beat(step=step, phase="train")
+                                row = self._log_chunk(
+                                    first, step, metrics, log_every
+                                )
+                                if row is not None:
+                                    last = row
+                                self._check_preemption(step)
+                                continue
+                            metrics = self.train_one_batch(batch)
+                            n_images += batch[
+                                "idx" if "idx" in batch else "image"
+                            ].shape[0]
+                            step += 1
                             if self.watchdog is not None:
                                 self.watchdog.beat(step=step, phase="train")
-                            # chunk-aware log cadence: sync the stacked [K]
-                            # metrics only when a log boundary falls inside
-                            # this chunk, and log that boundary's own row
-                            boundary = (step // log_every) * log_every
-                            if boundary >= first:
-                                with tracer.span("step/sync", cat="sync"):
-                                    host_metrics = jax.device_get(metrics)
-                                row = {
-                                    key: v[boundary - first]
-                                    for key, v in host_metrics.items()
-                                }
-                                last = fault.check_step_metrics(row, boundary)
-                                last["lr"] = float(self.schedule(boundary))
-                                self.logger.log(boundary, last)
-                                self.skip_monitor.drain()
+                            row = self._log_step(step, metrics, log_every)
+                            if row is not None:
+                                last = row
                             self._check_preemption(step)
-                            continue
-                        metrics = self.train_one_batch(batch)
-                        n_images += batch[
-                            "idx" if "idx" in batch else "image"
-                        ].shape[0]
-                        step += 1
-                        if self.watchdog is not None:
-                            self.watchdog.beat(step=step, phase="train")
-                        if step % log_every == 0:
-                            # fail fast on NaN/inf instead of training on
-                            # garbage — unless the guarded update already
-                            # withheld this step (fault.check_step_metrics).
-                            # The sync span is where async dispatch drains,
-                            # i.e. device compute time for the interval
-                            with tracer.span("step/sync", cat="sync"):
-                                host_metrics = jax.device_get(metrics)
-                            last = fault.check_step_metrics(host_metrics, step)
-                            last["lr"] = float(self.schedule(step))
-                            self.logger.log(step, last)
-                            self.skip_monitor.drain()
-                        self._check_preemption(step)
-                    # epoch tail: a feed length not divisible by K leaves <K
-                    # batches pending — run them through the per-step path
-                    # (its jit compiles lazily, only when a tail exists)
-                    for batch in chunk:
-                        metrics = self.train_one_batch(batch)
-                        n_images += batch[
-                            "idx" if "idx" in batch else "image"
-                        ].shape[0]
-                        step += 1
-                        if self.watchdog is not None:
-                            self.watchdog.beat(step=step, phase="train")
-                        if step % log_every == 0:
-                            with tracer.span("step/sync", cat="sync"):
-                                host_metrics = jax.device_get(metrics)
-                            last = fault.check_step_metrics(host_metrics, step)
-                            last["lr"] = float(self.schedule(step))
-                            self.logger.log(step, last)
-                            self.skip_monitor.drain()
-                        self._check_preemption(step)
+                        # epoch tail: a feed length not divisible by K
+                        # leaves <K batches pending — run them through the
+                        # per-step path (its jit compiles lazily, only when
+                        # a tail exists)
+                        for batch in chunk:
+                            metrics = self.train_one_batch(batch)
+                            n_images += batch[
+                                "idx" if "idx" in batch else "image"
+                            ].shape[0]
+                            step += 1
+                            if self.watchdog is not None:
+                                self.watchdog.beat(step=step, phase="train")
+                            row = self._log_step(step, metrics, log_every)
+                            if row is not None:
+                                last = row
+                            self._check_preemption(step)
                     # epoch-boundary sync for an honest throughput number
                     with tracer.span("step/sync", cat="sync", boundary="epoch"):
                         jax.device_get(
@@ -743,6 +993,9 @@ class Trainer:
                     self._check_preemption(step)
         finally:
             self._shutdown = None
+            # the last scheduled save must be on disk before train()
+            # returns (callers immediately save(kind="final") or exit)
+            self._drain_async_saves()
         if last:
             last = {k: float(v) for k, v in last.items()}
         # merged last so step-metric logging cannot wipe the eval result

@@ -73,6 +73,32 @@ class TestLoader:
         loader = DataLoader(ds, batch_size=4, shuffle=False, prefetch=2)
         assert sum(1 for _ in loader) == 3
 
+    def test_queue_depth_under_active_prefetch(self):
+        """queue_depth() must report batches staged ahead of a stalled
+        consumer while the producer thread is actively prefetching —
+        the number the watchdog (and now the device stager's telemetry)
+        snapshots to tell feed starvation from a wedged device."""
+        import time
+
+        ds = SyntheticDataset(_cfg(), length=24)
+        loader = DataLoader(ds, batch_size=4, shuffle=False, prefetch=4)
+        assert loader.queue_depth() is None  # no iteration started yet
+        it = iter(loader)
+        first = next(it)
+        assert first["image"].shape == (4, 64, 64, 3)
+        # consumer stalls here; the producer must run ahead and fill the
+        # buffer (bounded wait — thread scheduling, not a fixed sleep)
+        deadline = time.time() + 10.0
+        depth = 0
+        while time.time() < deadline:
+            depth = loader.queue_depth() or 0
+            if depth >= 1:
+                break
+            time.sleep(0.01)
+        assert depth >= 1, "producer never staged ahead of the consumer"
+        assert depth <= 4, "queue depth exceeded the configured prefetch bound"
+        assert sum(1 for _ in it) == 5  # drains cleanly after the stall
+
     def test_worker_error_propagates(self):
         class Bad:
             def __len__(self):

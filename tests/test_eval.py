@@ -173,6 +173,75 @@ def test_evaluator_data_parallel_matches_single_device():
     )
 
 
+def test_evaluate_cached_tail_padding_no_double_count():
+    """The cached sweep pads a short tail batch with DUPLICATE indices of
+    the last image to hit the compiled shape; the padded rows must not
+    add detections or ground truth to the mAP accumulation. 6 images at
+    batch 4 must score exactly 6 of each, each from its own image.
+    Compile-free: the jitted infer is stubbed with an index-encoding fake
+    (the padding logic under test is pure host code around it)."""
+    import dataclasses
+
+    from replication_faster_rcnn_tpu.data import SyntheticDataset
+    from replication_faster_rcnn_tpu.eval import Evaluator
+
+    cfg = FasterRCNNConfig(
+        model=ModelConfig(
+            backbone="resnet18", roi_op="align", compute_dtype="float32"
+        ),
+        data=DataConfig(
+            dataset="synthetic", image_size=(64, 64), max_boxes=8,
+            cache_device=True,
+        ),
+        eval=EvalConfig(max_detections=4),
+    )
+    ds = SyntheticDataset(cfg.data, split="val", length=6)
+    ev = Evaluator(cfg)
+
+    calls = []
+
+    def fake_infer(variables, image_cache, idx):
+        idx = np.asarray(idx)
+        calls.append(idx.copy())
+        b, d = len(idx), 4
+        boxes = np.zeros((b, d, 4), np.float32)
+        # detection 0's y1 encodes the gathered index — lets the
+        # assertions below tie each scored row back to its source image
+        boxes[:, 0] = np.stack(
+            [idx, idx, idx + 10.0, idx + 10.0], axis=-1
+        ).astype(np.float32)
+        scores = np.zeros((b, d), np.float32)
+        scores[:, 0] = 0.9
+        classes = np.ones((b, d), np.int32)
+        valid = np.zeros((b, d), bool)
+        valid[:, 0] = True
+        return {
+            "boxes": boxes, "scores": scores,
+            "classes": classes, "valid": valid,
+        }
+
+    ev._jit_infer_cached = fake_infer
+    captured = {}
+    orig_score = ev._score
+
+    def spy_score(dets, gts):
+        captured["dets"], captured["gts"] = dets, gts
+        return orig_score(dets, gts)
+
+    ev._score = spy_score
+    res = ev.evaluate({}, ds, batch_size=4)
+
+    assert len(calls) == 2
+    np.testing.assert_array_equal(calls[0], [0, 1, 2, 3])
+    np.testing.assert_array_equal(calls[1], [4, 5, 5, 5])  # padded tail
+    assert len(captured["dets"]) == 6  # padded duplicates NOT accumulated
+    assert len(captured["gts"]) == 6
+    for j, det in enumerate(captured["dets"]):
+        assert det["boxes"].shape[0] == 1
+        assert det["boxes"][0][0] == j  # row j came from image j, once
+    assert 0.0 <= res["mAP"] <= 1.0
+
+
 @pytest.mark.slow  # compiles both eval feed paths
 def test_evaluator_cached_feed_matches_fed_path():
     """--cache-device eval: the device-resident sweep (gather-by-index

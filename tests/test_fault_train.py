@@ -297,6 +297,110 @@ class TestPreemption:
         self._assert_resume_parity(straight, resumed)
 
 
+class TestOverlapParity:
+    """PR 4 acceptance: preemption + resume under the overlapped feed
+    (data.prefetch_device) and background checkpointing
+    (train.async_checkpoint) must land bitwise on the plain synchronous
+    trajectory — overlap may move work off the critical path but may not
+    change what is computed or what survives a kill."""
+
+    def _overlap_cfg(self, prefetch=2, **train_kw):
+        cfg = _cfg(n_epoch=2, **train_kw)
+        return cfg.replace(
+            data=dataclasses.replace(cfg.data, prefetch_device=prefetch)
+        )
+
+    def test_prefetch_preemption_resume_parity(self, tmp_path):
+        ds = SyntheticDataset(_cfg().data, length=16)
+        straight = Trainer(  # baseline: no prefetch, no async
+            _cfg(n_epoch=2), workdir=str(tmp_path / "a"), dataset=ds
+        )
+        straight.train(log_every=100)
+
+        workdir = str(tmp_path / "b")
+        victim = Trainer(self._overlap_cfg(), workdir=workdir, dataset=ds)
+        orig = victim.train_one_batch
+
+        def preempt_after_first(batch=None, staged=None):
+            metrics = orig(batch, staged=staged)
+            if victim._host_step == 1:  # mid-epoch: 2 steps per epoch
+                victim._shutdown.request("preemption-notice")
+            return metrics
+
+        victim.train_one_batch = preempt_after_first
+        with pytest.raises(fault.Preempted, match="preemption-notice"):
+            victim.train(log_every=100)
+        assert victim.checkpoint_manager.latest_step() == 1
+        manifest = fault.load_manifest(workdir, 1)
+        assert manifest is not None and manifest["kind"] == "emergency"
+        del victim
+
+        # resume also runs with the stager: its skip= replay must consume
+        # exactly the epoch's first batch before staging anything
+        resumed = Trainer(self._overlap_cfg(), workdir=workdir, dataset=ds)
+        resumed.train(resume=True, log_every=100)
+        assert int(straight.state.step) == int(resumed.state.step)
+        _assert_tree_equal(
+            jax.device_get(straight.state.params),
+            jax.device_get(resumed.state.params),
+        )
+        _assert_tree_equal(
+            jax.device_get(straight.state.opt_state),
+            jax.device_get(resumed.state.opt_state),
+        )
+
+    def test_async_checkpoint_kill_and_resume_matches_sync(self, tmp_path):
+        # fused K=2 + prefetch + async checkpointing, killed mid-epoch:
+        # the emergency save must be synchronous and verified, and the
+        # resumed run must finish bitwise-equal to the all-sync baseline.
+        ds = SyntheticDataset(_cfg().data, length=32)
+        straight = Trainer(
+            _cfg(n_epoch=2, steps_per_dispatch=2),
+            workdir=str(tmp_path / "a"),
+            dataset=ds,
+        )
+        straight.train(log_every=100)
+
+        cfg = self._overlap_cfg(steps_per_dispatch=2, async_checkpoint=True)
+        workdir = str(tmp_path / "b")
+        victim = Trainer(cfg, workdir=workdir, dataset=ds)
+        assert victim._async_writer is not None
+        orig = victim.train_chunk
+
+        def preempt_after_first(batches=None, staged=None):
+            metrics = orig(batches, staged=staged)
+            if victim._host_step == 2:
+                victim._shutdown.request("preemption-notice")
+            return metrics
+
+        victim.train_chunk = preempt_after_first
+        with pytest.raises(fault.Preempted):
+            victim.train(log_every=100)
+        assert victim.checkpoint_manager.latest_step() == 2
+        manifest = fault.load_manifest(workdir, 2)
+        assert manifest is not None and manifest["kind"] == "emergency"
+        assert fault.verify_state(manifest, victim._host_state()) == []
+        del victim
+
+        resumed = Trainer(cfg, workdir=workdir, dataset=ds)
+        resumed.train(resume=True, log_every=100)
+        assert int(straight.state.step) == int(resumed.state.step)
+        _assert_tree_equal(
+            jax.device_get(straight.state.params),
+            jax.device_get(resumed.state.params),
+        )
+        _assert_tree_equal(
+            jax.device_get(straight.state.opt_state),
+            jax.device_get(resumed.state.opt_state),
+        )
+        # the post-resume epoch-end saves went through the background
+        # writer; their manifests carry its provenance and still verify
+        final = fault.load_manifest(workdir, 8)
+        assert final is not None and final["kind"] == "scheduled"
+        assert final.get("writer") == "async"
+        assert fault.verify_state(final, resumed._host_state()) == []
+
+
 class TestVerifiedRestore:
     def test_garbled_latest_falls_back_to_newest_verifiable(self, tmp_path):
         cfg = _cfg(n_epoch=2)
