@@ -99,8 +99,8 @@ def main() -> None:
     stp = lowered_cost(step, state_abs, batch_abs)
 
     n_params = sum(
-        int(np.prod(l.shape))
-        for l in jax.tree_util.tree_leaves(state_abs.params)
+        int(np.prod(lf.shape))
+        for lf in jax.tree_util.tree_leaves(state_abs.params)
     )
 
     def _phase(name, flops, bytes_):

@@ -59,7 +59,7 @@ def _build_devkit(root: str, n_images: int) -> None:
             x1, y1 = rng.randint(0, w - 60), rng.randint(0, h - 60)
             bw, bh = rng.randint(30, 60), rng.randint(30, 60)
             objs.append(
-                f"<object><name>car</name><difficult>0</difficult>"
+                "<object><name>car</name><difficult>0</difficult>"
                 f"<bndbox><xmin>{x1}</xmin><ymin>{y1}</ymin>"
                 f"<xmax>{x1+bw}</xmax><ymax>{y1+bh}</ymax></bndbox></object>"
             )

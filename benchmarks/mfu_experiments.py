@@ -322,7 +322,7 @@ def run_one(exp, deadline: float) -> bool:
         time.sleep(10)
         rc = proc.poll()
         with open(log) as f:
-            lines = [l for l in f.read().splitlines() if l.startswith("{")]
+            lines = [ln for ln in f.read().splitlines() if ln.startswith("{")]
         if lines:
             try:
                 rec = json.loads(lines[-1])
@@ -339,7 +339,7 @@ def run_one(exp, deadline: float) -> bool:
                         {
                             "name": exp["name"],
                             "why": exp["why"],
-                            "error": f"measured on backend "
+                            "error": "measured on backend "
                             f"{got.get('backend') if isinstance(got, dict) else got!r}"
                             f", required {want} — relay likely died mid-suite",
                             "result": rec,

@@ -170,7 +170,7 @@ def _collect_fast_tier_ids():
     ]
     if not ids:
         raise SystemExit(
-            f"tier_budget_audit: collection produced no test ids "
+            "tier_budget_audit: collection produced no test ids "
             f"(rc={r.returncode}):\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
         )
     return ids

@@ -1,0 +1,28 @@
+"""Static analysis + runtime strictness for JAX jit hygiene.
+
+Two halves, one contract:
+
+* :mod:`analysis.jaxlint` — an AST analyzer with project-specific rules
+  (JX001-JX006) that walks the call graph from the package's jit/shard_map
+  entry points and flags host-sync hazards, tracer branching, donated-buffer
+  reuse, bad static args, RNG key reuse, and un-spanned device syncs.
+  Findings resolve against the committed suppression file
+  ``analysis/baseline.toml``; ``frcnn check`` runs it standalone.
+* :mod:`analysis.strict` — a runtime harness (``--strict`` /
+  ``debug.strict``) that proves at runtime what jaxlint claims statically:
+  post-warmup trainer steps perform zero implicit host<->device transfers
+  (``jax.transfer_guard``) and zero recompiles (XLA compile-event counter +
+  per-program jit cache size).
+"""
+
+from replication_faster_rcnn_tpu.analysis.jaxlint import (  # noqa: F401
+    Finding,
+    LintResult,
+    RULES,
+    lint_package,
+    lint_paths,
+)
+from replication_faster_rcnn_tpu.analysis.strict import (  # noqa: F401
+    StrictHarness,
+    StrictViolation,
+)

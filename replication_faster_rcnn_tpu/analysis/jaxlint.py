@@ -1,0 +1,1476 @@
+"""jaxlint — AST lint for JAX jit hygiene, tuned to this codebase.
+
+The perf work (fused dispatch, critical-path overlap) is silently undone
+whenever a stray host sync, tracer branch, or avoidable recompile creeps
+back into a jitted path; benchmarks catch that only after the fact. This
+module catches it at review time, with project-specific rules:
+
+  JX001  host-sync hazard: ``float()`` / ``int()`` / ``.item()`` /
+         ``np.asarray()`` applied to a tracer-typed (jnp) value — inside a
+         jit-reachable function that forces a device sync per call, and in
+         host code it forces a sync of un-jitted device math (the classic
+         per-step ``float(schedule(step))`` pull).
+  JX002  Python ``if``/``while`` branching on a tracer value inside a
+         jit-reachable function (a trace-time crash or, worse, a silent
+         constant-fold on the tracing value).
+  JX003  donated-buffer reuse: reading an argument again after passing it
+         to a dispatch that donates it (``donate_argnums``).
+  JX004  mutable/non-hashable value (list/dict/set) passed — or defaulted —
+         for a parameter marked static (``static_argnums``/``argnames``):
+         every call re-hashes, a changed value silently recompiles, an
+         unhashable one throws at dispatch.
+  JX005  ``jax.random`` key reused by two sampling calls without an
+         intervening ``split`` (identical randomness; ``fold_in`` derives
+         fresh keys and is exempt).
+  JX006  ``block_until_ready`` / ``jax.device_get`` outside a telemetry
+         span: unattributed sync time that telemetry reports then book to
+         the wrong phase (the spans contract from PR 1).
+
+Jit-reachability is computed by walking the call graph from every
+``jax.jit`` / ``shard_map`` entry point in the package (the known roots
+live in train/train_step.py, parallel/spmd.py, eval/evaluator.py; the
+discovery scans every module so new roots are picked up automatically).
+The walker follows factory returns (``jax.jit(make_train_step(...))``),
+tuple-assignment aliasing (``body, spec = per_shard_multi, P(...)``),
+``self.attr`` bindings (``self.jitted_step = jax.jit(...)``) and
+function-reference arguments (``lax.scan(body, ...)``,
+``value_and_grad(loss_fn)``). ``flax`` module dispatch is resolved by
+method name for ``.apply(..., method="name")`` call sites.
+
+Findings resolve against a committed suppression file
+(``analysis/baseline.toml``): every pre-existing violation is either fixed
+or explicitly waived with a reason. ``frcnn check`` runs this standalone
+(``--json`` for machine-readable output, nonzero exit on unsuppressed
+findings) and tests/test_jaxlint.py asserts the package lints clean.
+
+Known limits (deliberate — this is a reviewer, not a verifier): taint is
+per-function and flow-insensitive across branches; dynamic dispatch other
+than the patterns above is not followed; runtime truth is the job of
+analysis/strict.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "JX001": "host-sync hazard: float()/int()/.item()/np.asarray on a jnp value",
+    "JX002": "Python if/while branches on a tracer value in jit-reachable code",
+    "JX003": "donated buffer read again after a donating dispatch",
+    "JX004": "mutable/non-hashable value for a static jit argument",
+    "JX005": "jax.random key reused without split",
+    "JX006": "block_until_ready/device_get outside a telemetry span",
+}
+
+PACKAGE = "replication_faster_rcnn_tpu"
+
+# attribute reads that are static under tracing (no device value involved)
+_SHAPE_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding", "weak_type"}
+# parameters that are static by convention even without an annotation
+# (cfg/config are the repo's frozen host dataclasses)
+_STATIC_PARAM_NAMES = {"self", "cls", "train", "training", "deterministic", "cfg", "config"}
+# annotation heads that mark a parameter host-static
+_STATIC_ANNOTATION_HEADS = {"bool", "int", "str", "float", "Sequence", "Tuple", "tuple", "List", "list", "Dict", "dict"}
+
+
+def _annotation_static(ann: Optional[str]) -> bool:
+    """True when the annotation names a host-side (non-array) type:
+    scalars, host containers, Optional/| None of those, and the repo's
+    frozen ``*Config`` dataclasses."""
+    if ann is None:
+        return False
+    ann = ann.strip()
+    if ann.startswith("Optional[") and ann.endswith("]"):
+        ann = ann[len("Optional["):-1].strip()
+    if ann.endswith("| None"):
+        ann = ann[: -len("| None")].strip()
+    head = ann.split("[", 1)[0].split(".")[-1]
+    return head in _STATIC_ANNOTATION_HEADS or head.endswith("Config")
+# dotted-call prefixes whose results are tracer-typed
+_TRACER_CALL_PREFIXES = (
+    "jax.numpy.",
+    "jax.lax.",
+    "jax.random.",
+    "jax.nn.",
+    "jax.scipy.",
+)
+# external callables that just map over their arguments (taint passes through)
+_PASSTHROUGH_CALLS = {
+    "jax.tree_util.tree_map",
+    "jax.tree_map",
+    "jax.tree.map",
+    "optax.apply_updates",
+    "jax.checkpoint",
+    "jax.remat",
+}
+_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+_JIT_NAMES = {"jax.jit"}
+_SHARD_MAP_NAMES = {
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+}
+_REMAT_NAMES = {"flax.linen.remat", "nn.remat", "jax.checkpoint", "jax.remat"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    func: str  # function qualname within the module ("<module>" at top level)
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.func)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} [{self.func}] {self.message}"
+
+
+@dataclasses.dataclass
+class Waiver:
+    rule: str
+    path: str
+    func: str  # "*" matches any function in the file
+    reason: str
+    used: bool = False
+
+    def matches(self, f: Finding) -> bool:
+        return (
+            self.rule == f.rule
+            and self.path == f.path
+            and (self.func == "*" or self.func == f.func)
+        )
+
+
+@dataclasses.dataclass
+class Baseline:
+    waivers: List[Waiver] = dataclasses.field(default_factory=list)
+    # rule -> excluded path prefixes (measurement/tooling modules where the
+    # rule's premise does not apply)
+    excludes: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+
+    def excluded(self, f: Finding) -> bool:
+        return any(f.path.startswith(p) for p in self.excludes.get(f.rule, ()))
+
+    def waive(self, f: Finding) -> Optional[Waiver]:
+        for w in self.waivers:
+            if w.matches(f):
+                w.used = True
+                return w
+        return None
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]  # unsuppressed
+    suppressed: List[Tuple[Finding, str]]  # (finding, waiver reason)
+    excluded: List[Finding]
+    stale_waivers: List[Waiver]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rules": RULES,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [
+                {**f.to_dict(), "reason": r} for f, r in self.suppressed
+            ],
+            "excluded_count": len(self.excluded),
+            "stale_waivers": [dataclasses.asdict(w) for w in self.stale_waivers],
+            "ok": not self.findings and not self.stale_waivers,
+        }
+
+
+def load_baseline(path: str) -> Baseline:
+    try:
+        import tomllib  # py >= 3.11
+    except ModuleNotFoundError:  # pragma: no cover - py 3.10 image
+        import tomli as tomllib
+    with open(path, "rb") as f:
+        data = tomllib.load(f)
+    waivers = []
+    for w in data.get("waiver", []):
+        if not w.get("reason"):
+            raise ValueError(
+                f"baseline waiver {w.get('rule')}:{w.get('path')} has no "
+                "reason — every suppression must say why"
+            )
+        waivers.append(
+            Waiver(
+                rule=w["rule"],
+                path=w["path"],
+                func=w.get("func", "*"),
+                reason=w["reason"],
+            )
+        )
+    excludes = {
+        rule: list(paths) for rule, paths in data.get("excludes", {}).items()
+    }
+    return Baseline(waivers=waivers, excludes=excludes)
+
+
+# --------------------------------------------------------------- module index
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain; 'self.x' for self attributes."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if isinstance(node, ast.Call):
+        # e.g. tspans.current_tracer().span — dotted of the outer attrs only
+        inner = _dotted(node.func)
+        if inner is not None and parts:
+            return inner + "()." + ".".join(reversed(parts))
+    return None
+
+
+def _ann_str(node: Optional[ast.AST]) -> Optional[str]:
+    if node is None:
+        return None
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - defensive
+        return None
+
+
+class FunctionInfo:
+    def __init__(self, module: "ModuleInfo", qualname: str, node: ast.AST,
+                 parent: Optional["FunctionInfo"], cls: Optional[str]):
+        self.module = module
+        self.qualname = qualname
+        self.node = node
+        self.parent = parent
+        self.cls = cls  # enclosing class name, if a method
+        self.nested: Dict[str, FunctionInfo] = {}
+        self.jit_reachable = False
+        self._returns_tracer: Optional[bool] = None
+        self._return_elts: Optional[List[List[Optional[ast.AST]]]] = None
+        # static params: annotated host types, conventional names, and any
+        # marked by a static_argnums/argnames jit/remat wrapper
+        self.params: List[str] = []
+        self.static_params: Set[str] = set()
+        args = getattr(node, "args", None)
+        if args is not None:
+            allargs = (
+                list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+            )
+            for a in allargs:
+                self.params.append(a.arg)
+                if a.arg in _STATIC_PARAM_NAMES or _annotation_static(
+                    _ann_str(a.annotation)
+                ):
+                    self.static_params.add(a.arg)
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    def returns(self) -> List[List[Optional[ast.AST]]]:
+        """Per-return list of element exprs ([expr] or tuple elements)."""
+        if self._return_elts is None:
+            elts: List[List[Optional[ast.AST]]] = []
+            body = getattr(self.node, "body", [])
+            for stmt in ast.walk(ast.Module(body=list(body), type_ignores=[])):
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    continue  # walk() still descends; nested returns filtered below
+            for stmt in _returns_of(self.node):
+                v = stmt.value
+                if isinstance(v, ast.Tuple):
+                    elts.append(list(v.elts))
+                else:
+                    elts.append([v])
+            self._return_elts = elts
+        return self._return_elts
+
+
+def _returns_of(fn_node: ast.AST) -> List[ast.Return]:
+    """Return statements belonging to fn_node itself (not nested defs)."""
+    out: List[ast.Return] = []
+
+    def visit(stmts):
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(s, ast.Return):
+                out.append(s)
+            for attr in ("body", "orelse", "finalbody"):
+                visit(getattr(s, attr, []))
+            for h in getattr(s, "handlers", []):
+                visit(h.body)
+
+    visit(getattr(fn_node, "body", []))
+    return out
+
+
+class ModuleInfo:
+    def __init__(self, path: str, relpath: str, modname: str, tree: ast.Module):
+        self.path = path
+        self.relpath = relpath
+        self.modname = modname  # dotted, e.g. pkg.train.trainer
+        self.tree = tree
+        self.imports: Dict[str, str] = {}  # local name -> dotted target
+        self.functions: Dict[str, FunctionInfo] = {}  # qualname -> info
+        self.toplevel: Dict[str, FunctionInfo] = {}
+        # class name -> attr name -> list of resolution dicts
+        self.class_attrs: Dict[str, Dict[str, List[Dict[str, Any]]]] = {}
+
+
+class Index:
+    """Cross-module symbol index + call graph + jit-reachability."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}  # modname -> info
+        self.by_dotted: Dict[str, FunctionInfo] = {}  # pkg.mod.qualname -> fn
+        self.methods_by_name: Dict[str, List[FunctionInfo]] = {}
+        self.edges: Dict[FunctionInfo, Set[FunctionInfo]] = {}
+        self.roots: Set[FunctionInfo] = set()
+        # donating callables: identifier -> donated positional indices.
+        # identifiers: "Class.attr" for self-attrs, "mod.qual" for locals
+        self.donating: Dict[str, Tuple[int, ...]] = {}
+        # static-arg callables: dotted fn -> static param names
+        self.static_args: Dict[str, Set[str]] = {}
+        # memo caches (also cycle-breakers for mutually-recursive factories)
+        self._returned_memo: Dict[Any, Tuple[List[FunctionInfo], Optional[Tuple[int, ...]]]] = {}
+        self._aliases_memo: Dict["FunctionInfo", Dict[str, List[Any]]] = {}
+
+
+def _module_name(path: str, package_root: str) -> str:
+    rel = os.path.relpath(path, os.path.dirname(package_root))
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace(os.sep, ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _collect_imports(mi: ModuleInfo) -> None:
+    pkg_parts = mi.modname.split(".")
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mi.imports[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                )
+                if alias.asname:
+                    mi.imports[alias.asname] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:  # relative import
+                base = pkg_parts[: -(node.level)]
+                mod = ".".join(base + ([node.module] if node.module else []))
+            else:
+                mod = node.module or ""
+            for alias in node.names:
+                mi.imports[alias.asname or alias.name] = f"{mod}.{alias.name}"
+    # module-level simple aliases (e.g. `_shard_map = jax.shard_map`)
+    for stmt in mi.tree.body:
+        if isinstance(stmt, (ast.If, ast.Try)):
+            bodies = [stmt.body] + [getattr(stmt, "orelse", [])]
+            for b in bodies:
+                for s in b:
+                    _maybe_module_alias(mi, s)
+        else:
+            _maybe_module_alias(mi, stmt)
+
+
+def _maybe_module_alias(mi: ModuleInfo, stmt: ast.stmt) -> None:
+    if (
+        isinstance(stmt, ast.Assign)
+        and len(stmt.targets) == 1
+        and isinstance(stmt.targets[0], ast.Name)
+    ):
+        d = _dotted(stmt.value)
+        if d is not None:
+            root = d.split(".")[0]
+            resolved = mi.imports.get(root)
+            if resolved is not None:
+                d = resolved + d[len(root):]
+            mi.imports.setdefault(stmt.targets[0].id, d)
+
+
+def _collect_functions(mi: ModuleInfo) -> None:
+    def visit(stmts, prefix: str, parent: Optional[FunctionInfo], cls: Optional[str]):
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{s.name}" if prefix else s.name
+                fi = FunctionInfo(mi, qual, s, parent, cls)
+                mi.functions[qual] = fi
+                if parent is None and cls is None:
+                    mi.toplevel[s.name] = fi
+                elif parent is not None:
+                    parent.nested[s.name] = fi
+                visit(s.body, qual + ".", fi, None)
+            elif isinstance(s, ast.ClassDef):
+                visit(s.body, f"{prefix}{s.name}.", None, s.name)
+            elif isinstance(s, (ast.If, ast.Try, ast.With, ast.For, ast.While)):
+                for attr in ("body", "orelse", "finalbody"):
+                    visit(getattr(s, attr, []), prefix, parent, cls)
+                for h in getattr(s, "handlers", []):
+                    visit(h.body, prefix, parent, cls)
+
+    visit(mi.tree.body, "", None, None)
+
+
+def build_index(paths: Sequence[str], package_root: str) -> Index:
+    idx = Index()
+    repo_root = os.path.dirname(os.path.abspath(package_root))
+    for path in paths:
+        with open(path) as f:
+            src = f.read()
+        tree = ast.parse(src, filename=path)
+        ap = os.path.abspath(path)
+        if ap.startswith(repo_root + os.sep):
+            rel = os.path.relpath(ap, repo_root)
+        else:
+            rel = os.path.basename(ap)
+        mi = ModuleInfo(ap, rel.replace(os.sep, "/"), _module_name(ap, package_root), tree)
+        _collect_imports(mi)
+        _collect_functions(mi)
+        idx.modules[mi.modname] = mi
+        for qual, fi in mi.functions.items():
+            idx.by_dotted[f"{mi.modname}.{qual}"] = fi
+            idx.methods_by_name.setdefault(fi.name, []).append(fi)
+    _resolve_class_attrs(idx)
+    _discover(idx)
+    _mark_reachable(idx)
+    return idx
+
+
+# ------------------------------------------------------------- resolution
+
+
+def _resolve_dotted_prefix(mi: ModuleInfo, dotted: str) -> str:
+    """Substitute the leading import alias in a dotted chain."""
+    root, _, rest = dotted.partition(".")
+    target = mi.imports.get(root)
+    if target is None:
+        return dotted
+    return f"{target}.{rest}" if rest else target
+
+
+def _resolve_name(
+    idx: Index, fn: Optional[FunctionInfo], mi: ModuleInfo, name: str,
+    aliases: Optional[Dict[str, List[Any]]] = None, _depth: int = 0,
+) -> List[Any]:
+    """Resolve a bare name to FunctionInfo(s) or a dotted external string."""
+    if _depth > 6:
+        return []
+    if aliases and name in aliases:
+        out: List[Any] = []
+        for tgt in aliases[name]:
+            if isinstance(tgt, str):
+                out.extend(
+                    _resolve_name(idx, fn, mi, tgt, aliases=None, _depth=_depth + 1)
+                )
+            else:
+                out.append(tgt)
+        if out:
+            return out
+    scope = fn
+    while scope is not None:
+        if name in scope.nested:
+            return [scope.nested[name]]
+        if scope.cls is None and scope.parent is None and name == scope.name:
+            break
+        scope = scope.parent
+    if name in mi.toplevel:
+        return [mi.toplevel[name]]
+    if name in mi.imports:
+        dotted = mi.imports[name]
+        target = idx.by_dotted.get(dotted)
+        if target is not None:
+            return [target]
+        # maybe a re-export through an __init__: try "<mod>.<name>" tails
+        for modname, m in idx.modules.items():
+            if dotted == f"{modname}.{name}" and name in m.toplevel:
+                return [m.toplevel[name]]
+        # package __init__ re-export: resolve one indirection
+        mod_part = dotted.rsplit(".", 1)[0]
+        m = idx.modules.get(mod_part)
+        if m is not None and name in m.imports:
+            return _resolve_name(idx, None, m, name, _depth=_depth + 1)
+        return [dotted]
+    return []
+
+
+def _resolve_callee(
+    idx: Index, fn: Optional[FunctionInfo], mi: ModuleInfo, node: ast.AST,
+    aliases: Optional[Dict[str, List[Any]]] = None,
+) -> List[Any]:
+    """Resolve a call target expr to FunctionInfo(s) and/or dotted strings."""
+    if isinstance(node, ast.Name):
+        return _resolve_name(idx, fn, mi, node.id, aliases)
+    if isinstance(node, ast.Attribute):
+        d = _dotted(node)
+        if d is None:
+            return []
+        if d.startswith("self.") and fn is not None and fn.cls is not None:
+            entries = mi.class_attrs.get(fn.cls, {}).get(d[len("self."):], [])
+            out = []
+            for e in entries:
+                if e.get("func") is not None:
+                    out.append(e["func"])
+            return out or [d]
+        resolved = _resolve_dotted_prefix(mi, d)
+        target = idx.by_dotted.get(resolved)
+        if target is not None:
+            return [target]
+        # a method path like pkg.mod.Class.method
+        return [resolved]
+    return []
+
+
+def _callable_from_expr(
+    idx: Index, fn: Optional[FunctionInfo], mi: ModuleInfo, expr: ast.AST,
+    aliases: Optional[Dict[str, List[Any]]] = None, _depth: int = 0,
+) -> Tuple[List[FunctionInfo], Optional[Tuple[int, ...]]]:
+    """(functions, donate) for an expr that evaluates to a callable.
+
+    Handles: a bare function reference, ``jax.jit(fn, ...)``,
+    ``shard_map(fn, ...)``, ``partial(jax.jit, ...)`` decorators, a
+    factory call whose return is a nested def, and aliases of any of
+    those. ``donate`` is the donate_argnums tuple if a jit wrapper in the
+    chain donates.
+    """
+    if _depth > 6:
+        return [], None
+    donate: Optional[Tuple[int, ...]] = None
+    if isinstance(expr, (ast.Name, ast.Attribute)):
+        targets = _resolve_callee(idx, fn, mi, expr, aliases)
+        return [t for t in targets if isinstance(t, FunctionInfo)], None
+    if isinstance(expr, ast.Call):
+        callee = _resolve_callee(idx, fn, mi, expr.func, aliases)
+        dotted = [t for t in callee if isinstance(t, str)]
+        fis = [t for t in callee if isinstance(t, FunctionInfo)]
+        if any(d in _JIT_NAMES for d in dotted):
+            for kw in expr.keywords:
+                if kw.arg == "donate_argnums":
+                    donate = _int_tuple(kw.value)
+            if expr.args:
+                inner, inner_donate = _callable_from_expr(
+                    idx, fn, mi, expr.args[0], aliases, _depth + 1
+                )
+                return inner, donate if donate is not None else inner_donate
+            return [], donate
+        if any(d in _SHARD_MAP_NAMES for d in dotted):
+            if expr.args:
+                return _callable_from_expr(
+                    idx, fn, mi, expr.args[0], aliases, _depth + 1
+                )[:1] + (None,) if False else (
+                    _callable_from_expr(idx, fn, mi, expr.args[0], aliases, _depth + 1)[0],
+                    None,
+                )
+            return [], None
+        if any(d.endswith("functools.partial") or d == "partial" for d in dotted):
+            if expr.args:
+                return _callable_from_expr(
+                    idx, fn, mi, expr.args[0], aliases, _depth + 1
+                )
+            return [], None
+        # factory call: follow the factory's returned function(s)
+        out: List[FunctionInfo] = []
+        for factory in fis:
+            rf, rd = _returned_functions(idx, factory, index=None)
+            out.extend(rf)
+            donate = donate if donate is not None else rd
+        return out, donate
+    return [], None
+
+
+def _int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                vals.append(e.value)
+        return tuple(vals)
+    return None
+
+
+def _str_tuple(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return ()
+
+
+def _returned_functions(
+    idx: Index, factory: FunctionInfo, index: Optional[int]
+) -> Tuple[List[FunctionInfo], Optional[Tuple[int, ...]]]:
+    """Functions a factory returns (element ``index`` of tuple returns,
+    or any element when None); plus donate info from a jit wrapper."""
+    memo_key = (factory, index)
+    if memo_key in idx._returned_memo:
+        return idx._returned_memo[memo_key]
+    # seed with the empty answer to cut cycles (mutually-recursive
+    # factories resolve to nothing rather than recursing forever)
+    idx._returned_memo[memo_key] = ([], None)
+    out: List[FunctionInfo] = []
+    donate: Optional[Tuple[int, ...]] = None
+    aliases = _local_aliases(idx, factory)
+    for elts in factory.returns():
+        chosen = elts if index is None else (
+            [elts[index]] if index < len(elts) else []
+        )
+        for e in chosen:
+            if e is None:
+                continue
+            fis, d = _callable_from_expr(
+                idx, factory, factory.module, e, aliases, _depth=1
+            )
+            out.extend(fis)
+            if d is not None:
+                donate = d
+    idx._returned_memo[memo_key] = (out, donate)
+    return out, donate
+
+
+def _local_aliases(idx: Index, fn: FunctionInfo) -> Dict[str, List[Any]]:
+    """name -> [FunctionInfo|name] for simple aliasing assignments inside
+    ``fn`` (incl. tuple-assign pairs like ``body, spec = f, P(...)``)."""
+    if fn in idx._aliases_memo:
+        return idx._aliases_memo[fn]
+    aliases: Dict[str, List[Any]] = {}
+    idx._aliases_memo[fn] = aliases  # pre-register to cut cycles
+
+    def add(name: str, value: ast.AST) -> None:
+        if isinstance(value, ast.Name):
+            aliases.setdefault(name, []).append(value.id)
+        elif isinstance(value, (ast.Attribute, ast.Call)):
+            fis, _ = _callable_from_expr(idx, fn, fn.module, value, None)
+            for f in fis:
+                aliases.setdefault(name, []).append(f)
+
+    for stmt in ast.walk(fn.node):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            tgt, val = stmt.targets[0], stmt.value
+            if isinstance(tgt, ast.Name):
+                add(tgt.id, val)
+            elif (
+                isinstance(tgt, ast.Tuple)
+                and isinstance(val, ast.Tuple)
+                and len(tgt.elts) == len(val.elts)
+            ):
+                for t, v in zip(tgt.elts, val.elts):
+                    if isinstance(t, ast.Name):
+                        add(t.id, v)
+    return aliases
+
+
+def _resolve_class_attrs(idx: Index) -> None:
+    """Fill ModuleInfo.class_attrs: ``self.x = ...`` bindings resolved to
+    functions where possible (jit wrappers recording donate_argnums)."""
+    for mi in idx.modules.values():
+        for qual, fi in mi.functions.items():
+            if fi.cls is None:
+                continue
+            table = mi.class_attrs.setdefault(fi.cls, {})
+            for stmt in ast.walk(fi.node):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                targets = stmt.targets
+                if len(targets) != 1:
+                    continue
+                tgt = targets[0]
+                if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+                    fis, donate = _callable_from_expr(idx, fi, mi, stmt.value)
+                    entry: Dict[str, Any] = {
+                        "func": fis[0] if fis else None,
+                        "funcs": fis,
+                        "donate": donate,
+                    }
+                    # value may instead be a tracer-returning call result
+                    table.setdefault(tgt.attr, []).append(entry)
+                    if donate:
+                        idx.donating[f"{fi.cls}.{tgt.attr}"] = donate
+                elif isinstance(tgt, ast.Tuple) and isinstance(stmt.value, ast.Call):
+                    # self.a, self.b = factory(...)
+                    callee = _resolve_callee(idx, fi, mi, stmt.value.func)
+                    factories = [t for t in callee if isinstance(t, FunctionInfo)]
+                    for i, t in enumerate(tgt.elts):
+                        if not (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            continue
+                        fis: List[FunctionInfo] = []
+                        donate = None
+                        for fac in factories:
+                            rf, rd = _returned_functions(idx, fac, index=i)
+                            fis.extend(rf)
+                            donate = donate if donate is not None else rd
+                        table.setdefault(t.attr, []).append(
+                            {"func": fis[0] if fis else None, "funcs": fis, "donate": donate}
+                        )
+                        if donate:
+                            idx.donating[f"{fi.cls}.{t.attr}"] = donate
+
+
+def _discover(idx: Index) -> None:
+    """Find jit/shard_map roots, donating callables, and static-arg specs."""
+    for mi in idx.modules.values():
+        # decorators
+        for fi in mi.functions.values():
+            for dec in getattr(fi.node, "decorator_list", []):
+                d = _dotted(dec) if not isinstance(dec, ast.Call) else _dotted(dec.func)
+                if d is None:
+                    continue
+                rd = _resolve_dotted_prefix(mi, d)
+                if rd in _JIT_NAMES:
+                    idx.roots.add(fi)
+                    if isinstance(dec, ast.Call):
+                        _record_static(idx, mi, fi, dec.keywords)
+                elif rd.endswith("functools.partial") and isinstance(dec, ast.Call):
+                    inner = dec.args[0] if dec.args else None
+                    di = _dotted(inner) if inner is not None else None
+                    if di is not None and _resolve_dotted_prefix(mi, di) in _JIT_NAMES:
+                        idx.roots.add(fi)
+                        _record_static(idx, mi, fi, dec.keywords)
+        # call sites
+        for qual, fi in list(mi.functions.items()):
+            aliases = _local_aliases(idx, fi)
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _resolve_callee(idx, fi, mi, node.func, aliases)
+                dotted = [t for t in callee if isinstance(t, str)]
+                if any(d in _JIT_NAMES or d in _SHARD_MAP_NAMES for d in dotted):
+                    if node.args:
+                        fis, donate = _callable_from_expr(
+                            idx, fi, mi, node.args[0], aliases
+                        )
+                        idx.roots.update(fis)
+                        for kw in node.keywords:
+                            if kw.arg == "donate_argnums":
+                                donate = _int_tuple(kw.value) or donate
+                        if donate:
+                            for f in fis:
+                                idx.donating[
+                                    f"{f.module.modname}.{f.qualname}"
+                                ] = donate
+                if any(d in _REMAT_NAMES for d in dotted) and node.args:
+                    fis, _ = _callable_from_expr(idx, fi, mi, node.args[0], aliases)
+                    for kw in node.keywords:
+                        if kw.arg in ("static_argnums", "static_argnames"):
+                            for f in fis:
+                                _record_static_for(idx, f, kw)
+        # module-level jit sites (`jitted = jax.jit(step, ...)` at top
+        # level): not inside any function, so the walk above misses them
+        for stmt in mi.tree.body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    break  # function bodies were handled with local scope
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _resolve_callee(idx, None, mi, node.func)
+                dotted = [t for t in callee if isinstance(t, str)]
+                if any(d in _JIT_NAMES or d in _SHARD_MAP_NAMES for d in dotted) and node.args:
+                    fis, donate = _callable_from_expr(idx, None, mi, node.args[0])
+                    idx.roots.update(fis)
+                    for kw in node.keywords:
+                        if kw.arg == "donate_argnums":
+                            donate = _int_tuple(kw.value) or donate
+                    if donate:
+                        for f in fis:
+                            idx.donating[f"{f.module.modname}.{f.qualname}"] = donate
+                        if (
+                            isinstance(stmt, ast.Assign)
+                            and len(stmt.targets) == 1
+                            and isinstance(stmt.targets[0], ast.Name)
+                        ):
+                            # calls through the module-level binding donate too
+                            idx.donating[
+                                f"{mi.modname}.{stmt.targets[0].id}"
+                            ] = donate
+
+
+def _record_static(idx: Index, mi: ModuleInfo, fi: FunctionInfo, keywords) -> None:
+    for kw in keywords:
+        if kw.arg in ("static_argnums", "static_argnames"):
+            _record_static_for(idx, fi, kw)
+
+
+def _record_static_for(idx: Index, fi: FunctionInfo, kw: ast.keyword) -> None:
+    key = f"{fi.module.modname}.{fi.qualname}"
+    names = idx.static_args.setdefault(key, set())
+    if kw.arg == "static_argnames":
+        names.update(_str_tuple(kw.value))
+    else:
+        nums = _int_tuple(kw.value) or ()
+        for n in nums:
+            if 0 <= n < len(fi.params):
+                names.add(fi.params[n])
+
+
+def _mark_reachable(idx: Index) -> None:
+    """BFS the call graph from the jit roots."""
+    # build edges
+    for mi in idx.modules.values():
+        for fi in mi.functions.values():
+            aliases = _local_aliases(idx, fi)
+            edges = idx.edges.setdefault(fi, set())
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                for t in _resolve_callee(idx, fi, mi, node.func, aliases):
+                    if isinstance(t, FunctionInfo):
+                        edges.add(t)
+                # function-reference arguments: lax.scan(body, ...),
+                # value_and_grad(loss_fn), tree_map(keep, ...)
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(arg, ast.Name):
+                        for t in _resolve_name(idx, fi, mi, arg.id, aliases):
+                            if isinstance(t, FunctionInfo):
+                                edges.add(t)
+                # flax dynamic dispatch: X.apply(..., method="name")
+                fd = _dotted(node.func)
+                if fd is not None and fd.endswith(".apply"):
+                    method = None
+                    for kw in node.keywords:
+                        if kw.arg == "method" and isinstance(kw.value, ast.Constant):
+                            method = kw.value.value
+                    for m in idx.methods_by_name.get(method or "__call__", []):
+                        if m.cls is not None:
+                            edges.add(m)
+            # nested defs are reachable from their parent by construction
+            edges.update(fi.nested.values())
+    seen: Set[FunctionInfo] = set()
+    frontier = list(idx.roots)
+    while frontier:
+        f = frontier.pop()
+        if f in seen:
+            continue
+        seen.add(f)
+        f.jit_reachable = True
+        frontier.extend(idx.edges.get(f, ()))
+
+
+# ----------------------------------------------------------- taint + rules
+
+
+class _Env:
+    __slots__ = ("tainted", "containers", "keys", "key_uses", "dead", "in_span")
+
+    def __init__(self) -> None:
+        self.tainted: Set[str] = set()
+        # names bound to Python containers (list/tuple/dict literals or
+        # comprehensions): their *truthiness* is a host length check even
+        # when the elements are tracers
+        self.containers: Set[str] = set()
+        self.keys: Set[str] = set()
+        self.key_uses: Dict[str, int] = {}
+        self.dead: Dict[str, int] = {}  # donated name -> line of donation
+        self.in_span = 0
+
+
+class _RuleWalker:
+    """Single in-order pass over one function's statements."""
+
+    def __init__(self, idx: Index, fi: FunctionInfo, findings: List[Finding]):
+        self.idx = idx
+        self.fi = fi
+        self.mi = fi.module
+        self.findings = findings
+        self.aliases = _local_aliases(idx, fi)
+        self.env = _Env()
+        if fi.jit_reachable:
+            for p in fi.params:
+                if p not in fi.static_params:
+                    self.env.tainted.add(p)
+
+    # ---------------- helpers
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(
+            Finding(
+                rule=rule,
+                path=self.mi.relpath,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                func=self.fi.qualname,
+                message=message,
+            )
+        )
+
+    def _callee_dotted(self, call: ast.Call) -> List[str]:
+        out = []
+        for t in _resolve_callee(self.idx, self.fi, self.mi, call.func, self.aliases):
+            if isinstance(t, str):
+                out.append(t)
+        d = _dotted(call.func)
+        if d is not None:
+            out.append(_resolve_dotted_prefix(self.mi, d))
+            out.append(d)
+        return out
+
+    def _callee_fns(self, call: ast.Call) -> List[FunctionInfo]:
+        return [
+            t
+            for t in _resolve_callee(self.idx, self.fi, self.mi, call.func, self.aliases)
+            if isinstance(t, FunctionInfo)
+        ]
+
+    def _returns_tracer(self, fn: FunctionInfo, _depth: int = 0) -> bool:
+        if fn._returns_tracer is not None:
+            return fn._returns_tracer
+        if _depth > 4:
+            return False
+        fn._returns_tracer = False  # cut recursion cycles
+        w = _RuleWalker(self.idx, fn, [])  # throwaway: taint only
+        result = False
+        for elts in fn.returns():
+            for e in elts:
+                if e is not None and w.tainted(e):
+                    result = True
+        fn._returns_tracer = result
+        return result
+
+    # ---------------- taint
+
+    def tainted(self, node: Optional[ast.AST]) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.env.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _SHAPE_ATTRS:
+                return False
+            return self.tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.tainted(node.value)
+        if isinstance(node, ast.Call):
+            return self.call_tainted(node)
+        if isinstance(node, (ast.BinOp,)):
+            return self.tainted(node.left) or self.tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            ops = node.ops
+            if all(isinstance(o, (ast.Is, ast.IsNot, ast.In, ast.NotIn)) for o in ops):
+                return False
+            if any(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in [node.left] + node.comparators
+            ):
+                return False
+            return self.tainted(node.left) or any(
+                self.tainted(c) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return self.tainted(node.body) or self.tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.tainted(v) for v in node.values if v is not None)
+        if isinstance(node, ast.Starred):
+            return self.tainted(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return self.tainted(node.elt) or any(
+                self.tainted(g.iter) for g in node.generators
+            )
+        if isinstance(node, ast.DictComp):
+            return self.tainted(node.value) or any(
+                self.tainted(g.iter) for g in node.generators
+            )
+        if isinstance(node, ast.JoinedStr):
+            return False
+        return False
+
+    def call_tainted(self, call: ast.Call) -> bool:
+        dotted = self._callee_dotted(call)
+        # host conversions return host values (JX001 flags them separately)
+        if isinstance(call.func, ast.Name) and call.func.id in (
+            "float", "int", "bool", "str", "len", "repr",
+        ):
+            return False
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "item":
+            return False
+        if any(d in _SYNC_CALLS for d in dotted):
+            return False
+        for d in dotted:
+            if d.startswith(_TRACER_CALL_PREFIXES) and not d.startswith(
+                ("jax.random.PRNGKey",)
+            ):
+                return True
+            if d in _PASSTHROUGH_CALLS:
+                return any(self.tainted(a) for a in call.args)
+        if any(d.startswith("jax.random.") for d in dotted):
+            return True
+        for fn in self._callee_fns(call):
+            if self._returns_tracer(fn):
+                return True
+        # method call on a tainted object (x.sum(), x.astype(...))
+        if isinstance(call.func, ast.Attribute) and self.tainted(call.func.value):
+            return True
+        return False
+
+    # ---------------- statement walk
+
+    def walk(self) -> None:
+        self._walk_stmts(getattr(self.fi.node, "body", []))
+
+    def _walk_stmts(self, stmts: Sequence[ast.stmt]) -> None:
+        for s in stmts:
+            self._stmt(s)
+
+    def _stmt(self, s: ast.stmt) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested defs are walked as their own functions
+        if isinstance(s, ast.Assign):
+            self._expr(s.value)
+            self._assign(s.targets, s.value, s)
+        elif isinstance(s, ast.AugAssign):
+            self._expr(s.value)
+            if isinstance(s.target, ast.Name):
+                if self.tainted(s.value):
+                    self.env.tainted.add(s.target.id)
+                self._revive(s.target.id)
+        elif isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self._expr(s.value)
+                self._assign([s.target], s.value, s)
+        elif isinstance(s, (ast.If, ast.While)):
+            # `not isinstance(x, ...Tracer) and <rest>` is the idiomatic
+            # "host value only" guard: x is proven concrete for the rest
+            # of the test and the body — narrow its taint there.
+            guarded = self._tracer_guarded_names(s.test)
+            re_taint = guarded & self.env.tainted
+            self.env.tainted -= guarded
+            self._expr(s.test)
+            if self.fi.jit_reachable and self._truth_tainted(s.test):
+                kind = "if" if isinstance(s, ast.If) else "while"
+                self._emit(
+                    "JX002",
+                    s,
+                    f"`{kind}` branches on a tracer value inside jit-reachable "
+                    f"`{self.fi.qualname}` — use jnp.where/lax.cond, or mark "
+                    "the argument static",
+                )
+            self._walk_stmts(s.body)
+            self.env.tainted |= re_taint
+            self._walk_stmts(s.orelse)
+        elif isinstance(s, ast.For):
+            self._expr(s.iter)
+            if isinstance(s.target, ast.Name) and self.tainted(s.iter):
+                self.env.tainted.add(s.target.id)
+            self._walk_stmts(s.body)
+            self._walk_stmts(s.orelse)
+        elif isinstance(s, ast.With):
+            spanned = any(self._is_span(item.context_expr) for item in s.items)
+            for item in s.items:
+                self._expr(item.context_expr)
+            if spanned:
+                self.env.in_span += 1
+            self._walk_stmts(s.body)
+            if spanned:
+                self.env.in_span -= 1
+        elif isinstance(s, ast.Try):
+            self._walk_stmts(s.body)
+            for h in s.handlers:
+                self._walk_stmts(h.body)
+            self._walk_stmts(s.orelse)
+            self._walk_stmts(s.finalbody)
+        elif isinstance(s, ast.Return):
+            if s.value is not None:
+                self._expr(s.value)
+        elif isinstance(s, ast.Expr):
+            self._expr(s.value)
+            if isinstance(s.value, ast.Call):
+                self._donating_call(s.value, targets=[])
+        elif isinstance(s, (ast.Raise, ast.Assert)):
+            for sub in ast.walk(s):
+                if isinstance(sub, ast.Call):
+                    self._expr(sub)
+                    break
+        elif isinstance(s, ast.Delete):
+            for t in s.targets:
+                if isinstance(t, ast.Name):
+                    self.env.tainted.discard(t.id)
+                    self.env.dead.pop(t.id, None)
+
+    def _tracer_guarded_names(self, test: ast.AST) -> Set[str]:
+        """Names proven non-tracer by a ``not isinstance(x, ...Tracer)``
+        conjunct in ``test``."""
+        out: Set[str] = set()
+        conjuncts = test.values if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And) else [test]
+        for c in conjuncts:
+            if not (isinstance(c, ast.UnaryOp) and isinstance(c.op, ast.Not)):
+                continue
+            call = c.operand
+            if not (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Name)
+                and call.func.id == "isinstance"
+                and len(call.args) == 2
+                and isinstance(call.args[0], ast.Name)
+            ):
+                continue
+            cls = _dotted(call.args[1])
+            if cls is not None and cls.endswith("Tracer"):
+                out.add(call.args[0].id)
+        return out
+
+    def _truth_tainted(self, test: ast.AST) -> bool:
+        """Like ``tainted`` but for truthiness: ``if xs`` / ``if not xs``
+        on a Python container is a host length check even when the
+        elements are tracers."""
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._truth_tainted(test.operand)
+        if isinstance(test, ast.Name) and test.id in self.env.containers:
+            return False
+        if isinstance(test, ast.BoolOp):
+            return any(self._truth_tainted(v) for v in test.values)
+        return self.tainted(test)
+
+    def _is_span(self, expr: ast.AST) -> bool:
+        if not isinstance(expr, ast.Call):
+            return False
+        f = expr.func
+        if isinstance(f, ast.Attribute) and f.attr == "span":
+            return True
+        if isinstance(f, ast.Name) and "span" in f.id.lower():
+            return True
+        return False
+
+    def _assign(self, targets, value: ast.AST, stmt: ast.stmt) -> None:
+        names = [t.id for t in ast.walk(ast.Tuple(elts=list(targets), ctx=ast.Store())) if isinstance(t, ast.Name)]
+        tgt_dotted = set()
+        for t in targets:
+            for sub in ast.walk(t):
+                d = _dotted(sub)
+                if d is not None:
+                    tgt_dotted.add(d)
+        if isinstance(value, ast.Call):
+            self._donating_call(value, targets=sorted(tgt_dotted))
+        value_tainted = self.tainted(value)
+        # pairwise tuple-to-tuple assignment keeps taint per element
+        if (
+            len(targets) == 1
+            and isinstance(targets[0], ast.Tuple)
+            and isinstance(value, ast.Tuple)
+            and len(targets[0].elts) == len(value.elts)
+        ):
+            for t, v in zip(targets[0].elts, value.elts):
+                if isinstance(t, ast.Name):
+                    self._set_taint(t.id, self.tainted(v))
+                    self._track_key(t.id, v)
+            return
+        container = isinstance(
+            value,
+            (ast.List, ast.Tuple, ast.Set, ast.Dict, ast.ListComp, ast.SetComp, ast.DictComp),
+        )
+        for name in names:
+            self._set_taint(name, value_tainted)
+            if container:
+                self.env.containers.add(name)
+            else:
+                self.env.containers.discard(name)
+            self._track_key(name, value)
+
+    def _set_taint(self, name: str, tainted: bool) -> None:
+        if tainted:
+            self.env.tainted.add(name)
+        else:
+            self.env.tainted.discard(name)
+        self._revive(name)
+
+    def _revive(self, name: str) -> None:
+        self.env.dead.pop(name, None)
+        # a rebind of a key name resets its use count
+        if name in self.env.keys:
+            self.env.key_uses[name] = 0
+
+    def _track_key(self, name: str, value: ast.AST) -> None:
+        if not isinstance(value, ast.Call):
+            return
+        dotted = self._callee_dotted(value)
+        if any(
+            d in ("jax.random.PRNGKey", "jax.random.split", "jax.random.fold_in", "jax.random.key")
+            for d in dotted
+        ):
+            self.env.keys.add(name)
+            self.env.key_uses[name] = 0
+
+    def _donating_call(self, call: ast.Call, targets: List[str]) -> None:
+        """JX003 bookkeeping: mark donated args dead unless reassigned."""
+        donate: Optional[Tuple[int, ...]] = None
+        f = call.func
+        d = _dotted(f)
+        if d is not None and d.startswith("self.") and self.fi.cls is not None:
+            donate = self.idx.donating.get(f"{self.fi.cls}.{d[len('self.'):]}")
+        if donate is None and isinstance(f, ast.Name):
+            # a module-level jitted binding (`jitted = jax.jit(fn, ...)`)
+            donate = self.idx.donating.get(f"{self.mi.modname}.{f.id}")
+        if donate is None and isinstance(f, ast.Name):
+            for t in _resolve_name(self.idx, self.fi, self.mi, f.id, self.aliases):
+                if isinstance(t, FunctionInfo):
+                    donate = self.idx.donating.get(
+                        f"{t.module.modname}.{t.qualname}"
+                    )
+                    if donate:
+                        break
+                elif isinstance(t, str):
+                    donate = self.idx.donating.get(t)
+                    if donate:
+                        break
+            # locally-jitted donating callable: `step = jax.jit(f, donate_...)`
+            if donate is None and f.id in self.aliases:
+                pass
+        if not donate:
+            return
+        for i in donate:
+            if i >= len(call.args):
+                continue
+            arg = call.args[i]
+            ad = _dotted(arg)
+            if ad is None:
+                continue
+            if ad in targets:
+                continue  # donated buffer is rebound by this statement: safe
+            self.env.dead[ad] = getattr(call, "lineno", 0)
+
+    # ---------------- expression rules
+
+    def _expr(self, node: Optional[ast.AST]) -> None:
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._check_call(sub)
+            elif isinstance(sub, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(sub, "ctx", None), ast.Load
+            ):
+                d = _dotted(sub)
+                if d is not None and d in self.env.dead:
+                    self._emit(
+                        "JX003",
+                        sub,
+                        f"`{d}` was donated to a dispatch at line "
+                        f"{self.env.dead[d]} and read again — its buffer may "
+                        "already be reused; rebind the result "
+                        "(`x, out = jitted(x, ...)`) or pass a copy",
+                    )
+                    self.env.dead.pop(d, None)  # one report per donation
+
+    def _check_call(self, call: ast.Call) -> None:
+        dotted = self._callee_dotted(call)
+        # ---- JX001: host conversion of a tracer value
+        conv = None
+        if isinstance(call.func, ast.Name) and call.func.id in ("float", "int"):
+            conv = call.func.id
+            arg = call.args[0] if call.args else None
+        elif isinstance(call.func, ast.Attribute) and call.func.attr == "item" and not call.args:
+            conv = ".item()"
+            arg = call.func.value
+        elif any(d in ("numpy.asarray", "numpy.array", "np.asarray", "np.array") for d in dotted):
+            conv = "np.asarray"
+            arg = call.args[0] if call.args else None
+        else:
+            arg = None
+        if conv is not None and arg is not None and self.tainted(arg):
+            where = (
+                "inside jit-reachable code (device sync per call)"
+                if self.fi.jit_reachable
+                else "in host code (forces a device sync of un-jitted jnp math)"
+            )
+            self._emit(
+                "JX001",
+                call,
+                f"`{conv}` applied to a jnp value {where} — keep the math in "
+                "jnp, or fetch once at a sync boundary via jax.device_get",
+            )
+        # ---- JX005: key reuse
+        if any(d.startswith("jax.random.") for d in dotted) and not any(
+            d in ("jax.random.PRNGKey", "jax.random.key", "jax.random.fold_in")
+            for d in dotted
+        ):
+            if call.args and isinstance(call.args[0], ast.Name):
+                name = call.args[0].id
+                if name in self.env.keys:
+                    self.env.key_uses[name] = self.env.key_uses.get(name, 0) + 1
+                    if self.env.key_uses[name] >= 2:
+                        self._emit(
+                            "JX005",
+                            call,
+                            f"key `{name}` consumed by a second jax.random "
+                            "call without an intervening split — identical "
+                            "randomness; split (or fold_in) first",
+                        )
+        # ---- JX006: un-spanned sync
+        sync = None
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "block_until_ready":
+            sync = "block_until_ready"
+        elif any(d in _SYNC_CALLS for d in dotted):
+            sync = next(d for d in dotted if d in _SYNC_CALLS).split(".")[-1]
+        if sync is not None and not self.env.in_span:
+            self._emit(
+                "JX006",
+                call,
+                f"`{sync}` outside a telemetry span — sync time is "
+                "unattributed; wrap in `tracer.span(...)` (telemetry/spans.py) "
+                "or waive with a reason if a caller holds the span",
+            )
+        # ---- JX004: mutable static args
+        self._check_static_args(call, dotted)
+
+    def _check_static_args(self, call: ast.Call, dotted: List[str]) -> None:
+        static: Set[str] = set()
+        target: Optional[FunctionInfo] = None
+        for t in self._callee_fns(call):
+            key = f"{t.module.modname}.{t.qualname}"
+            if key in self.idx.static_args:
+                static = self.idx.static_args[key]
+                target = t
+                break
+        if not static or target is None:
+            return
+
+        def mutable(expr: ast.AST) -> bool:
+            if isinstance(expr, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)):
+                return True
+            return False
+
+        for kw in call.keywords:
+            if kw.arg in static and mutable(kw.value):
+                self._emit(
+                    "JX004",
+                    call,
+                    f"static arg `{kw.arg}` of `{target.name}` gets a "
+                    "mutable (unhashable) value — jit static args must be "
+                    "hashable; pass a tuple",
+                )
+        for i, arg in enumerate(call.args):
+            if i < len(target.params) and target.params[i] in static and mutable(arg):
+                self._emit(
+                    "JX004",
+                    call,
+                    f"static arg `{target.params[i]}` of `{target.name}` gets "
+                    "a mutable (unhashable) value — jit static args must be "
+                    "hashable; pass a tuple",
+                )
+
+
+def _static_defaults(idx: Index, findings: List[Finding]) -> None:
+    """JX004 at the definition: a static param defaulting to a mutable."""
+    for key, static in idx.static_args.items():
+        fi = idx.by_dotted.get(key)
+        if fi is None:
+            continue
+        args = getattr(fi.node, "args", None)
+        if args is None:
+            continue
+        pos = list(args.posonlyargs) + list(args.args)
+        defaults = list(args.defaults)
+        for a, d in zip(pos[len(pos) - len(defaults):], defaults):
+            if a.arg in static and isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                findings.append(
+                    Finding(
+                        rule="JX004",
+                        path=fi.module.relpath,
+                        line=d.lineno,
+                        col=d.col_offset,
+                        func=fi.qualname,
+                        message=(
+                            f"static param `{a.arg}` defaults to a mutable "
+                            "(unhashable) literal — use a tuple"
+                        ),
+                    )
+                )
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is not None and a.arg in static and isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                findings.append(
+                    Finding(
+                        rule="JX004",
+                        path=fi.module.relpath,
+                        line=d.lineno,
+                        col=d.col_offset,
+                        func=fi.qualname,
+                        message=(
+                            f"static param `{a.arg}` defaults to a mutable "
+                            "(unhashable) literal — use a tuple"
+                        ),
+                    )
+                )
+
+
+# ----------------------------------------------------------------- drivers
+
+
+def package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.toml")
+
+
+def iter_package_files(root: Optional[str] = None) -> List[str]:
+    root = root or package_root()
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                out.append(os.path.join(dirpath, name))
+    return sorted(out)
+
+
+def lint_paths(
+    paths: Sequence[str],
+    baseline: Optional[str] = None,
+    pkg_root: Optional[str] = None,
+) -> LintResult:
+    """Lint explicit files. ``baseline`` is a path to a suppression TOML
+    (None = no suppressions)."""
+    idx = build_index(list(paths), pkg_root or package_root())
+    raw: List[Finding] = []
+    for mi in idx.modules.values():
+        for fi in mi.functions.values():
+            _RuleWalker(idx, fi, raw).walk()
+    _static_defaults(idx, raw)
+    raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    base = load_baseline(baseline) if baseline else Baseline()
+    findings: List[Finding] = []
+    suppressed: List[Tuple[Finding, str]] = []
+    excluded: List[Finding] = []
+    for f in raw:
+        if base.excluded(f):
+            excluded.append(f)
+            continue
+        w = base.waive(f)
+        if w is not None:
+            suppressed.append((f, w.reason))
+        else:
+            findings.append(f)
+    stale = [w for w in base.waivers if not w.used]
+    return LintResult(findings, suppressed, excluded, stale)
+
+
+def lint_package(baseline: Optional[str] = "default") -> LintResult:
+    """Lint every module of the installed package against the committed
+    baseline (pass ``baseline=None`` for raw findings)."""
+    if baseline == "default":
+        baseline = default_baseline_path()
+        if not os.path.exists(baseline):
+            baseline = None
+    return lint_paths(iter_package_files(), baseline=baseline)

@@ -1,0 +1,219 @@
+"""Strict mode: runtime proof of jit hygiene.
+
+jaxlint (analysis/jaxlint.py) reasons about the source; this harness
+checks the same contract at runtime, where dynamic feeds and real
+shardings live. Under ``debug.strict`` / ``--strict`` the trainer (and
+the CLI bounded-step loop) run with:
+
+* ``jax.transfer_guard("disallow")`` engaged globally for the whole
+  session — any *implicit* host<->device transfer raises immediately with
+  a traceback at the offending line. Explicit ``jax.device_put`` /
+  ``jax.device_get`` are exempt by JAX itself, which is exactly the
+  contract jaxlint's JX001/JX006 push toward: transfers happen only where
+  the code says so.
+* a recompile detector around every dispatch site — the first
+  ``warmup_dispatches`` calls of each named program are expected to
+  compile (and run under a thread-local ``transfer_guard("allow")``,
+  since trace-time constant staging is legitimately implicit); after
+  that, any growth in the program's jit cache (``fn._cache_size()``) or
+  any XLA backend-compile event observed during a warm dispatch raises
+  :class:`StrictViolation` naming the program.
+
+The acceptance contract this enforces: post-warmup, N trainer steps
+perform **zero** implicit transfers and **zero** recompiles on every
+feed (loader, --cache-device, spmd, fused K>1).
+
+Typical wiring (see train/trainer.py)::
+
+    strict = StrictHarness()
+    with strict.session():
+        for batch in feed:
+            with strict.dispatch("train_step", jitted_step):
+                state, metrics = jitted_step(state, batch)
+    report = strict.report()   # dispatch/compile counts per program
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+
+__all__ = ["StrictHarness", "StrictViolation"]
+
+
+class StrictViolation(RuntimeError):
+    """A strict-mode contract was broken (recompile after warmup).
+
+    Implicit-transfer violations surface as JAX's own transfer-guard
+    errors, which carry the exact offending line; this exception covers
+    the recompile half, naming the program and the evidence.
+    """
+
+
+# One process-wide compile-event counter. jax.monitoring has no
+# unregister API, so the listener must be installed once and count into
+# module state that outlives any particular harness.
+_compile_events = 0
+_listener_installed = False
+_listener_lock = threading.Lock()
+
+
+def _on_event_duration(event: str, duration: float, **kwargs: Any) -> None:
+    global _compile_events
+    if "backend_compile" in event:
+        _compile_events += 1
+
+
+def _install_compile_listener() -> None:
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return
+        jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+        _listener_installed = True
+
+
+def compile_event_count() -> int:
+    """Process-wide XLA backend-compile events seen since the listener
+    was installed (0 until a StrictHarness session has run)."""
+    return _compile_events
+
+
+class _ProgramState:
+    __slots__ = ("dispatches", "warm_dispatches", "cache_size", "compiles_during_warm")
+
+    def __init__(self) -> None:
+        self.dispatches = 0
+        self.warm_dispatches = 0
+        self.cache_size: Optional[int] = None
+        self.compiles_during_warm = 0
+
+
+class StrictHarness:
+    """Transfer-guard + recompile gate around dispatch sites.
+
+    ``warmup_dispatches`` — dispatches per program name that are allowed
+    to compile (and to transfer implicitly, for trace-time staging)
+    before the gate arms. Distinctly-shaped programs (e.g. a fused tail
+    chunk with a smaller K) must be given distinct names so each gets
+    its own warmup.
+    """
+
+    def __init__(self, warmup_dispatches: int = 1) -> None:
+        if warmup_dispatches < 1:
+            raise ValueError("warmup_dispatches must be >= 1")
+        self.warmup_dispatches = warmup_dispatches
+        self.programs: Dict[str, _ProgramState] = {}
+        self.violations: list[str] = []
+        self._active = False
+
+    # ------------------------------------------------------------- session
+
+    @contextlib.contextmanager
+    def session(self) -> Iterator["StrictHarness"]:
+        """Engage ``transfer_guard("disallow")`` globally and the compile
+        listener for the duration of the block."""
+        _install_compile_listener()
+        prev = getattr(jax.config, "jax_transfer_guard", None)
+        jax.config.update("jax_transfer_guard", "disallow")
+        self._active = True
+        try:
+            yield self
+        finally:
+            self._active = False
+            jax.config.update("jax_transfer_guard", prev or "allow")
+
+    # ------------------------------------------------------------ dispatch
+
+    @contextlib.contextmanager
+    def dispatch(
+        self, program: str, fn: Optional[Callable[..., Any]] = None
+    ) -> Iterator[None]:
+        """Wrap one dispatch of ``program``.
+
+        ``fn`` is the jitted callable, used for its per-program cache
+        size (``_cache_size``); pass the same object every time. During
+        warmup the body runs under a thread-local
+        ``transfer_guard("allow")``; once warm, the global "disallow"
+        stays in force and cache growth / compile events raise.
+        """
+        st = self.programs.setdefault(program, _ProgramState())
+        warm = st.dispatches >= self.warmup_dispatches
+        st.dispatches += 1
+        compiles_before = _compile_events
+        cache_before = self._cache_size(fn)
+        if warm:
+            yield
+            st.warm_dispatches += 1
+            cache_after = self._cache_size(fn)
+            compiled = _compile_events - compiles_before
+            st.compiles_during_warm += compiled
+            evidence = []
+            if (
+                cache_before is not None
+                and cache_after is not None
+                and cache_after > cache_before
+            ):
+                evidence.append(
+                    f"jit cache grew {cache_before}->{cache_after}"
+                )
+            if compiled:
+                evidence.append(f"{compiled} backend_compile event(s)")
+            if evidence:
+                msg = (
+                    f"strict mode: program '{program}' recompiled after "
+                    f"warmup (dispatch #{st.dispatches}): "
+                    + "; ".join(evidence)
+                    + " — a shape, dtype, or static-arg value changed "
+                    "between steps"
+                )
+                self.violations.append(msg)
+                raise StrictViolation(msg)
+        else:
+            # Warmup: tracing legitimately stages host constants to
+            # device; thread-local guard overrides the global disallow.
+            with jax.transfer_guard("allow"):
+                yield
+            st.cache_size = self._cache_size(fn)
+
+    @staticmethod
+    def _cache_size(fn: Optional[Callable[..., Any]]) -> Optional[int]:
+        if fn is None:
+            return None
+        probe = getattr(fn, "_cache_size", None)
+        if probe is None:
+            return None
+        try:
+            return int(probe())
+        except Exception:
+            return None
+
+    # -------------------------------------------------------------- report
+
+    def report(self) -> Dict[str, Any]:
+        """Machine-readable summary: per-program dispatch/compile counts
+        plus the process-wide compile-event total."""
+        return {
+            "active": self._active,
+            "warmup_dispatches": self.warmup_dispatches,
+            "compile_events_total": _compile_events,
+            "violations": list(self.violations),
+            "programs": {
+                name: {
+                    "dispatches": st.dispatches,
+                    "warm_dispatches": st.warm_dispatches,
+                    "recompiles_after_warmup": st.compiles_during_warm,
+                    "cache_size": st.cache_size,
+                }
+                for name, st in self.programs.items()
+            },
+        }
+
+    def check(self) -> None:
+        """Raise if any violation was recorded (belt-and-braces for
+        callers that swallow exceptions at dispatch sites)."""
+        if self.violations:
+            raise StrictViolation("; ".join(self.violations))

@@ -102,6 +102,10 @@ def _build_config(args):
                 cfg.compile, cache_dir=args.compile_cache
             )
         )
+    if getattr(args, "strict", False):
+        cfg = cfg.replace(
+            debug=dataclasses.replace(cfg.debug, strict=True)
+        )
     if (args.backbone or args.roi_op or getattr(args, "remat", False)
             or getattr(args, "frozen_bn", False)
             or getattr(args, "norm", None)):
@@ -143,6 +147,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="preset name (see replication_faster_rcnn_tpu.config.CONFIGS)")
     p.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"],
                    help="JAX backend (BASELINE --device flag)")
+    p.add_argument("--strict", action="store_true",
+                   help="runtime jit-hygiene gate (debug.strict): "
+                        "jax.transfer_guard('disallow') for the whole "
+                        "session + a per-program recompile check after "
+                        "warmup — implicit transfers and silent recompiles "
+                        "raise instead of eating throughput")
     p.add_argument("--dataset", default=None, choices=[None, "voc", "coco", "synthetic"])
     p.add_argument("--data-root", default=None)
     p.add_argument("--image-size", type=int, default=None)
@@ -313,7 +323,8 @@ def cmd_train(args) -> int:
         k = trainer.steps_per_dispatch
         log_every = max(1, args.log_every)
         try:
-            with trainer.telemetry_session(), GracefulShutdown() as shutdown:
+            with trainer.telemetry_session(), trainer.strict_session(), \
+                    GracefulShutdown() as shutdown:
                 with trace(args.profile):
                     done = start
                     while done < args.steps:
@@ -385,10 +396,20 @@ def cmd_eval(args) -> int:
     model, variables = load_eval_variables(cfg, args.workdir, args.checkpoint_step)
     dataset = make_dataset(cfg.data, args.split)
     ev = Evaluator(cfg, model)
-    result = ev.evaluate(
-        variables, dataset, batch_size=cfg.train.batch_size,
-        max_images=args.max_images,
-    )
+    if cfg.debug.strict:
+        from replication_faster_rcnn_tpu.analysis.strict import StrictHarness
+
+        ev.strict = StrictHarness(cfg.debug.strict_warmup)
+        with ev.strict.session():
+            result = ev.evaluate(
+                variables, dataset, batch_size=cfg.train.batch_size,
+                max_images=args.max_images,
+            )
+    else:
+        result = ev.evaluate(
+            variables, dataset, batch_size=cfg.train.batch_size,
+            max_images=args.max_images,
+        )
     if cfg.eval.metric == "coco":
         print(
             f"mAP@[.50:.95]: {result['mAP']:.4f} "
@@ -543,6 +564,49 @@ def cmd_trace_summary(args) -> int:
     return 0
 
 
+def cmd_check(args) -> int:
+    """jaxlint over the package (or explicit paths): jit-hygiene rules
+    JX001-JX006 resolved against analysis/baseline.toml. Pure AST work —
+    no jax import, fast enough to gate every PR. Exits nonzero on any
+    unsuppressed finding or stale waiver."""
+    import json
+
+    from replication_faster_rcnn_tpu.analysis.jaxlint import (
+        RULES,
+        lint_package,
+        lint_paths,
+    )
+
+    if args.paths:
+        result = lint_paths(args.paths, baseline=args.baseline)
+    elif args.baseline is not None:
+        result = lint_package(baseline=args.baseline)
+    else:
+        result = lint_package()
+    if args.json:
+        print(json.dumps(result.to_dict(), indent=2))
+    else:
+        for f in result.findings:
+            print(f)
+        for w in result.stale_waivers:
+            print(
+                f"stale waiver: {w.rule} {w.path} [{w.func}] matched "
+                "nothing — the violation is gone, remove it from "
+                "analysis/baseline.toml"
+            )
+        if args.verbose:
+            for f, reason in result.suppressed:
+                print(f"waived: {f}\n    reason: {reason}")
+        print(
+            f"jaxlint: {len(result.findings)} finding(s), "
+            f"{len(result.suppressed)} waived, "
+            f"{len(result.excluded)} excluded, "
+            f"{len(result.stale_waivers)} stale waiver(s) "
+            f"({len(RULES)} rules)"
+        )
+    return 1 if (result.findings or result.stale_waivers) else 0
+
+
 def cmd_telemetry(args) -> int:
     """Phase-time + train-health report from a --telemetry run dir. Pure
     host-side parsing (telemetry/report.py) — no jax import, safe with a
@@ -681,6 +745,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tel.add_argument("--json", default=None, metavar="PATH",
                        help="also write the summary as JSON")
     p_tel.set_defaults(fn=cmd_telemetry)
+
+    p_check = sub.add_parser(
+        "check",
+        help="static jit-hygiene lint (jaxlint rules JX001-JX006) against "
+             "the committed suppression baseline; exits nonzero on any "
+             "unsuppressed finding",
+    )
+    p_check.add_argument("paths", nargs="*",
+                         help="files to lint (default: the whole package)")
+    p_check.add_argument("--baseline", default=None, metavar="TOML",
+                         help="suppression file (default: the committed "
+                              "analysis/baseline.toml; pass /dev/null to "
+                              "see raw findings)")
+    p_check.add_argument("--json", action="store_true",
+                         help="machine-readable findings on stdout")
+    p_check.add_argument("-v", "--verbose", action="store_true",
+                         help="also print waived findings with reasons")
+    p_check.set_defaults(fn=cmd_check)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -454,8 +454,34 @@ class CompileConfig:
     def __post_init__(self):
         if not isinstance(self.cache_dir, str):
             raise ValueError(
-                f"compile.cache_dir must be a string path, got "
+                "compile.cache_dir must be a string path, got "
                 f"{self.cache_dir!r}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class DebugConfig:
+    """Runtime hygiene checks (analysis/strict.py).
+
+    ``strict`` engages jax.transfer_guard("disallow") for the whole
+    training session plus a per-program recompile gate around every
+    dispatch: after each program's first (warmup) dispatch, any implicit
+    host<->device transfer or recompilation raises instead of silently
+    eating throughput. Costs nothing per step beyond a counter compare;
+    intended for CI and bringup, safe to leave on for real runs.
+
+    ``strict_warmup`` is the number of dispatches per program allowed to
+    compile (and stage constants) before the gate arms; ≥ 1.
+    """
+
+    strict: bool = False
+    strict_warmup: int = 1
+
+    def __post_init__(self):
+        if not isinstance(self.strict_warmup, int) or self.strict_warmup < 1:
+            raise ValueError(
+                "debug.strict_warmup must be an int >= 1, got "
+                f"{self.strict_warmup!r}"
             )
 
 
@@ -471,6 +497,7 @@ class FasterRCNNConfig:
     eval: EvalConfig = dataclasses.field(default_factory=EvalConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     compile: CompileConfig = dataclasses.field(default_factory=CompileConfig)
+    debug: DebugConfig = dataclasses.field(default_factory=DebugConfig)
 
     def feature_size(self, image_size: Optional[Tuple[int, int]] = None) -> Tuple[int, int]:
         """Spatial size of the stride-16 feature map for a given image size.

@@ -158,7 +158,7 @@ class CachedSampler:
             lo, hi = float(scale_range[0]), float(scale_range[1])
             if not 0.1 <= lo <= hi <= 4.0:
                 raise ValueError(
-                    f"scale_range must satisfy 0.1 <= lo <= hi <= 4, "
+                    "scale_range must satisfy 0.1 <= lo <= hi <= 4, "
                     f"got {scale_range!r}"
                 )
             scale_range = (lo, hi)

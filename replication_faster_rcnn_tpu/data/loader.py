@@ -355,12 +355,12 @@ class DataLoader:
                         # deadline turns that silent hang into an error
                         if time.monotonic() - last_progress > self.stall_timeout:
                             raise RuntimeError(
-                                f"loader made no progress for "
+                                "loader made no progress for "
                                 f"{self.stall_timeout:.0f}s waiting on batch "
                                 f"{next_yield} with all {len(procs)} workers "
-                                f"alive — likely a fork-inherited lock "
-                                f"deadlock; use worker_mode='thread' or "
-                                f"raise stall_timeout"
+                                "alive — likely a fork-inherited lock "
+                                "deadlock; use worker_mode='thread' or "
+                                "raise stall_timeout"
                             )
                         continue
                     buf[seq] = payload

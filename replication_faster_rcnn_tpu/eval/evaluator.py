@@ -10,6 +10,7 @@ batch_size that fits the devices), the same SPMD layout as training.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, Dict, List, Optional
 
@@ -72,6 +73,14 @@ class Evaluator:
         self._jit_infer_cached = jax.jit(infer_cached)
         self._device_cache_base = None
         self._device_cache = None
+        # optional strict-mode gate (analysis/strict.py): when set, every
+        # infer dispatch runs under its per-program warmup/recompile check
+        self.strict = None
+
+    def _strict_dispatch(self, program: str, fn):
+        if self.strict is None:
+            return contextlib.nullcontext()
+        return self.strict.dispatch(program, fn)
 
     def _eval_sharding(self, batch_size: int):
         """(image sharding, replicated sharding) for a data-parallel eval
@@ -100,7 +109,13 @@ class Evaluator:
     ) -> Dict[str, np.ndarray]:
         if sharding is not None:
             images = jax.device_put(np.asarray(images), sharding)
-        return jax.device_get(self._jit_infer(variables, images))
+        elif not isinstance(images, jax.Array):
+            # explicit staging: a host array passed straight to dispatch
+            # would transfer implicitly (a strict-mode violation)
+            images = jax.device_put(np.asarray(images))
+        with self._strict_dispatch("eval_infer", self._jit_infer):
+            out = self._jit_infer(variables, images)
+        return jax.device_get(out)
 
     def _score(
         self,
@@ -153,11 +168,15 @@ class Evaluator:
                     [idxs, np.full(batch_size - k, idxs[-1], np.int32)]
                 )
             with tracer.span("eval/infer", cat="eval", feed="device_cache"):
-                out = jax.device_get(
-                    self._jit_infer_cached(
-                        variables, images, jnp.asarray(idxs)
+                # device_put, not jnp.asarray: the index upload must be an
+                # explicit transfer or strict mode's guard rejects it
+                with self._strict_dispatch(
+                    "eval_infer_cached", self._jit_infer_cached
+                ):
+                    raw = self._jit_infer_cached(
+                        variables, images, jax.device_put(idxs)
                     )
-                )
+                out = jax.device_get(raw)
             for i in range(k):
                 j = start + i
                 valid = out["valid"][i]

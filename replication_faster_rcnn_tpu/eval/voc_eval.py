@@ -13,7 +13,7 @@ off-device (tiny, branchy, once per epoch).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 

@@ -210,8 +210,6 @@ def create(config: FasterRCNNConfig) -> FasterRCNN:
 
 def init_variables(config: FasterRCNNConfig, rng: Any, batch_size: int = 1):
     """Initialize parameters/batch stats with a dummy batch."""
-    import jax
-
     model = FasterRCNN(config)
     h, w = config.data.image_size
     dummy = jnp.zeros((batch_size, h, w, 3), jnp.float32)

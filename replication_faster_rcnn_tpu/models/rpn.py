@@ -26,7 +26,6 @@ import jax.numpy as jnp
 
 from replication_faster_rcnn_tpu.config import ProposalConfig
 from replication_faster_rcnn_tpu.ops import boxes as box_ops
-from replication_faster_rcnn_tpu.ops import nms as nms_ops
 
 Array = jnp.ndarray
 

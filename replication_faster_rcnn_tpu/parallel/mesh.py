@@ -98,7 +98,7 @@ def validate_spatial(config) -> None:
         )
     if config.data.image_size[0] % config.mesh.num_model:
         raise ValueError(
-            f"spatial partitioning needs image rows "
+            "spatial partitioning needs image rows "
             f"({config.data.image_size[0]}) divisible by the model "
             f"axis ({config.mesh.num_model})"
         )

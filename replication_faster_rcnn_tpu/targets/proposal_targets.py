@@ -124,5 +124,5 @@ def batched_proposal_targets(
     else:
         keys = jax.vmap(lambda p: jax.random.fold_in(rng, p))(positions)
     return jax.vmap(
-        lambda k, r, v, b, l, m: proposal_targets(k, r, v, b, l, m, cfg)
+        lambda k, r, v, b, lbl, m: proposal_targets(k, r, v, b, lbl, m, cfg)
     )(keys, rois, roi_valid, gt_boxes, gt_labels, gt_mask)

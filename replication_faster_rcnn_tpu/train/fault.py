@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from replication_faster_rcnn_tpu.telemetry import spans as tspans
 from replication_faster_rcnn_tpu.telemetry.health import health_metrics
 
 # Distinct exit code for "preempted with a verified emergency checkpoint;
@@ -71,7 +72,7 @@ class Preempted(RuntimeError):
     def __init__(self, step: int, reason: str = "signal"):
         super().__init__(
             f"training preempted ({reason}) at step {step}; emergency "
-            f"checkpoint saved — restart with --resume"
+            "checkpoint saved — restart with --resume"
         )
         self.step = int(step)
         self.reason = reason
@@ -217,7 +218,9 @@ class SkipMonitor:
         :class:`NonFiniteEscalation` past the budget."""
         pending, self._pending = self._pending, []
         for first, flags in pending:
-            arr = np.atleast_1d(np.asarray(jax.device_get(flags), np.float64))
+            with tspans.current_tracer().span("fault/skip_drain", cat="sync"):
+                flags = jax.device_get(flags)
+            arr = np.atleast_1d(np.asarray(flags, np.float64))
             for off, flag in enumerate(arr):
                 if flag > 0:
                     self.consecutive += 1
@@ -235,7 +238,7 @@ class SkipMonitor:
                     if self.consecutive >= self.max_consecutive:
                         self._escalate(
                             f"{self.consecutive} consecutive nonfinite-"
-                            f"gradient skips (>= train.max_consecutive_skips="
+                            "gradient skips (>= train.max_consecutive_skips="
                             f"{self.max_consecutive}, last at step "
                             f"{first + off}, {self.total_skipped} skipped "
                             "total): gradients are persistently non-finite, "
@@ -339,8 +342,10 @@ def config_hash(config) -> str:
 def _leaf_records(tree: Any) -> Dict[str, Dict[str, Any]]:
     leaves: Dict[str, Dict[str, Any]] = {}
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
-    for path, leaf in flat:
-        arr = np.asarray(jax.device_get(leaf))
+    with tspans.current_tracer().span("checkpoint/manifest", cat="checkpoint"):
+        host_leaves = [jax.device_get(leaf) for _path, leaf in flat]
+    for (path, _leaf), fetched in zip(flat, host_leaves):
+        arr = np.asarray(fetched)
         leaves[jax.tree_util.keystr(path)] = {
             "crc32": zlib.crc32(np.ascontiguousarray(arr).tobytes()),
             "shape": list(arr.shape),

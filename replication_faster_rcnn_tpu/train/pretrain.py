@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import optax
 
 from replication_faster_rcnn_tpu.models.resnet import ResNetClassifier
+from replication_faster_rcnn_tpu.telemetry import spans as tspans
 
 Array = jnp.ndarray
 
@@ -80,7 +81,6 @@ def pretrain(
     variables. Small-scale utility (the reference's CIFAR script analog) —
     full-dataset pretraining would go through Trainer-style sharding."""
     rng = jax.random.PRNGKey(0) if rng is None else rng
-    first = None
     it = iter(batches)
     first_batch = next(it)
     images0 = jnp.asarray(first_batch[0])
@@ -98,8 +98,9 @@ def pretrain(
         variables, opt_state, metrics = step(
             variables, opt_state, jnp.asarray(images), jnp.asarray(labels)
         )
-    del first
-    return {"variables": variables, "metrics": jax.device_get(metrics)}
+    with tspans.current_tracer().span("step/sync", cat="sync"):
+        host_metrics = jax.device_get(metrics)
+    return {"variables": variables, "metrics": host_metrics}
 
 
 def graft_classifier(detector_variables: Dict[str, Any], classifier_variables: Dict[str, Any]):

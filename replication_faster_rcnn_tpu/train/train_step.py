@@ -18,8 +18,7 @@ unweighted, `train.py:123`).
 
 from __future__ import annotations
 
-import dataclasses
-from functools import partial
+import math
 from typing import Any, Dict, Tuple
 
 import jax
@@ -372,3 +371,21 @@ def make_optimizer(config: FasterRCNNConfig, steps_per_epoch: int):
         optax.scale_by_learning_rate(schedule),
     )
     return tx, schedule
+
+
+def host_schedule(config: FasterRCNNConfig, steps_per_epoch: int):
+    """Host-math twin of ``make_optimizer``'s cosine schedule.
+
+    The jnp schedule inside the optimizer is correct under jit, but
+    evaluating it on the host (the per-step log path) builds a device
+    scalar and ``float()`` then forces an implicit device sync — a
+    jaxlint JX001 hit and a transfer-guard violation under strict mode.
+    Same formula in pure Python for host callers; keep the two in sync.
+    """
+    tc = config.train
+
+    def schedule(step: int) -> float:
+        epoch = min(int(step) // max(steps_per_epoch, 1), tc.n_epoch)
+        return float(tc.lr * 0.5 * (1.0 + math.cos(math.pi * epoch / tc.n_epoch)))
+
+    return schedule

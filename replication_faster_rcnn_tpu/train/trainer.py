@@ -19,7 +19,7 @@ import dataclasses
 import os
 import sys
 import time
-from typing import Dict, Iterable, Optional
+from typing import Dict, Optional
 
 import jax
 import numpy as np
@@ -27,18 +27,14 @@ import numpy as np
 from replication_faster_rcnn_tpu.config import FasterRCNNConfig
 from replication_faster_rcnn_tpu.data import DataLoader, make_dataset
 from replication_faster_rcnn_tpu.data.prefetch_device import (
-    HOST,
     STAGED,
     DevicePrefetcher,
 )
 from replication_faster_rcnn_tpu.parallel import (
-    batch_sharding,
     fit_data_parallelism,
     make_mesh,
     gather_replicated,
     replicate_tree,
-    shard_batch,
-    shard_stacked_batch,
     stage_to_devices,
     validate_parallel,
 )
@@ -51,6 +47,7 @@ from replication_faster_rcnn_tpu.train.train_step import (
     TrainState,
     build_multi_step,
     create_train_state,
+    host_schedule,
     make_cached_multi_step,
     make_optimizer,
     make_train_step,
@@ -82,9 +79,12 @@ def load_eval_variables(
             if mgr.all_steps():
                 # manifest-verified restore with latest-good fallback: a
                 # torn newest step must not make eval unrecoverable either
+                with tspans.current_tracer().span(
+                    "checkpoint/restore", cat="checkpoint"
+                ):
+                    template = jax.device_get(state)
                 result = fault.verified_restore(
-                    mgr, jax.device_get(state), os.path.abspath(workdir),
-                    step=step,
+                    mgr, template, os.path.abspath(workdir), step=step,
                 )
                 if result.state is not None:
                     state = result.state
@@ -230,6 +230,9 @@ class Trainer:
             )
             steps_per_epoch = max(len(self.loader), 1)
         self.tx, self.schedule = make_optimizer(config, steps_per_epoch)
+        # host-math twin for log rows: evaluating the jnp schedule on the
+        # host would build + sync a device scalar every logged step
+        self.host_schedule = host_schedule(config, steps_per_epoch)
         self.model, state = create_train_state(
             config, jax.random.PRNGKey(config.train.seed), self.tx
         )
@@ -305,6 +308,15 @@ class Trainer:
                     donate_argnums=(0,),
                     out_shardings=(self._state_shardings, None),
                 )
+        # runtime hygiene gate (debug.strict / --strict): transfer guard +
+        # recompile detector around every dispatch, armed after warmup
+        self.strict = None
+        if config.debug.strict:
+            from replication_faster_rcnn_tpu.analysis.strict import StrictHarness
+
+            self.strict = StrictHarness(
+                warmup_dispatches=config.debug.strict_warmup
+            )
         self._ckpt_mgr = None
         # background scheduled-checkpoint writer (train.async_checkpoint):
         # single-process only — multi-process orbax saves need the live
@@ -353,7 +365,8 @@ class Trainer:
 
     def _host_state(self):
         """Full state on host (numpy)."""
-        return jax.device_get(self._replicated_state())
+        with self.tracer.span("state/host_fetch", cat="sync"):
+            return jax.device_get(self._replicated_state())
 
     def _fault_incident(self, kind: str, **fields) -> None:
         """Route a fault event to the JSONL metric stream AND the watchdog
@@ -494,9 +507,10 @@ class Trainer:
             )
             self.checkpoint_manager.wait_until_finished()
             if jax.process_index() == 0:
+                with self.tracer.span("checkpoint/manifest", cat="checkpoint"):
+                    host_state = jax.device_get(rep_state)
                 fault.write_manifest(
-                    self.workdir, step, jax.device_get(rep_state),
-                    self.config, kind=kind,
+                    self.workdir, step, host_state, self.config, kind=kind,
                 )
                 fault.prune_manifests(
                     self.workdir, self.checkpoint_manager.all_steps()
@@ -573,10 +587,11 @@ class Trainer:
         """Graft a torch resnet checkpoint into trunk + head tail."""
         from replication_faster_rcnn_tpu.models import convert
 
-        variables = {
-            "params": jax.device_get(self.state.params),
-            "batch_stats": jax.device_get(self.state.batch_stats),
-        }
+        with self.tracer.span("checkpoint/graft", cat="checkpoint"):
+            variables = {
+                "params": jax.device_get(self.state.params),
+                "batch_stats": jax.device_get(self.state.batch_stats),
+            }
         grafted = convert.graft_into_variables(variables, pth_path)
         self.state = self.state.replace(
             params=replicate_tree(grafted["params"], self.mesh),
@@ -635,13 +650,14 @@ class Trainer:
             # in --cache-device mode `batch` is a selection dict (idx/flip/
             # jitter — bytes, not megabytes); the images never leave device
             staged = self._stage_batch(batch)
+        strict = self._strict_dispatch("train_step", self.jitted_step)
         if self.device_cache is not None:
-            with tracer.span("step/dispatch", cat="step"):
+            with tracer.span("step/dispatch", cat="step"), strict:
                 self.state, metrics = self.jitted_step(
                     self.state, self.device_cache.arrays, staged
                 )
         else:
-            with tracer.span("step/dispatch", cat="step"):
+            with tracer.span("step/dispatch", cat="step"), strict:
                 self.state, metrics = self.jitted_step(self.state, staged)
         self._host_step += 1
         # hand the monitor this step's `skipped` flag as a DEVICE scalar —
@@ -673,13 +689,16 @@ class Trainer:
                 )
             staged = self._stage_chunk(batches)
         tracer = self.tracer
+        strict = self._strict_dispatch(
+            f"multi_step_k{k}", self.jitted_multi_step
+        )
         if self.device_cache is not None:
-            with tracer.span("step/dispatch", cat="step", steps=k):
+            with tracer.span("step/dispatch", cat="step", steps=k), strict:
                 self.state, metrics = self.jitted_multi_step(
                     self.state, self.device_cache.arrays, staged
                 )
         else:
-            with tracer.span("step/dispatch", cat="step", steps=k):
+            with tracer.span("step/dispatch", cat="step", steps=k), strict:
                 self.state, metrics = self.jitted_multi_step(
                     self.state, staged
                 )
@@ -687,6 +706,21 @@ class Trainer:
         self._host_step += k
         self.skip_monitor.observe(first, metrics)  # stacked [K] device flags
         return metrics
+
+    def _strict_dispatch(self, program: str, fn):
+        """Strict-mode gate for one dispatch of ``program`` (no-op context
+        when strict mode is off)."""
+        if self.strict is None:
+            return contextlib.nullcontext()
+        return self.strict.dispatch(program, fn)
+
+    def strict_session(self):
+        """Transfer-guard session for the whole loop (no-op when off).
+        Callers driving :meth:`train_one_batch` directly (the CLI bounded
+        --steps loop) wrap their loop in this."""
+        if self.strict is None:
+            return contextlib.nullcontext()
+        return self.strict.session()
 
     def flush_telemetry(self) -> None:
         """Write the trace file and stop the watchdog. For callers driving
@@ -746,6 +780,10 @@ class Trainer:
 
             self._val_dataset = make_dataset(self.config.data, "val")
             self._evaluator = Evaluator(self.config, self.model)
+            # under strict mode the epoch-end eval runs inside the train
+            # session's transfer guard: the evaluator needs the harness so
+            # its first infer dispatch gets a warmup allowance
+            self._evaluator.strict = self.strict
         variables = {
             "params": self.state.params,
             "batch_stats": self.state.batch_stats,
@@ -771,7 +809,7 @@ class Trainer:
         with self.tracer.span("step/sync", cat="sync"):
             host_metrics = jax.device_get(metrics)
         row = fault.check_step_metrics(host_metrics, step)
-        row["lr"] = float(self.schedule(step))
+        row["lr"] = self.host_schedule(step)
         self.logger.log(step, row)
         self.skip_monitor.drain()
         return row
@@ -789,7 +827,7 @@ class Trainer:
             host_metrics = jax.device_get(metrics)
         row = {key: v[boundary - first] for key, v in host_metrics.items()}
         row = fault.check_step_metrics(row, boundary)
-        row["lr"] = float(self.schedule(boundary))
+        row["lr"] = self.host_schedule(boundary)
         self.logger.log(boundary, row)
         self.skip_monitor.drain()
         return row
@@ -821,7 +859,7 @@ class Trainer:
         tracer = self.tracer
         self._shutdown = fault.GracefulShutdown()
         try:
-            with self.telemetry_session(), self._shutdown:
+            with self.telemetry_session(), self.strict_session(), self._shutdown:
                 k = self.steps_per_dispatch
                 prefetch = self.config.data.prefetch_device
                 for epoch in range(start_epoch, cfg.n_epoch):

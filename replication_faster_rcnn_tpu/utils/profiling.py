@@ -18,7 +18,6 @@ import time
 from typing import Any, Callable, Dict, Iterator, Optional
 
 import jax
-import jax.numpy as jnp
 
 
 def sync(tree: Any) -> None:

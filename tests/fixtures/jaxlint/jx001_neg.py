@@ -1,0 +1,15 @@
+"""JX001 negative: shape math and host-value conversion are not syncs."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(state, batch):
+    # .shape is static under tracing; float() of it never touches device
+    scale = float(batch.shape[0])
+    return state * jnp.sum(batch) / scale
+
+
+def host_side(n: int) -> float:
+    return float(n * 2)  # plain host math, no jnp value involved

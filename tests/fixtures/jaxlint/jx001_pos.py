@@ -1,0 +1,10 @@
+"""JX001 positive: float() on a jnp value inside a jit-reachable function."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(state, batch):
+    total = jnp.sum(batch)
+    return state * float(total)  # JX001: forces a device sync per call

@@ -1,0 +1,12 @@
+"""JX002 negative: jnp.where on tracers, Python `if` only on static args."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(state, batch, scale_loss: bool = False):
+    loss = jnp.sum(batch)
+    if scale_loss:  # static by annotation: baked at trace time, fine
+        loss = loss / batch.shape[0]
+    return jnp.where(loss > 0, state - loss, state)

@@ -1,0 +1,12 @@
+"""JX002 positive: Python `if` on a tracer value in jit-reachable code."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(state, batch):
+    loss = jnp.sum(batch)
+    if loss > 0:  # JX002: trace-time crash / silent constant fold
+        return state - loss
+    return state

@@ -1,0 +1,20 @@
+"""JX003 negative: the donated argument is rebound by the dispatch itself."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step(state, batch):
+    new_state = state + jnp.sum(batch)
+    return new_state, jnp.mean(batch)
+
+
+class Runner:
+    def __init__(self):
+        self.step = jax.jit(_step, donate_argnums=(0,))
+
+    def run(self, state, batch):
+        # the trainer idiom: the donated arg is an assignment target of the
+        # same statement, so the stale buffer is never read again
+        state, metric = self.step(state, batch)
+        return state, metric

@@ -1,0 +1,19 @@
+"""JX003 positive: donated buffer read again after the donating dispatch."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step(state, batch):
+    new_state = state + jnp.sum(batch)
+    return new_state, jnp.mean(batch)
+
+
+class Runner:
+    def __init__(self):
+        self.step = jax.jit(_step, donate_argnums=(0,))
+
+    def run(self, state, batch):
+        new_state, metric = self.step(state, batch)
+        drift = new_state - state  # JX003: `state` buffer was donated
+        return new_state, metric, drift

@@ -1,0 +1,14 @@
+"""JX004 positive: a mutable (unhashable) value for a static jit arg."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("sizes",))
+def crop(x, sizes):
+    return x[: sizes[0]]
+
+
+def run(x):
+    return crop(x, sizes=[2, 3])  # JX004: list is unhashable -> dispatch error

@@ -1,0 +1,13 @@
+"""JX005 negative: split (and fold_in) before every consumption."""
+
+import jax
+
+
+def sample():
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.uniform(k1, (4,))
+    b = jax.random.normal(k2, (4,))
+    step_key = jax.random.fold_in(key, 7)  # fold_in derives, doesn't consume
+    c = jax.random.bernoulli(step_key, 0.5, (4,))
+    return a + b + c

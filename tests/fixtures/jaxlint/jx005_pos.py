@@ -1,0 +1,10 @@
+"""JX005 positive: one PRNG key consumed by two sampling calls."""
+
+import jax
+
+
+def sample():
+    key = jax.random.PRNGKey(0)
+    a = jax.random.uniform(key, (4,))
+    b = jax.random.normal(key, (4,))  # JX005: identical randomness with `a`
+    return a + b

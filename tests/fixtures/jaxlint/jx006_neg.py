@@ -1,0 +1,9 @@
+"""JX006 negative: syncs attributed to a telemetry span."""
+
+import jax
+
+
+def pull_metrics(tracer, metrics):
+    with tracer.span("step/sync", cat="sync"):
+        host = jax.device_get(metrics)
+    return host

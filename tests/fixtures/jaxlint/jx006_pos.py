@@ -1,0 +1,7 @@
+"""JX006 positive: device sync outside any telemetry span."""
+
+import jax
+
+
+def pull_metrics(metrics):
+    return jax.device_get(metrics)  # JX006: unattributed sync time

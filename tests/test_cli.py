@@ -372,4 +372,4 @@ class TestTrainSmoke:
             for tok in line.split()
             if tok.startswith("loss=")
         ]
-        assert losses and all(np.isfinite(l) for l in losses)
+        assert losses and all(np.isfinite(v) for v in losses)

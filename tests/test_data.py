@@ -705,8 +705,6 @@ class TestDeviceScaleJitter:
             make_optimizer,
             make_train_step,
         )
-        import dataclasses
-
         from replication_faster_rcnn_tpu.config import (
             DataConfig,
             FasterRCNNConfig,

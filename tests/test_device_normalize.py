@@ -2,8 +2,6 @@
 host ships raw bytes, the model's preprocess applies /255 + mean/std
 on-device. Tests pin the u8 and f32 paths to each other."""
 
-import io
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -357,7 +357,7 @@ class TestWatchdogIncident:
         wd.beat(step=3, phase="train")
         snap = wd.incident("preempted", step=3, reason="SIGTERM")
         assert snap["kind"] == "preempted" and snap["reason"] == "SIGTERM"
-        rows = [json.loads(l) for l in open(path)]
+        rows = [json.loads(line) for line in open(path)]
         assert rows[-1]["kind"] == "preempted"
         assert rows[-1]["last_step"] == 3
 

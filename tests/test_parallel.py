@@ -2,7 +2,6 @@
 single-chip vs 8-chip data-parallel equivalence check (SURVEY.md §4e)."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

@@ -1,0 +1,178 @@
+"""Runtime strictness harness (ISSUE 5 tentpole, runtime half).
+
+Unit tests prove the two detectors in isolation — the transfer guard
+rejects implicit host-to-device transfers inside a strict session, and
+the per-program dispatch monitor raises on any post-warmup recompile.
+The e2e tests then run real training under ``debug.strict=True`` on
+both acceptance feeds (per-batch loader and fused steps_per_dispatch=2)
+and assert the final report shows zero implicit transfers (no
+StrictViolation / no guard raise) and zero recompiles after warmup over
+>= 4 trainer steps each.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from replication_faster_rcnn_tpu.analysis.strict import (
+    StrictHarness,
+    StrictViolation,
+)
+from replication_faster_rcnn_tpu.config import (
+    DataConfig,
+    DebugConfig,
+    FasterRCNNConfig,
+    MeshConfig,
+    ModelConfig,
+    ProposalConfig,
+    ROITargetConfig,
+    TrainConfig,
+)
+
+
+class TestStrictHarnessUnits:
+    def test_session_blocks_implicit_h2d(self):
+        h = StrictHarness()
+        with h.session():
+            with pytest.raises(Exception, match="[Dd]isallow"):
+                _ = jnp.asarray(np.arange(4)) + 1
+
+    def test_session_allows_explicit_device_put(self):
+        h = StrictHarness()
+        with h.session():
+            x = jax.device_put(np.arange(4))
+            assert int(jax.device_get(x).sum()) == 6
+
+    def test_guard_restored_after_session(self):
+        h = StrictHarness()
+        with h.session():
+            pass
+        # implicit transfers legal again outside the session
+        assert float((jnp.asarray(np.ones(2)) + 1).sum()) == 4.0
+
+    def test_recompile_after_warmup_raises(self):
+        f = jax.jit(lambda x: x * 2)
+        x4, x8 = jnp.zeros(4), jnp.zeros(8)  # built before the guard
+        h = StrictHarness(warmup_dispatches=1)
+        with h.session():
+            with h.dispatch("p", f):
+                f(x4)  # warmup: compile allowed
+            with h.dispatch("p", f):
+                f(x4)  # warm, same shape: fine
+            with pytest.raises(StrictViolation, match="recompiled"):
+                with h.dispatch("p", f):
+                    f(x8)  # new shape => cache grows => violation
+        assert h.report()["programs"]["p"]["recompiles_after_warmup"] == 1
+        assert len(h.violations) == 1
+
+    def test_warm_dispatches_counted_per_program(self):
+        f = jax.jit(lambda x: x + 1)
+        g = jax.jit(lambda x: x - 1)
+        x = jnp.zeros(3)
+        h = StrictHarness(warmup_dispatches=1)
+        with h.session():
+            for fn, name in ((f, "f"), (g, "g")):
+                for _ in range(3):
+                    with h.dispatch(name, fn):
+                        fn(x)
+        rep = h.report()["programs"]
+        for name in ("f", "g"):
+            assert rep[name]["dispatches"] == 3
+            assert rep[name]["warm_dispatches"] == 2
+            assert rep[name]["recompiles_after_warmup"] == 0
+        h.check()  # raises StrictViolation if anything was recorded
+
+    def test_extended_warmup_tolerates_retrace(self):
+        f = jax.jit(lambda x: x * 3)
+        x4, x8, x2 = jnp.zeros(4), jnp.zeros(8), jnp.zeros(2)
+        h = StrictHarness(warmup_dispatches=2)
+        with h.session():
+            with h.dispatch("p", f):
+                f(x4)
+            with h.dispatch("p", f):
+                f(x8)  # second warmup dispatch: recompile allowed
+            with pytest.raises(StrictViolation):
+                with h.dispatch("p", f):
+                    f(x2)
+
+    def test_debug_config_validation(self):
+        assert DebugConfig().strict is False
+        assert DebugConfig(strict=True, strict_warmup=3).strict_warmup == 3
+        with pytest.raises(ValueError, match="strict_warmup"):
+            DebugConfig(strict_warmup=0)
+
+
+def _cfg(**train_kw):
+    return FasterRCNNConfig(
+        model=ModelConfig(
+            backbone="resnet18", roi_op="align", compute_dtype="float32"
+        ),
+        data=DataConfig(dataset="synthetic", image_size=(64, 64), max_boxes=8),
+        train=TrainConfig(batch_size=2, n_epoch=1, **train_kw),
+        mesh=MeshConfig(num_data=-1),
+        proposals=ProposalConfig(pre_nms_train=64, post_nms_train=16),
+        roi_targets=ROITargetConfig(n_sample=8),
+        debug=DebugConfig(strict=True),
+    )
+
+
+def _assert_strict_clean(trainer, program, min_warm):
+    assert trainer.strict is not None
+    rep = trainer.strict.report()
+    assert rep["violations"] == []
+    prog = rep["programs"][program]
+    assert prog["warm_dispatches"] >= min_warm
+    assert prog["recompiles_after_warmup"] == 0
+    trainer.strict.check()  # raises StrictViolation if anything slipped
+
+
+class TestStrictTrainingE2E:
+    """Real trainer.train() under --strict semantics: every post-warmup
+    step dispatches with zero implicit transfers (the disallow guard
+    would raise) and zero recompiles (the harness would raise)."""
+
+    def test_loader_feed_strict_clean(self, tmp_path):
+        from replication_faster_rcnn_tpu.data import SyntheticDataset
+        from replication_faster_rcnn_tpu.train import Trainer
+
+        cfg = _cfg()
+        ds = SyntheticDataset(cfg.data, length=10)  # 5 steps, 4 post-warmup
+        tr = Trainer(cfg, workdir=str(tmp_path / "w"), dataset=ds)
+        tr.train(log_every=3)  # crosses a log boundary while guarded
+        _assert_strict_clean(tr, "train_step", min_warm=4)
+
+    @pytest.mark.slow  # fused-program compile alone is ~30s on CPU
+    def test_fused_feed_strict_clean(self, tmp_path, monkeypatch):
+        from replication_faster_rcnn_tpu.data import SyntheticDataset
+        from replication_faster_rcnn_tpu.train import Trainer
+        from replication_faster_rcnn_tpu.train import train_step as ts
+
+        # loop-form scan compiles ~2x faster on CPU; the dispatch/guard
+        # behavior under test is identical to the unrolled TPU default
+        monkeypatch.setattr(ts, "fused_scan_unroll", lambda k: 1)
+        cfg = _cfg(steps_per_dispatch=2)
+        ds = SyntheticDataset(cfg.data, length=12)  # 3 chunks = 6 steps
+        tr = Trainer(cfg, workdir=str(tmp_path / "w"), dataset=ds)
+        tr.train(log_every=2)
+        _assert_strict_clean(tr, "multi_step_k2", min_warm=2)
+        rep = tr.strict.report()["programs"]["multi_step_k2"]
+        # >= 4 trainer steps executed beyond the warmup chunk
+        assert rep["warm_dispatches"] * 2 >= 4
+
+    def test_cli_strict_flag_plumbs_to_config(self):
+        from replication_faster_rcnn_tpu import cli
+
+        cfg = cli._build_config(_parse(["--strict"]))
+        assert cfg.debug.strict is True
+        assert cli._build_config(_parse([])).debug.strict is False
+
+
+def _parse(argv):
+    import argparse
+
+    from replication_faster_rcnn_tpu import cli
+
+    parser = argparse.ArgumentParser()
+    cli._add_common(parser)
+    return parser.parse_args(argv)

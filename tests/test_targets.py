@@ -13,7 +13,6 @@ import pytest
 
 from replication_faster_rcnn_tpu.config import ROITargetConfig, RPNTargetConfig
 from replication_faster_rcnn_tpu.ops import anchors as anchor_ops
-from replication_faster_rcnn_tpu.ops import boxes as box_ops
 from replication_faster_rcnn_tpu.targets import (
     anchor_targets,
     batched_anchor_targets,
@@ -285,8 +284,8 @@ class TestProposalTargets:
         gt, gt_pad, gt_mask, gt_labels, rois, roi_valid = self._setup()
         B = 3
         f = jax.jit(
-            lambda k, r, v, b, l, m: batched_proposal_targets(
-                k, r, v, b, l, m, self.cfg
+            lambda k, r, v, b, lbl, m: batched_proposal_targets(
+                k, r, v, b, lbl, m, self.cfg
             )
         )
         s_rois, reg, labels = f(

@@ -154,7 +154,7 @@ class TestWatchdog:
         finally:
             wd.stop()
 
-        events = [json.loads(l) for l in open(snap_path)]
+        events = [json.loads(line) for line in open(snap_path)]
         kinds = [e["kind"] for e in events]
         assert kinds == ["stall", "recovered", "stall"]
         stall = events[0]
@@ -362,7 +362,7 @@ class TestMetricLoggerTelemetry:
         lg = MetricLogger(stream=io.StringIO(), jsonl_path=path)
         lg.log(5, {"loss": 1.0, "grad_norm": np.float32(2.0)})
         lg.event("stall", elapsed_s=3.5, last_phase="train")
-        rows = [json.loads(l) for l in open(path)]
+        rows = [json.loads(line) for line in open(path)]
         assert rows[0]["step"] == 5 and rows[0]["grad_norm"] == 2.0
         assert rows[1]["event"] == "stall" and "step" not in rows[1]
 
@@ -415,7 +415,7 @@ class TestTrainerTelemetryIntegration:
         names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
         assert {"data/fetch", "step/dispatch", "step/sync"} <= names
 
-        rows = [json.loads(l) for l in open(os.path.join(tdir, "metrics.jsonl"))]
+        rows = [json.loads(line) for line in open(os.path.join(tdir, "metrics.jsonl"))]
         step_rows = [r for r in rows if "step" in r]
         assert step_rows, "no step metrics logged"
         for key in ("grad_norm", "rpn_cls_loss", "rpn_reg_loss",

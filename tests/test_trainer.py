@@ -158,9 +158,6 @@ def test_crash_resume_is_exact(tmp_path):
 def test_pretrained_graft_changes_trunk(tmp_path):
     torch = pytest.importorskip("torch")
     # fabricate a torch resnet18-style state_dict from the flax shapes
-    from replication_faster_rcnn_tpu.models.resnet import ResNetTrunk, ResNetTail
-    import jax.numpy as jnp
-
     cfg = _cfg()
     ds = SyntheticDataset(cfg.data, length=8)
     tr = Trainer(cfg, workdir=str(tmp_path), dataset=ds)
@@ -272,5 +269,5 @@ def test_zero1_checkpoint_roundtrip_single_process(tmp_path):
         if hasattr(x, "sharding") and x.ndim >= 1 and x.shape[0] % 8 == 0
     ]
     assert any(
-        l.sharding.spec != P() and l.sharding.spec is not None for l in leaves
+        lf.sharding.spec != P() and lf.sharding.spec is not None for lf in leaves
     )

@@ -64,8 +64,6 @@ class TestFacade:
             FRCNN("predict")
 
     def test_get_network_and_loader(self):
-        import dataclasses
-
         from replication_faster_rcnn_tpu.config import DataConfig, ModelConfig, get_config
         from replication_faster_rcnn_tpu.frcnn import FRCNN
 
