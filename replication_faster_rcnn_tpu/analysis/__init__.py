@@ -1,15 +1,23 @@
 """Static analysis + runtime strictness for JAX jit hygiene.
 
-Two halves, one contract:
+Three gates, one contract:
 
 * :mod:`analysis.jaxlint` — an AST analyzer with project-specific rules
-  (JX001-JX006) that walks the call graph from the package's jit/shard_map
+  (JX001-JX007) that walks the call graph from the package's jit/shard_map
   entry points and flags host-sync hazards, tracer branching, donated-buffer
-  reuse, bad static args, RNG key reuse, and un-spanned device syncs.
-  Findings resolve against the committed suppression file
-  ``analysis/baseline.toml``; ``frcnn check`` runs it standalone.
+  reuse, bad static args, RNG key reuse, un-spanned device syncs, and
+  implicit-dtype array creation. Findings resolve against the committed
+  suppression file ``analysis/baseline.toml``; ``frcnn check`` runs it
+  standalone.
+* :mod:`analysis.hlolint` + :mod:`analysis.fingerprint` — the HLO program
+  auditor (``frcnn audit``, rules HX001-HX006): AOT-lowers every registered
+  (feed × K) train program + eval and asserts what the COMPILER emitted —
+  donation survives as input/output aliasing (and the device cache never
+  aliases), no silent dtype upcasts, the collective inventory matches the
+  backend, peak memory fits the HBM budget — against committed fingerprints
+  under ``analysis/fingerprints/``.
 * :mod:`analysis.strict` — a runtime harness (``--strict`` /
-  ``debug.strict``) that proves at runtime what jaxlint claims statically:
+  ``debug.strict``) that proves at runtime what the static gates claim:
   post-warmup trainer steps perform zero implicit host<->device transfers
   (``jax.transfer_guard``) and zero recompiles (XLA compile-event counter +
   per-program jit cache size).
@@ -26,3 +34,17 @@ from replication_faster_rcnn_tpu.analysis.strict import (  # noqa: F401
     StrictHarness,
     StrictViolation,
 )
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "RULES",
+    "lint_package",
+    "lint_paths",
+    "StrictHarness",
+    "StrictViolation",
+]
+
+# analysis.hlolint / analysis.fingerprint import jax and the model stack;
+# they are imported lazily by their consumers (`frcnn audit`, tests) so
+# that the AST-only `frcnn check` path keeps its no-jax startup.

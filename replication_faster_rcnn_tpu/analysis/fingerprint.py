@@ -1,0 +1,313 @@
+"""Compiled-program fingerprints: what the compiler actually emitted.
+
+jaxlint (analysis/jaxlint.py) reasons about Python source; strict mode
+(analysis/strict.py) observes the live process. This module captures the
+layer between them — the AOT artifacts: for each registered program
+(train/warmup.py::build_program_specs) it extracts, from the LOWERED
+StableHLO and the COMPILED executable,
+
+* the abstract arg/output shapes, dtypes and shardings,
+* the input/output aliasing map (did ``donate_argnums`` survive?),
+* the collective inventory (which psums, at which element types — read
+  from the lowered IR, because XLA:CPU legalizes bf16 all-reduces to f32
+  in the compiled module and would mask the contract),
+* HloCostAnalysis flops/bytes (via `benchmark.lowered_cost_analysis`,
+  the same pricing the step-profile harness banks), and
+* the executable's memory analysis with a peak-HBM estimate
+  (arguments + outputs − aliased + temporaries).
+
+Fingerprints serialize to committed JSON banks under
+``analysis/fingerprints/`` (`save_bank` / `load_bank`, atomic replace);
+`diff_programs` reports field-level drift between a live fingerprint and
+a banked one. The contract rules over these records live in
+analysis/hlolint.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+import jax
+
+SCHEMA = "hlo_fingerprint/v1"
+
+# kinds of StableHLO collective ops inventoried from the lowered IR
+COLLECTIVE_KINDS = (
+    "all_reduce",
+    "all_gather",
+    "all_to_all",
+    "reduce_scatter",
+    "collective_permute",
+    "collective_broadcast",
+)
+
+# `"stablehlo.all_reduce"(%x) <{...}> ({ region }) : (tensor<10x20xbf16>)
+# -> ...` — the result element type follows the region close; DOTALL
+# because the reduction region spans lines.
+_ALL_REDUCE_RE = re.compile(
+    r'"stablehlo\.all_reduce"\(.*?\}\) : \(tensor<([^>]*)>', re.S
+)
+# compiled-module header: `input_output_alias={ {0}: (0, {}, may-alias),
+# {1,2}: (3, {}, must-alias), ... }`
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([\d,\s]*)\}:\s*\((\d+),\s*\{[^}]*\},\s*(may-alias|must-alias)\)"
+)
+# element types are the last 'x'-separated token of a tensor type
+# (`tensor<4xf64>`) or the whole body for scalars (`tensor<f64>`)
+_F64_RE = re.compile(r"[<x]f64>")
+
+
+def parse_alias_map(compiled_text: str) -> List[Dict[str, Any]]:
+    """The input/output aliasing entries of a compiled module's text:
+    [{"output": "0", "parameter": 0, "kind": "may-alias"}, ...]. Empty
+    when the header is absent (nothing donated, or a backend that prints
+    no alias table — absence is indistinguishable from no aliasing, which
+    is the conservative reading for the donation contract)."""
+    if "input_output_alias" not in compiled_text:
+        return []
+    # the `{out}: (param, {}, kind)` entry shape (with the literal alias
+    # kind) only occurs in the module header's alias table; scanning the
+    # pre-ENTRY header avoids bracket-matching the nested braces
+    header = compiled_text.split("ENTRY", 1)[0]
+    out = []
+    for om, pm, kind in _ALIAS_ENTRY_RE.findall(header):
+        out.append(
+            {
+                "output": om.replace(" ", ""),
+                "parameter": int(pm),
+                "kind": kind,
+            }
+        )
+    return out
+
+
+def parse_collectives(stablehlo_text: str) -> Dict[str, Any]:
+    """Inventory of collective ops in a lowered module's StableHLO text.
+
+    {"all_reduce": {"count": N, "element_types": {"bf16": i, "f32": j}},
+     "<other kind>": {"count": M}, ...} — kinds with zero occurrences are
+    omitted, so an empty dict means a collective-free program."""
+    inv: Dict[str, Any] = {}
+    for kind in COLLECTIVE_KINDS:
+        n = len(re.findall(rf'"?stablehlo\.{kind}"?\(', stablehlo_text))
+        if n:
+            inv[kind] = {"count": n}
+    if "all_reduce" in inv:
+        types: Dict[str, int] = {}
+        for tensor in _ALL_REDUCE_RE.findall(stablehlo_text):
+            elem = tensor.split("x")[-1]
+            types[elem] = types.get(elem, 0) + 1
+        inv["all_reduce"]["element_types"] = dict(sorted(types.items()))
+    return inv
+
+
+def contains_f64(stablehlo_text: str) -> bool:
+    """True when any tensor in the lowered IR has element type f64 — the
+    silent x64-promotion the dtype contract (HX002) forbids."""
+    return _F64_RE.search(stablehlo_text) is not None
+
+
+def memory_stats(compiled) -> Optional[Dict[str, float]]:
+    """The executable's memory analysis as plain floats, plus
+    ``peak_bytes_estimate`` = arguments + outputs − aliased + temporaries
+    (donated buffers are counted once). None when the backend exposes no
+    memory analysis — callers must treat that as "unknown", not "fits"."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    fields = (
+        "argument_size_in_bytes",
+        "output_size_in_bytes",
+        "alias_size_in_bytes",
+        "temp_size_in_bytes",
+        "generated_code_size_in_bytes",
+    )
+    out = {}
+    for f in fields:
+        v = getattr(ma, f, None)
+        if v is None:
+            return None
+        out[f] = float(v)
+    out["peak_bytes_estimate"] = (
+        out["argument_size_in_bytes"]
+        + out["output_size_in_bytes"]
+        - out["alias_size_in_bytes"]
+        + out["temp_size_in_bytes"]
+    )
+    return out
+
+
+def summarize_abstract(tree) -> List[Dict[str, Any]]:
+    """Flattened [{path, shape, dtype, sharding}] for one abstract
+    argument (or output) pytree, in XLA's flat-parameter order."""
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    out = []
+    for path, leaf in leaves:
+        sharding = getattr(leaf, "sharding", None)
+        out.append(
+            {
+                "path": jax.tree_util.keystr(path),
+                "shape": list(getattr(leaf, "shape", ())),
+                "dtype": str(jax.numpy.dtype(leaf.dtype)),
+                "sharding": repr(sharding) if sharding is not None else None,
+            }
+        )
+    return out
+
+
+def fingerprint_program(spec) -> Dict[str, Any]:
+    """AOT-lower and compile one ProgramSpec; return its fingerprint.
+
+    The dtype/collective facts come from the LOWERED StableHLO (the
+    program as written — CPU legalization would otherwise rewrite bf16
+    collectives out of sight); aliasing and memory from the COMPILED
+    executable (the program as it will run); costs from the shared
+    HloCostAnalysis helper."""
+    from replication_faster_rcnn_tpu.benchmark import lowered_cost_analysis
+
+    jitted, args = spec.build()
+    lowered = jitted.lower(*args)
+    stablehlo = lowered.as_text()
+    compiled = lowered.compile()
+    try:
+        compiled_text = compiled.as_text()
+    except Exception:  # pragma: no cover - some backends hide HLO text
+        compiled_text = ""
+
+    sizes = [len(jax.tree_util.tree_leaves(a)) for a in args]
+    params: Dict[str, List[int]] = {}
+    start = 0
+    for role, n in zip(spec.arg_roles, sizes):
+        params[role] = [start, start + n]
+        start += n
+
+    try:
+        out_tree = jax.eval_shape(jitted, *args)
+    except Exception:  # pragma: no cover - defensive; specs are jittable
+        out_tree = ()
+
+    return {
+        "program": spec.name,
+        "feed": spec.feed,
+        "k": spec.k,
+        "args": {role: summarize_abstract(a) for role, a in zip(spec.arg_roles, args)},
+        "params": params,
+        "outputs": summarize_abstract(out_tree),
+        "aliasing": parse_alias_map(compiled_text),
+        "collectives": parse_collectives(stablehlo),
+        "has_f64": contains_f64(stablehlo),
+        "cost": lowered_cost_analysis(lowered),
+        "memory": memory_stats(compiled),
+        "meta": dict(spec.meta),
+    }
+
+
+# ------------------------------------------------------------------- bank IO
+
+
+def default_fingerprint_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "fingerprints")
+
+
+def bank_path(directory: str, name: str, platform: str) -> str:
+    return os.path.join(directory, f"{name}_{platform}.json")
+
+
+def load_bank(path: str) -> Optional[Dict[str, Any]]:
+    """The banked fingerprint record, or None when absent/unreadable
+    (callers surface that as the HX006 missing-bank violation)."""
+    try:
+        with open(path) as f:
+            bank = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if bank.get("schema") != SCHEMA:
+        return None
+    return bank
+
+
+def save_bank(path: str, bank: Dict[str, Any]) -> None:
+    """Atomic write (tmp + os.replace) so a killed re-bank can't leave a
+    half-written record for the next audit to choke on."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(bank, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def make_bank(
+    programs: Dict[str, Dict[str, Any]],
+    platform: str,
+    n_devices: int,
+    config_summary: Dict[str, Any],
+) -> Dict[str, Any]:
+    return {
+        "schema": SCHEMA,
+        "platform": platform,
+        "n_devices": n_devices,
+        "config": config_summary,
+        "programs": programs,
+    }
+
+
+# --------------------------------------------------------------------- drift
+
+# relative tolerances per numeric field: costs are deterministic for an
+# unchanged program (any real change moves them), memory estimates wobble
+# with XLA's buffer assignment across versions
+COST_REL_TOL = 0.02
+MEMORY_REL_TOL = 0.25
+
+# structural fields compared exactly
+_EXACT_FIELDS = ("args", "params", "outputs", "aliasing", "collectives", "has_f64")
+
+
+def _rel_delta(cur: float, banked: float) -> float:
+    if banked == 0.0:
+        return 0.0 if cur == 0.0 else float("inf")
+    return abs(cur - banked) / abs(banked)
+
+
+def diff_programs(
+    current: Dict[str, Any],
+    banked: Dict[str, Any],
+    cost_tol: float = COST_REL_TOL,
+    memory_tol: float = MEMORY_REL_TOL,
+) -> List[str]:
+    """Field-level drift between one program's live fingerprint and its
+    banked record: [] when they agree, else human-readable mismatches."""
+    out: List[str] = []
+    for field in _EXACT_FIELDS:
+        if current.get(field) != banked.get(field):
+            out.append(f"{field} changed vs bank")
+    for key in ("flops", "bytes_accessed"):
+        cur = float(current.get("cost", {}).get(key, 0.0))
+        bank = float(banked.get("cost", {}).get(key, 0.0))
+        d = _rel_delta(cur, bank)
+        if d > cost_tol:
+            out.append(
+                f"cost.{key} drifted {d:+.1%} (now {cur:.4g}, banked "
+                f"{bank:.4g}, tol {cost_tol:.0%})"
+            )
+    cur_mem, bank_mem = current.get("memory"), banked.get("memory")
+    if (cur_mem is None) != (bank_mem is None):
+        out.append("memory analysis availability changed vs bank")
+    elif cur_mem is not None:
+        d = _rel_delta(
+            float(cur_mem.get("peak_bytes_estimate", 0.0)),
+            float(bank_mem.get("peak_bytes_estimate", 0.0)),
+        )
+        if d > memory_tol:
+            out.append(
+                f"memory.peak_bytes_estimate drifted {d:+.1%} "
+                f"(tol {memory_tol:.0%})"
+            )
+    return out

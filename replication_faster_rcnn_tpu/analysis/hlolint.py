@@ -1,0 +1,467 @@
+"""HLO program auditor: contract rules over compiled-program fingerprints.
+
+The third static gate. jaxlint (`frcnn check`) proves jit hygiene at the
+Python-AST level and strict mode (`--strict`) polices the live process;
+this auditor asserts what the COMPILER emitted for every registered
+(feed × K) program of the step (train/warmup.py::build_program_specs)
+before anything runs:
+
+HX001  donation survives lowering as input/output aliasing for the state
+       arg — and NEVER for the device cache / batch / eval inputs
+       (train/train_step.py::make_cached_train_step's "cache must NOT be
+       donated" contract, checked in the artifact).
+HX002  dtype contracts: no silent f32→f64 promotion anywhere; the
+       gradient all-reduce element type matches
+       ``train.grad_allreduce_dtype`` (bf16 config ⇒ one bf16
+       all_reduce per float grad leaf; f32 config ⇒ zero bf16).
+HX003  collective inventory matches the backend: the shard_map feed
+       carries hand-placed psums (all_reduce only); loader/cached/eval
+       programs lower collective-free IR (GSPMD inserts collectives
+       after partitioning, never in the lowered module).
+HX004  compiled peak-memory estimate within ``analysis.hbm_budget_bytes``.
+HX005  per-program drift vs the banked fingerprint: structural fields
+       (shapes, shardings, aliasing, collectives) exactly, flops/bytes
+       and memory within tolerance.
+HX006  program set = expected bucket count: the bank covers exactly the
+       registry's programs on this platform (recompile/bucket drift
+       caught before runtime, complementing analysis/strict.py).
+
+`frcnn audit` drives this (``--json``, ``--update`` to re-bank, nonzero
+exit on any violation); tests/test_hlolint.py gates a CPU subset in
+tier 1 against the committed bank under ``analysis/fingerprints/``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+from replication_faster_rcnn_tpu.analysis import fingerprint as fp_mod
+from replication_faster_rcnn_tpu.config import FasterRCNNConfig
+
+HLO_RULES: Dict[str, str] = {
+    "HX001": "donation lost or leaked: state arg must alias, cache/batch/eval must not",
+    "HX002": "dtype contract: f64 in lowered IR, or all-reduce type != grad_allreduce_dtype",
+    "HX003": "collective inventory does not match the backend's expectation",
+    "HX004": "compiled peak-memory estimate exceeds the HBM budget",
+    "HX005": "fingerprint drift vs the banked record",
+    "HX006": "program set does not match the expected bucket count / bank missing",
+}
+
+# the audited program matrix: every feed the Trainer can run, single-step
+# and fused, plus eval — 7 programs
+AUDIT_FEEDS = ("loader", "cached", "spmd")
+AUDIT_KS = (1, 2)
+AUDIT_BANK_NAME = "ci"
+AUDIT_CACHE_N = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    program: str
+    message: str
+
+    def to_dict(self) -> Dict[str, str]:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"{self.rule} [{self.program}] {self.message}"
+
+
+@dataclasses.dataclass
+class AuditResult:
+    violations: List[Violation]
+    programs: Dict[str, Dict[str, Any]]
+    bank_file: str
+    updated: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rules": HLO_RULES,
+            "violations": [v.to_dict() for v in self.violations],
+            "programs": self.programs,
+            "bank_file": self.bank_file,
+            "updated": self.updated,
+            "ok": self.ok,
+        }
+
+
+def audit_config() -> FasterRCNNConfig:
+    """The audited config: the fast-tier 64×64 synthetic shape family
+    (same trims as benchmarks/step_profile.py::tiny_config) on a 2-way
+    data mesh with the bf16 gradient all-reduce ON — small enough to
+    compile everywhere, wide enough that every contract (psums, bf16
+    collectives, donation under out_shardings) is exercised for real."""
+    from replication_faster_rcnn_tpu.config import (
+        DataConfig,
+        FasterRCNNConfig,
+        MeshConfig,
+        ModelConfig,
+        ProposalConfig,
+        ROITargetConfig,
+        TrainConfig,
+    )
+
+    return FasterRCNNConfig(
+        model=ModelConfig(
+            backbone="resnet18", roi_op="align", compute_dtype="float32"
+        ),
+        data=DataConfig(dataset="synthetic", image_size=(64, 64), max_boxes=8),
+        train=TrainConfig(
+            batch_size=2,
+            n_epoch=4,
+            grad_allreduce_dtype="bfloat16",
+        ),
+        mesh=MeshConfig(num_data=2),
+        proposals=ProposalConfig(pre_nms_train=128, post_nms_train=32),
+        roi_targets=ROITargetConfig(n_sample=8),
+    )
+
+
+def expected_program_names(
+    feeds: Sequence[str] = AUDIT_FEEDS,
+    ks: Sequence[int] = AUDIT_KS,
+    include_eval: bool = True,
+) -> List[str]:
+    from replication_faster_rcnn_tpu.train.warmup import program_name
+
+    names = [program_name(f, k) for f in feeds for k in ks]
+    if include_eval:
+        names.append("eval_infer")
+    return names
+
+
+def collect_fingerprints(
+    config: FasterRCNNConfig,
+    programs: Optional[Sequence[str]] = None,
+    cache_n: int = AUDIT_CACHE_N,
+) -> Dict[str, Dict[str, Any]]:
+    """Lower + compile the requested programs (default: the full matrix)
+    and fingerprint each. This is the expensive arm — tens of seconds per
+    program on CPU; the contract/drift rules below are pure functions
+    over the returned dicts."""
+    from replication_faster_rcnn_tpu.train.warmup import build_program_specs
+
+    specs = build_program_specs(
+        config, feeds=AUDIT_FEEDS, ks=AUDIT_KS, include_eval=True, cache_n=cache_n
+    )
+    if programs is None:
+        wanted = list(specs)
+    else:
+        unknown = set(programs) - set(specs)
+        if unknown:
+            raise ValueError(
+                f"unknown programs {sorted(unknown)}; registry has {sorted(specs)}"
+            )
+        wanted = list(programs)
+    return {name: fp_mod.fingerprint_program(specs[name]) for name in wanted}
+
+
+# ------------------------------------------------------------ contract rules
+
+
+def check_contracts(
+    fingerprints: Dict[str, Dict[str, Any]],
+    config: FasterRCNNConfig,
+    hbm_budget_bytes: int,
+) -> List[Violation]:
+    """HX001–HX004 over live fingerprints (pure; no lowering here)."""
+    out: List[Violation] = []
+    want_dt = config.train.grad_allreduce_dtype
+    for name, fp in sorted(fingerprints.items()):
+        params: Dict[str, List[int]] = fp.get("params", {})
+        aliased = {a["parameter"] for a in fp.get("aliasing", [])}
+
+        # HX001 — donation as aliasing
+        if fp.get("feed") == "eval":
+            if aliased:
+                out.append(
+                    Violation(
+                        "HX001",
+                        name,
+                        f"eval program aliases params {sorted(aliased)[:8]} "
+                        "but nothing is donated to it",
+                    )
+                )
+        elif "state" in params:
+            s0, s1 = params["state"]
+            missing = sorted(set(range(s0, s1)) - aliased)
+            if missing:
+                out.append(
+                    Violation(
+                        "HX001",
+                        name,
+                        f"donated state arg lost input/output aliasing for "
+                        f"{len(missing)}/{s1 - s0} leaves (first params "
+                        f"{missing[:8]}) — donation did not survive lowering",
+                    )
+                )
+            for role, (r0, r1) in sorted(params.items()):
+                if role == "state":
+                    continue
+                leaked = sorted(aliased & set(range(r0, r1)))
+                if leaked:
+                    out.append(
+                        Violation(
+                            "HX001",
+                            name,
+                            f"non-donated arg `{role}` is aliased (params "
+                            f"{leaked[:8]}) — its buffer would be clobbered "
+                            "by the dispatch",
+                        )
+                    )
+
+        # HX002 — dtype contracts
+        if fp.get("has_f64"):
+            out.append(
+                Violation(
+                    "HX002",
+                    name,
+                    "f64 tensors in the lowered IR — silent x64 promotion "
+                    "on a program that must stay f32/bf16",
+                )
+            )
+        collectives = fp.get("collectives", {})
+        ar = collectives.get("all_reduce")
+        if fp.get("feed") == "spmd":
+            types = (ar or {}).get("element_types", {})
+            n_bf16 = types.get("bf16", 0)
+            n_grad = int(fp.get("meta", {}).get("n_float_grad_leaves", 1))
+            if want_dt == "bfloat16" and n_bf16 < n_grad:
+                out.append(
+                    Violation(
+                        "HX002",
+                        name,
+                        "grad all-reduce element type: expected >= "
+                        f"{n_grad} bf16 all_reduces (one per float grad "
+                        f"leaf) under grad_allreduce_dtype=bfloat16, found "
+                        f"{n_bf16} (types: {types or 'none'})",
+                    )
+                )
+            elif want_dt == "float32" and n_bf16:
+                out.append(
+                    Violation(
+                        "HX002",
+                        name,
+                        f"{n_bf16} bf16 all_reduces under "
+                        "grad_allreduce_dtype=float32 — the gradient "
+                        "exchange silently lost precision",
+                    )
+                )
+
+        # HX003 — collective inventory per backend
+        if fp.get("feed") == "spmd":
+            if not ar or not ar.get("count"):
+                out.append(
+                    Violation(
+                        "HX003",
+                        name,
+                        "no all_reduce in the lowered IR — the hand-placed "
+                        "psums of parallel/spmd.py are gone",
+                    )
+                )
+            other = sorted(set(collectives) - {"all_reduce"})
+            if other:
+                out.append(
+                    Violation(
+                        "HX003",
+                        name,
+                        f"unexpected collective kinds {other} — the "
+                        "shard_map backend emits psum all_reduces only",
+                    )
+                )
+        elif collectives:
+            out.append(
+                Violation(
+                    "HX003",
+                    name,
+                    f"collectives {sorted(collectives)} in a "
+                    f"{fp.get('feed')} program — the jit backend lowers "
+                    "collective-free IR (GSPMD inserts collectives after "
+                    "partitioning, not here)",
+                )
+            )
+
+        # HX004 — memory budget
+        mem = fp.get("memory")
+        if mem is not None:
+            peak = float(mem.get("peak_bytes_estimate", 0.0))
+            if peak > hbm_budget_bytes:
+                out.append(
+                    Violation(
+                        "HX004",
+                        name,
+                        f"peak-memory estimate {peak / 2**30:.2f} GiB "
+                        f"exceeds analysis.hbm_budget_bytes "
+                        f"({hbm_budget_bytes / 2**30:.2f} GiB)",
+                    )
+                )
+    return out
+
+
+def check_drift(
+    fingerprints: Dict[str, Dict[str, Any]],
+    bank: Optional[Dict[str, Any]],
+    bank_file: str,
+    expected: Sequence[str],
+    platform: str,
+    n_devices: int,
+) -> List[Violation]:
+    """HX005 (per-program drift) + HX006 (bank presence / program set)."""
+    out: List[Violation] = []
+    if bank is None:
+        out.append(
+            Violation(
+                "HX006",
+                "<bank>",
+                f"no banked fingerprints at {bank_file} — run "
+                "`frcnn audit --update` to bank the current programs",
+            )
+        )
+        return out
+    if bank.get("platform") != platform or bank.get("n_devices") != n_devices:
+        out.append(
+            Violation(
+                "HX006",
+                "<bank>",
+                f"bank was recorded on {bank.get('platform')}/"
+                f"{bank.get('n_devices')} devices but this audit runs on "
+                f"{platform}/{n_devices} — fingerprints do not transfer "
+                "across topologies; re-bank per platform",
+            )
+        )
+        return out
+    banked = bank.get("programs", {})
+    missing = sorted(set(expected) - set(banked))
+    extra = sorted(set(banked) - set(expected))
+    if missing:
+        out.append(
+            Violation(
+                "HX006",
+                "<bank>",
+                f"bank is missing programs {missing} of the expected "
+                f"{len(expected)}-program matrix — run `frcnn audit --update`",
+            )
+        )
+    if extra:
+        out.append(
+            Violation(
+                "HX006",
+                "<bank>",
+                f"bank has unexpected programs {extra} — stale bucket "
+                "(recompile drift) or a renamed program; re-bank",
+            )
+        )
+    for name, fp in sorted(fingerprints.items()):
+        if name not in banked:
+            continue  # HX006 above already owns set mismatches
+        for msg in fp_mod.diff_programs(fp, banked[name]):
+            out.append(Violation("HX005", name, msg))
+    return out
+
+
+# -------------------------------------------------------------------- driver
+
+
+def resolve_bank_file(
+    config: FasterRCNNConfig,
+    fingerprint_dir: Optional[str] = None,
+    bank_name: str = AUDIT_BANK_NAME,
+) -> str:
+    import jax
+
+    directory = (
+        fingerprint_dir
+        or config.analysis.fingerprint_dir
+        or fp_mod.default_fingerprint_dir()
+    )
+    return fp_mod.bank_path(directory, bank_name, jax.default_backend())
+
+
+def run_audit(
+    config: Optional[FasterRCNNConfig] = None,
+    programs: Optional[Sequence[str]] = None,
+    update: bool = False,
+    fingerprint_dir: Optional[str] = None,
+    hbm_budget_bytes: Optional[int] = None,
+    fingerprints: Optional[Dict[str, Dict[str, Any]]] = None,
+    bank_name: str = AUDIT_BANK_NAME,
+    cache_n: int = AUDIT_CACHE_N,
+) -> AuditResult:
+    """The audit gate: collect (or accept pre-collected) fingerprints,
+    enforce HX001–HX004 contracts, then either re-bank (``update``) or
+    check HX005/HX006 drift against the committed bank. Violations in the
+    result ⇒ the CLI exits nonzero."""
+    import jax
+
+    if config is None:
+        config = audit_config()
+    expected = expected_program_names()
+    if fingerprints is None:
+        fingerprints = collect_fingerprints(config, programs, cache_n=cache_n)
+    budget = (
+        hbm_budget_bytes
+        if hbm_budget_bytes is not None
+        else config.analysis.hbm_budget_bytes
+    )
+    violations = check_contracts(fingerprints, config, budget)
+    bank_file = resolve_bank_file(config, fingerprint_dir, bank_name)
+    platform = jax.default_backend()
+    n_devices = len(jax.devices())
+    updated = False
+    if update:
+        bank = fp_mod.load_bank(bank_file)
+        banked_programs: Dict[str, Any] = {}
+        if (
+            bank is not None
+            and bank.get("platform") == platform
+            and bank.get("n_devices") == n_devices
+        ):
+            banked_programs = dict(bank.get("programs", {}))
+        banked_programs.update(fingerprints)
+        fp_mod.save_bank(
+            bank_file,
+            fp_mod.make_bank(
+                banked_programs,
+                platform,
+                n_devices,
+                config_summary={
+                    "image_size": list(config.data.image_size),
+                    "batch_size": config.train.batch_size,
+                    "grad_allreduce_dtype": config.train.grad_allreduce_dtype,
+                    "backbone": config.model.backbone,
+                    "num_data": config.mesh.num_data,
+                    "cache_n": cache_n,
+                },
+            ),
+        )
+        updated = True
+        missing = sorted(set(expected) - set(banked_programs))
+        if missing:
+            violations.append(
+                Violation(
+                    "HX006",
+                    "<bank>",
+                    f"re-banked {len(fingerprints)} programs but the bank "
+                    f"still misses {missing} — run `frcnn audit --update` "
+                    "without --programs to bank the full matrix",
+                )
+            )
+    else:
+        bank = fp_mod.load_bank(bank_file)
+        violations.extend(
+            check_drift(
+                fingerprints, bank, bank_file, expected, platform, n_devices
+            )
+        )
+    return AuditResult(
+        violations=violations,
+        programs=fingerprints,
+        bank_file=bank_file,
+        updated=updated,
+    )

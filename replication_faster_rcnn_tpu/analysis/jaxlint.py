@@ -63,6 +63,7 @@ RULES: Dict[str, str] = {
     "JX004": "mutable/non-hashable value for a static jit argument",
     "JX005": "jax.random key reused without split",
     "JX006": "block_until_ready/device_get outside a telemetry span",
+    "JX007": "implicit-dtype array creation in jit-reachable code",
 }
 
 PACKAGE = "replication_faster_rcnn_tpu"
@@ -113,6 +114,18 @@ _SHARD_MAP_NAMES = {
     "jax.experimental.shard_map.shard_map",
 }
 _REMAT_NAMES = {"flax.linen.remat", "nn.remat", "jax.checkpoint", "jax.remat"}
+# jnp creation calls whose result dtype follows weak-type/x64 promotion
+# unless pinned; value = index of the positional dtype parameter (the
+# package idiom `jnp.zeros((), jnp.int32)` counts as explicit)
+_IMPLICIT_DTYPE_CALLS = {
+    "jax.numpy.array": 1,
+    "jax.numpy.asarray": 1,
+    "jax.numpy.zeros": 1,
+    "jax.numpy.ones": 1,
+    "jax.numpy.empty": 1,
+    "jax.numpy.full": 2,
+    "jax.numpy.arange": 3,
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -141,6 +154,7 @@ class Waiver:
     func: str  # "*" matches any function in the file
     reason: str
     used: bool = False
+    line: int = 0  # 1-based line of this [[waiver]] header in the TOML
 
     def matches(self, f: Finding) -> bool:
         return (
@@ -194,9 +208,18 @@ def load_baseline(path: str) -> Baseline:
     except ModuleNotFoundError:  # pragma: no cover - py 3.10 image
         import tomli as tomllib
     with open(path, "rb") as f:
-        data = tomllib.load(f)
+        raw = f.read().decode("utf-8")
+    data = tomllib.loads(raw)
+    # tomllib keeps array-of-tables in document order, so the Nth parsed
+    # waiver belongs to the Nth `[[waiver]]` header — that line number
+    # makes stale-waiver reports point at the exact entry to delete
+    header_lines = [
+        i + 1
+        for i, ln in enumerate(raw.splitlines())
+        if ln.strip().startswith("[[waiver]]")
+    ]
     waivers = []
-    for w in data.get("waiver", []):
+    for n, w in enumerate(data.get("waiver", [])):
         if not w.get("reason"):
             raise ValueError(
                 f"baseline waiver {w.get('rule')}:{w.get('path')} has no "
@@ -208,6 +231,7 @@ def load_baseline(path: str) -> Baseline:
                 path=w["path"],
                 func=w.get("func", "*"),
                 reason=w["reason"],
+                line=header_lines[n] if n < len(header_lines) else 0,
             )
         )
     excludes = {
@@ -1331,8 +1355,34 @@ class _RuleWalker:
                 "unattributed; wrap in `tracer.span(...)` (telemetry/spans.py) "
                 "or waive with a reason if a caller holds the span",
             )
+        # ---- JX007: implicit-dtype creation in jit-reachable code
+        if self.fi.jit_reachable:
+            self._check_implicit_dtype(call, dotted)
         # ---- JX004: mutable static args
         self._check_static_args(call, dotted)
+
+    def _check_implicit_dtype(self, call: ast.Call, dotted: List[str]) -> None:
+        hit = next((d for d in dotted if d in _IMPLICIT_DTYPE_CALLS), None)
+        if hit is None:
+            return
+        if any(kw.arg == "dtype" for kw in call.keywords):
+            return
+        if len(call.args) > _IMPLICIT_DTYPE_CALLS[hit]:
+            return  # positional dtype argument present
+        short = hit.replace("jax.numpy.", "jnp.")
+        if short in ("jnp.array", "jnp.asarray"):
+            # converting a tracer keeps its dtype; only host values
+            # (Python scalars/lists) take the weak-type promotion path
+            if call.args and self.tainted(call.args[0]):
+                return
+        self._emit(
+            "JX007",
+            call,
+            f"`{short}` with no explicit dtype in jit-reachable code — the "
+            "result dtype follows weak-type/x64 promotion (f32 today, f64 "
+            "under jax_enable_x64) and can silently drift a compiled "
+            "program's dtypes; pass dtype= explicitly",
+        )
 
     def _check_static_args(self, call: ast.Call, dotted: List[str]) -> None:
         static: Set[str] = set()

@@ -55,7 +55,11 @@ class StrictViolation(RuntimeError):
 
 # One process-wide compile-event counter. jax.monitoring has no
 # unregister API, so the listener must be installed once and count into
-# module state that outlives any particular harness.
+# module state that outlives any particular harness. The counter is
+# guarded by _listener_lock (the XLA client may fire events from a
+# compilation thread); harnesses never read it directly — they take
+# start/end deltas via compile_event_count() so two sequential (or
+# threaded) sessions can't attribute each other's compiles.
 _compile_events = 0
 _listener_installed = False
 _listener_lock = threading.Lock()
@@ -64,7 +68,8 @@ _listener_lock = threading.Lock()
 def _on_event_duration(event: str, duration: float, **kwargs: Any) -> None:
     global _compile_events
     if "backend_compile" in event:
-        _compile_events += 1
+        with _listener_lock:
+            _compile_events += 1
 
 
 def _install_compile_listener() -> None:
@@ -78,8 +83,10 @@ def _install_compile_listener() -> None:
 
 def compile_event_count() -> int:
     """Process-wide XLA backend-compile events seen since the listener
-    was installed (0 until a StrictHarness session has run)."""
-    return _compile_events
+    was installed (0 until a StrictHarness session has run). Read under
+    the lock; compare two calls for a session-relative delta."""
+    with _listener_lock:
+        return _compile_events
 
 
 class _ProgramState:
@@ -109,6 +116,12 @@ class StrictHarness:
         self.programs: Dict[str, _ProgramState] = {}
         self.violations: list[str] = []
         self._active = False
+        # per-session compile accounting: events observed during THIS
+        # harness's sessions only (start/end deltas of the process-wide
+        # counter), so concurrent or back-to-back harnesses don't claim
+        # each other's compiles
+        self._session_base: Optional[int] = None
+        self._session_events = 0
 
     # ------------------------------------------------------------- session
 
@@ -120,10 +133,13 @@ class StrictHarness:
         prev = getattr(jax.config, "jax_transfer_guard", None)
         jax.config.update("jax_transfer_guard", "disallow")
         self._active = True
+        self._session_base = compile_event_count()
         try:
             yield self
         finally:
             self._active = False
+            self._session_events += compile_event_count() - self._session_base
+            self._session_base = None
             jax.config.update("jax_transfer_guard", prev or "allow")
 
     # ------------------------------------------------------------ dispatch
@@ -143,13 +159,13 @@ class StrictHarness:
         st = self.programs.setdefault(program, _ProgramState())
         warm = st.dispatches >= self.warmup_dispatches
         st.dispatches += 1
-        compiles_before = _compile_events
+        compiles_before = compile_event_count()
         cache_before = self._cache_size(fn)
         if warm:
             yield
             st.warm_dispatches += 1
             cache_after = self._cache_size(fn)
-            compiled = _compile_events - compiles_before
+            compiled = compile_event_count() - compiles_before
             st.compiles_during_warm += compiled
             evidence = []
             if (
@@ -193,13 +209,22 @@ class StrictHarness:
 
     # -------------------------------------------------------------- report
 
+    def session_compile_events(self) -> int:
+        """Compile events attributed to THIS harness's sessions (closed
+        sessions' deltas plus the live session's so far) — NOT the
+        process-wide total another harness may have grown."""
+        live = 0
+        if self._active and self._session_base is not None:
+            live = compile_event_count() - self._session_base
+        return self._session_events + live
+
     def report(self) -> Dict[str, Any]:
         """Machine-readable summary: per-program dispatch/compile counts
-        plus the process-wide compile-event total."""
+        plus this harness's session-scoped compile-event total."""
         return {
             "active": self._active,
             "warmup_dispatches": self.warmup_dispatches,
-            "compile_events_total": _compile_events,
+            "compile_events_total": self.session_compile_events(),
             "violations": list(self.violations),
             "programs": {
                 name: {

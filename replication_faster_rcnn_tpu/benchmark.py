@@ -952,17 +952,25 @@ def abstract_step_inputs(cfg, tx):
     return model, state_abs, batch_abs
 
 
-def lowered_cost(fn, *abstract_args):
-    """{flops, bytes_accessed} of ``fn`` from HloCostAnalysis of its
-    abstract lowering (no compile). Only safe on a non-plugin backend;
-    callers guard (see :func:`_step_flops`)."""
-    ca = jax.jit(fn).lower(*abstract_args).cost_analysis()
+def lowered_cost_analysis(lowered):
+    """{flops, bytes_accessed} from an already-lowered program's
+    HloCostAnalysis (no compile). Shared by the step-profile harness and
+    the HLO auditor (analysis/fingerprint.py) so both price programs
+    identically. Only safe on a non-plugin backend; callers guard."""
+    ca = lowered.cost_analysis()
     if isinstance(ca, (list, tuple)):
         ca = ca[0] if ca else None
     return {
         "flops": float(ca.get("flops", 0.0)) if ca else 0.0,
         "bytes_accessed": float(ca.get("bytes accessed", 0.0)) if ca else 0.0,
     }
+
+
+def lowered_cost(fn, *abstract_args):
+    """{flops, bytes_accessed} of ``fn`` from HloCostAnalysis of its
+    abstract lowering (no compile). Only safe on a non-plugin backend;
+    callers guard (see :func:`_step_flops`)."""
+    return lowered_cost_analysis(jax.jit(fn).lower(*abstract_args))
 
 
 def _flops_of_config(cfg) -> float:

@@ -566,7 +566,7 @@ def cmd_trace_summary(args) -> int:
 
 def cmd_check(args) -> int:
     """jaxlint over the package (or explicit paths): jit-hygiene rules
-    JX001-JX006 resolved against analysis/baseline.toml. Pure AST work —
+    JX001-JX007 resolved against analysis/baseline.toml. Pure AST work —
     no jax import, fast enough to gate every PR. Exits nonzero on any
     unsuppressed finding or stale waiver."""
     import json
@@ -588,11 +588,13 @@ def cmd_check(args) -> int:
     else:
         for f in result.findings:
             print(f)
+        baseline_name = args.baseline or "analysis/baseline.toml"
         for w in result.stale_waivers:
             print(
-                f"stale waiver: {w.rule} {w.path} [{w.func}] matched "
-                "nothing — the violation is gone, remove it from "
-                "analysis/baseline.toml"
+                f"stale waiver ({baseline_name}:{w.line}): {w.rule} "
+                f"{w.path} [{w.func}] matched nothing — the violation it "
+                f"suppressed (reason: {w.reason!r}) is gone; delete the "
+                f"[[waiver]] entry at line {w.line}"
             )
         if args.verbose:
             for f, reason in result.suppressed:
@@ -605,6 +607,57 @@ def cmd_check(args) -> int:
             f"({len(RULES)} rules)"
         )
     return 1 if (result.findings or result.stale_waivers) else 0
+
+
+def cmd_audit(args) -> int:
+    """HLO program auditor (analysis/hlolint.py): AOT-lower every
+    registered (feed × K) train program + eval for the audited config,
+    enforce the compiled-artifact contracts HX001-HX004 (donation
+    aliasing, dtype, collectives, memory budget), and compare against the
+    committed fingerprint bank (HX005/HX006). The third static gate next
+    to `frcnn check` (AST) and --strict (runtime); exits nonzero on any
+    contract violation or unexplained fingerprint drift."""
+    import json
+    import os
+
+    # the audit's spmd programs need a multi-device mesh; on a CPU-only
+    # host ask XLA for virtual devices BEFORE jax initializes (matches
+    # the test tier's 8-device topology; no-op when jax is already up)
+    if "jax" not in sys.modules and args.device in ("auto", "cpu"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count=8".strip()
+            )
+    _apply_device(args.device)
+
+    from replication_faster_rcnn_tpu.analysis import hlolint
+    from replication_faster_rcnn_tpu.config import get_config
+
+    cfg = hlolint.audit_config() if args.config == "ci" else get_config(args.config)
+    programs = [p for p in args.programs.split(",") if p] if args.programs else None
+    result = hlolint.run_audit(
+        cfg,
+        programs=programs,
+        update=args.update,
+        fingerprint_dir=args.fingerprint_dir,
+        hbm_budget_bytes=args.hbm_budget,
+    )
+    if args.json:
+        print(json.dumps(result.to_dict(), indent=2))
+    else:
+        for v in result.violations:
+            print(v)
+        verdict = (
+            "re-banked" if result.updated and result.ok
+            else ("ok" if result.ok else "FAILED")
+        )
+        print(
+            f"audit: {len(result.programs)} program(s), "
+            f"{len(result.violations)} violation(s) -> {verdict} "
+            f"(bank: {result.bank_file})"
+        )
+    return 1 if result.violations else 0
 
 
 def cmd_telemetry(args) -> int:
@@ -748,7 +801,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     p_check = sub.add_parser(
         "check",
-        help="static jit-hygiene lint (jaxlint rules JX001-JX006) against "
+        help="static jit-hygiene lint (jaxlint rules JX001-JX007) against "
              "the committed suppression baseline; exits nonzero on any "
              "unsuppressed finding",
     )
@@ -763,6 +816,39 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_check.add_argument("-v", "--verbose", action="store_true",
                          help="also print waived findings with reasons")
     p_check.set_defaults(fn=cmd_check)
+
+    p_audit = sub.add_parser(
+        "audit",
+        help="HLO program auditor (rules HX001-HX006): donation/dtype/"
+             "collective/memory contracts + fingerprint drift over the "
+             "compiled (feed x K) programs; third gate next to 'check' "
+             "and --strict",
+    )
+    p_audit.add_argument("--config", default="ci",
+                         help="'ci' = the small audited-matrix config "
+                              "(default; what the committed fingerprints "
+                              "were banked with), or any preset name")
+    p_audit.add_argument("--device", default="auto",
+                         choices=["auto", "tpu", "cpu"],
+                         help="JAX backend (cpu/auto gets 8 virtual "
+                              "devices for the spmd programs)")
+    p_audit.add_argument("--programs", default=None, metavar="A,B,...",
+                         help="comma-separated subset of program names to "
+                              "lower (default: the full feed x K matrix + "
+                              "eval)")
+    p_audit.add_argument("--update", action="store_true",
+                         help="re-bank: write the collected fingerprints "
+                              "to the bank instead of failing on drift")
+    p_audit.add_argument("--fingerprint-dir", default=None, metavar="DIR",
+                         help="override analysis.fingerprint_dir (default: "
+                              "the committed analysis/fingerprints/)")
+    p_audit.add_argument("--hbm-budget", type=int, default=None,
+                         metavar="BYTES",
+                         help="override analysis.hbm_budget_bytes for the "
+                              "HX004 peak-memory gate")
+    p_audit.add_argument("--json", action="store_true",
+                         help="machine-readable result on stdout")
+    p_audit.set_defaults(fn=cmd_audit)
 
     args = parser.parse_args(argv)
     return args.fn(args)

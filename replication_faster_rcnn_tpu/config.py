@@ -486,6 +486,33 @@ class DebugConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AnalysisConfig:
+    """Static-analysis gates (analysis/hlolint.py).
+
+    ``hbm_budget_bytes`` bounds the HLO auditor's compiled peak-memory
+    estimate per program (rule HX004); the default is one v5e chip's
+    16 GiB HBM. ``fingerprint_dir`` overrides where `frcnn audit` reads
+    and re-banks compiled-program fingerprints; empty string (default)
+    uses the committed bank under the package's ``analysis/fingerprints``.
+    """
+
+    hbm_budget_bytes: int = 16 << 30
+    fingerprint_dir: str = ""
+
+    def __post_init__(self):
+        if not isinstance(self.hbm_budget_bytes, int) or self.hbm_budget_bytes <= 0:
+            raise ValueError(
+                "analysis.hbm_budget_bytes must be a positive int, got "
+                f"{self.hbm_budget_bytes!r}"
+            )
+        if not isinstance(self.fingerprint_dir, str):
+            raise ValueError(
+                "analysis.fingerprint_dir must be a string path, got "
+                f"{self.fingerprint_dir!r}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class FasterRCNNConfig:
     anchors: AnchorConfig = dataclasses.field(default_factory=AnchorConfig)
     proposals: ProposalConfig = dataclasses.field(default_factory=ProposalConfig)
@@ -498,6 +525,7 @@ class FasterRCNNConfig:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     compile: CompileConfig = dataclasses.field(default_factory=CompileConfig)
     debug: DebugConfig = dataclasses.field(default_factory=DebugConfig)
+    analysis: AnalysisConfig = dataclasses.field(default_factory=AnalysisConfig)
 
     def feature_size(self, image_size: Optional[Tuple[int, int]] = None) -> Tuple[int, int]:
         """Spatial size of the stride-16 feature map for a given image size.

@@ -62,7 +62,7 @@ def _nms_tail(
     r, c = probs.shape
     flat_boxes = boxes.reshape(r * c, 4)
     flat_scores = probs.reshape(r * c)
-    class_ids = jnp.tile(jnp.arange(c), (r,))
+    class_ids = jnp.tile(jnp.arange(c, dtype=jnp.int32), (r,))
     fg = (class_ids > 0) & jnp.repeat(roi_valid, c)
     fg &= flat_scores >= eval_cfg.score_thresh
 

@@ -152,13 +152,16 @@ class FasterRCNN(nn.Module):
             return (
                 jnp.concatenate(logits_l, axis=1),
                 jnp.concatenate(deltas_l, axis=1),
-                jnp.asarray(np.concatenate(anchors_l, axis=0)),
+                jnp.asarray(
+                    np.concatenate(anchors_l, axis=0), dtype=jnp.float32
+                ),
             )
         logits, deltas = self.rpn(feat)
         anchors = jnp.asarray(
             anchor_ops.make_anchors(
                 self.config.anchors, (feat.shape[1], feat.shape[2])
-            )
+            ),
+            dtype=jnp.float32,
         )
         return logits, deltas, anchors
 

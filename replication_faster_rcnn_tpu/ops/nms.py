@@ -73,7 +73,7 @@ def nms_fixed(
         valid = valid.at[i].set(is_valid)
         ious = box_ops.iou(boxes[best][None, :], boxes)[0]  # [N]
         # The selected box suppresses itself (IoU 1) and all overlaps.
-        suppress = (ious > iou_thresh) | (jnp.arange(n) == best)
+        suppress = (ious > iou_thresh) | (jnp.arange(n, dtype=jnp.int32) == best)
         live = jnp.where(is_valid & suppress, _NEG, live)
         return live, idx, valid
 

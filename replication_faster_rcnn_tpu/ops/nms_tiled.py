@@ -83,7 +83,10 @@ def nms_fixed_tiled(
         )
     valid_sorted = s_sorted > _NEG
 
-    later = jnp.arange(tile)[:, None] < jnp.arange(tile)[None, :]  # a before b
+    later = (
+        jnp.arange(tile, dtype=jnp.int32)[:, None]
+        < jnp.arange(tile, dtype=jnp.int32)[None, :]
+    )  # a before b
 
     def outer_cond(st):
         i, count, _, _ = st
@@ -96,7 +99,7 @@ def nms_fixed_tiled(
         ti = jax.lax.dynamic_slice_in_dim(order_p, i * tile, tile)
 
         # cross-tile: suppressed by any already-selected box (one matrix op)
-        kmask = jnp.arange(max_out) < count
+        kmask = jnp.arange(max_out, dtype=jnp.int32) < count
         cross = box_ops.iou(sel_boxes, tb) > iou_thresh  # [max_out, tile]
         m0 = tv & ~jnp.any(cross & kmask[:, None], axis=0)
 
@@ -112,7 +115,7 @@ def nms_fixed_tiled(
             g2 = m0 & ~jnp.any(suppress & g[:, None], axis=0)
             return g2, jnp.all(g2 == g)
 
-        g, _ = jax.lax.while_loop(sweep_cond, sweep_body, (m0, jnp.array(False)))
+        g, _ = jax.lax.while_loop(sweep_cond, sweep_body, (m0, jnp.array(False, dtype=bool)))
 
         # append this tile's selections to the compact buffers (in order)
         pos = count + jnp.cumsum(g) - 1
@@ -129,5 +132,5 @@ def nms_fixed_tiled(
         jnp.zeros((max_out,), jnp.int32),
     )
     _, count, _, sel_idx = jax.lax.while_loop(outer_cond, outer_body, init)
-    valid = jnp.arange(max_out) < count
+    valid = jnp.arange(max_out, dtype=jnp.int32) < count
     return jnp.where(valid, sel_idx, 0), valid

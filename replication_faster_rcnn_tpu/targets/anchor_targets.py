@@ -64,7 +64,7 @@ def anchor_targets(
     gt_best_anchor = jnp.argmax(ious, axis=0)  # [G]
     scatter_rows = jnp.where(gt_mask, gt_best_anchor, a)  # a = dropped
     argmax = argmax.at[scatter_rows].set(
-        jnp.arange(gt_boxes.shape[0]), mode="drop"
+        jnp.arange(gt_boxes.shape[0], dtype=jnp.int32), mode="drop"
     )
     forced = jnp.zeros((a,), bool).at[scatter_rows].set(True, mode="drop")
 

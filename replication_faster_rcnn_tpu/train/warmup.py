@@ -18,6 +18,16 @@ Two pieces take that off the startup critical path:
   Run via ``cli warmup`` (typically with the cache enabled) to populate
   the cache ahead of a fleet launch; each compile is timed under a
   ``compile/*`` telemetry span.
+
+Both consumers go through one PROGRAM REGISTRY
+(:func:`build_program_specs`): every (feed × K) train program the Trainer
+can jit — host loader, ``--cache-device`` selection feed, explicit
+shard_map SPMD — plus the eval inference program, each with the exact jit
+wrapping (donation, out_shardings) and abstract inputs (trainer
+shardings attached) the real run uses. ``warmup_compile`` compiles the
+subset its config selects; ``analysis/hlolint.py`` AOT-lowers the full
+matrix and audits the artifacts (aliasing, collectives, memory) against
+committed fingerprints.
 """
 
 from __future__ import annotations
@@ -25,9 +35,10 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
-from typing import Dict, Optional
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
+import numpy as np
 
 from replication_faster_rcnn_tpu.config import FasterRCNNConfig
 from replication_faster_rcnn_tpu.telemetry import spans as tspans
@@ -79,104 +90,183 @@ def _mesh_for(config: FasterRCNNConfig):
     return make_mesh(mesh_cfg), mesh_cfg
 
 
-def warmup_compile(
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    """One AOT-compilable program: the trainer-exact jitted callable plus
+    the abstract inputs (trainer shardings attached) it lowers against.
+
+    ``arg_roles`` names each positional abstract argument ("state",
+    "batch", "cache", "sel", ...) so downstream consumers (the HLO
+    auditor's donation rule) can map XLA parameter indices back to the
+    Python-level argument they came from. ``build`` is lazy: constructing
+    specs costs nothing until a consumer lowers a program.
+    """
+
+    name: str
+    feed: str  # "loader" | "cached" | "spmd" | "eval"
+    k: int  # fused steps per dispatch (1 = single step; 0 for eval)
+    arg_roles: Tuple[str, ...]
+    build: Callable[[], Tuple[Any, Tuple[Any, ...]]]
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+TRAIN_FEEDS: Tuple[str, ...] = ("loader", "cached", "spmd")
+
+
+def program_name(feed: str, k: int) -> str:
+    return "eval_infer" if feed == "eval" else f"train_{feed}_k{k}"
+
+
+def build_program_specs(
     config: FasterRCNNConfig,
+    feeds: Sequence[str] = ("loader",),
+    ks: Sequence[int] = (1,),
     include_eval: bool = True,
-) -> Dict[str, float]:
-    """AOT-compile the programs a training run of ``config`` would jit.
+    cache_n: Optional[int] = None,
+) -> Dict[str, ProgramSpec]:
+    """The registry: {program_name: ProgramSpec} for every requested
+    (feed × K) train program plus (``include_eval``) the eval inference
+    program, all against ONE config.
 
-    Covers the per-step train program, the fused multi-step program when
-    ``train.steps_per_dispatch > 1``, and (``include_eval``) the eval
-    inference program. Returns {program_name: compile_seconds}; with the
-    persistent cache enabled, a warmed second run shows near-zero times
-    here and — the point — at real-run startup.
-
-    The abstract inputs carry the trainer's shardings (state via
-    `train_state_shardings`, batch via `shard_batch`'s layouts) and the
-    trainer's donation/out_shardings, so the compiled executables are
-    cache hits for the real run, not merely similar programs."""
+    Each spec reproduces the Trainer's jit site exactly — loader/cached
+    feeds jit with ``donate_argnums=(0,)`` and
+    ``out_shardings=(state_shardings, None)``; the spmd feed comes
+    pre-jitted from `make_shard_map_train_step` (replicated state,
+    donated); eval is `Evaluator._jit_infer` under its own eval-mesh
+    placement — so what a consumer lowers is what the real run compiles,
+    not a similar program. ``cache_n`` sizes the abstract device cache
+    for cached-feed programs (default: two batches — the cache length is
+    a free shape parameter, and fingerprints pin it).
+    """
     from replication_faster_rcnn_tpu.benchmark import abstract_step_inputs
     from replication_faster_rcnn_tpu.parallel import (
         batch_sharding,
         image_sharding,
+        replicated,
         stacked_batch_sharding,
     )
     from replication_faster_rcnn_tpu.parallel.zero import train_state_shardings
     from replication_faster_rcnn_tpu.train.train_step import (
         build_multi_step,
+        make_cached_multi_step,
+        make_cached_train_step,
         make_optimizer,
         make_train_step,
     )
 
-    tracer = tspans.current_tracer()
+    unknown = set(feeds) - set(TRAIN_FEEDS)
+    if unknown:
+        raise ValueError(f"unknown feeds {sorted(unknown)}; pick from {TRAIN_FEEDS}")
+    if any(k < 1 for k in ks):
+        raise ValueError(f"ks must be >= 1, got {tuple(ks)}")
+
     mesh, mesh_cfg = _mesh_for(config)
     tx, _ = make_optimizer(config, steps_per_epoch=100)
-    model, state_abs, batch_abs = abstract_step_inputs(config, tx)
+    model, state_raw, batch_raw = abstract_step_inputs(config, tx)
     state_shardings = train_state_shardings(
-        state_abs, mesh, mesh_cfg, config.train.shard_opt_state
-    )
-    state_abs = jax.tree_util.tree_map(
-        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
-        state_abs,
-        state_shardings,
+        state_raw, mesh, mesh_cfg, config.train.shard_opt_state
     )
 
-    def _with_sharding(abs_batch, img_s, other_s):
+    def _attach(tree, shardings):
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            tree,
+            shardings,
+        )
+
+    state_abs = _attach(state_raw, state_shardings)
+    rep = replicated(mesh)
+    state_rep = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=rep), state_raw
+    )
+    img_s, other_s = image_sharding(mesh, mesh_cfg), batch_sharding(mesh, mesh_cfg)
+    batch_abs = {
+        k: jax.ShapeDtypeStruct(
+            v.shape, v.dtype, sharding=img_s if k == "image" else other_s
+        )
+        for k, v in batch_raw.items()
+    }
+    stacked_s = stacked_batch_sharding(mesh, mesh_cfg)
+
+    def _chunk_abs(k: int) -> Dict[str, jax.ShapeDtypeStruct]:
         return {
-            k: jax.ShapeDtypeStruct(
-                v.shape, v.dtype, sharding=img_s if k == "image" else other_s
-            )
-            for k, v in abs_batch.items()
-        }
-
-    batch_abs = _with_sharding(
-        batch_abs, image_sharding(mesh, mesh_cfg), batch_sharding(mesh, mesh_cfg)
-    )
-
-    times: Dict[str, float] = {}
-
-    def _compile(name: str, jitted, *args) -> None:
-        with tracer.span(f"compile/{name}", cat="compile"):
-            t0 = time.perf_counter()
-            jitted.lower(*args).compile()
-            times[name] = round(time.perf_counter() - t0, 3)
-
-    step_fn = make_train_step(model, config, tx)
-    _compile(
-        "train_step",
-        jax.jit(
-            step_fn, donate_argnums=(0,), out_shardings=(state_shardings, None)
-        ),
-        state_abs,
-        batch_abs,
-    )
-    k = max(1, config.train.steps_per_dispatch)
-    if k > 1:
-        stacked_s = stacked_batch_sharding(mesh, mesh_cfg)
-        chunk_abs = {
-            key: jax.ShapeDtypeStruct(
-                (k,) + v.shape, v.dtype, sharding=stacked_s
-            )
+            key: jax.ShapeDtypeStruct((k,) + v.shape, v.dtype, sharding=stacked_s)
             for key, v in batch_abs.items()
         }
-        _compile(
-            "multi_step",
-            jax.jit(
-                build_multi_step(step_fn, k),
-                donate_argnums=(0,),
-                out_shardings=(state_shardings, None),
-            ),
-            state_abs,
-            chunk_abs,
+
+    batch = config.train.batch_size
+    n_cache = cache_n if cache_n is not None else 2 * batch
+    # the cache holds the collated sample arrays minus per-step jitter
+    # geometry (data/device_cache.py: jitter attaches via sel, never the
+    # cache), replicated over the mesh like DeviceCache places them
+    cache_abs = {
+        k: jax.ShapeDtypeStruct((n_cache,) + v.shape[1:], v.dtype, sharding=rep)
+        for k, v in batch_raw.items()
+        if k != "jitter"
+    }
+
+    def _sel_abs(lead: Tuple[int, ...]) -> Dict[str, jax.ShapeDtypeStruct]:
+        sel = {"idx": jax.ShapeDtypeStruct(lead + (batch,), np.int32, sharding=rep)}
+        if config.data.augment_hflip:
+            sel["flip"] = jax.ShapeDtypeStruct(lead + (batch,), np.bool_, sharding=rep)
+        if config.data.augment_scale is not None:
+            sel["jitter"] = jax.ShapeDtypeStruct(
+                lead + (batch, 4), np.int32, sharding=rep
+            )
+        return sel
+
+    meta = {
+        "n_float_grad_leaves": sum(
+            1
+            for leaf in jax.tree_util.tree_leaves(state_raw.params)
+            if np.issubdtype(leaf.dtype, np.floating)
+        ),
+        "mesh_shape": dict(mesh.shape),
+    }
+
+    def _loader(k: int):
+        step_fn = make_train_step(model, config, tx)
+        if k == 1:
+            fn, args = step_fn, (state_abs, batch_abs)
+        else:
+            fn, args = build_multi_step(step_fn, k), (state_abs, _chunk_abs(k))
+        jitted = jax.jit(
+            fn, donate_argnums=(0,), out_shardings=(state_shardings, None)
         )
-    if include_eval:
+        return jitted, args
+
+    def _cached(k: int):
+        if k == 1:
+            fn = make_cached_train_step(model, config, tx)
+            args = (state_abs, cache_abs, _sel_abs(()))
+        else:
+            fn = make_cached_multi_step(model, config, tx, k)
+            args = (state_abs, cache_abs, _sel_abs((k,)))
+        # donate the state ONLY — the cache must survive the dispatch
+        # (train/train_step.py::make_cached_train_step)
+        jitted = jax.jit(
+            fn, donate_argnums=(0,), out_shardings=(state_shardings, None)
+        )
+        return jitted, args
+
+    def _spmd(k: int):
+        from replication_faster_rcnn_tpu.parallel.spmd import (
+            make_shard_map_train_step,
+        )
+
+        jitted, _ = make_shard_map_train_step(config, tx, mesh, steps_per_dispatch=k)
+        if k == 1:
+            return jitted, (state_rep, batch_abs)
+        return jitted, (state_rep, _chunk_abs(k))
+
+    def _eval():
         from replication_faster_rcnn_tpu.eval import Evaluator
 
         ev = Evaluator(config, model)
         # mirror Evaluator.evaluate's own placement: its eval mesh (or no
         # sharding on a single device), so the lowered program is the one
         # the real eval sweep jits
-        img_s, rep_s = ev._eval_sharding(config.train.batch_size)
+        e_img_s, rep_s = ev._eval_sharding(config.train.batch_size)
 
         def _abs(x, s):
             if s is None:
@@ -185,12 +275,89 @@ def warmup_compile(
 
         variables_abs = {
             "params": jax.tree_util.tree_map(
-                lambda x: _abs(x, rep_s), state_abs.params
+                lambda x: _abs(x, rep_s), state_raw.params
             ),
             "batch_stats": jax.tree_util.tree_map(
-                lambda x: _abs(x, rep_s), state_abs.batch_stats
+                lambda x: _abs(x, rep_s), state_raw.batch_stats
             ),
         }
-        images_abs = _abs(batch_abs["image"], img_s)
-        _compile("eval_infer", ev._jit_infer, variables_abs, images_abs)
+        images_abs = _abs(batch_raw["image"], e_img_s)
+        return ev._jit_infer, (variables_abs, images_abs)
+
+    builders = {"loader": _loader, "cached": _cached, "spmd": _spmd}
+    roles = {
+        "loader": ("state", "batch"),
+        "cached": ("state", "cache", "sel"),
+        "spmd": ("state", "batch"),
+    }
+    specs: Dict[str, ProgramSpec] = {}
+    for feed in feeds:
+        for k in ks:
+            name = program_name(feed, k)
+            specs[name] = ProgramSpec(
+                name=name,
+                feed=feed,
+                k=k,
+                arg_roles=roles[feed],
+                build=(lambda f=feed, kk=k: builders[f](kk)),
+                meta=dict(meta),
+            )
+    if include_eval:
+        specs["eval_infer"] = ProgramSpec(
+            name="eval_infer",
+            feed="eval",
+            k=0,
+            arg_roles=("variables", "images"),
+            build=_eval,
+            meta=dict(meta),
+        )
+    return specs
+
+
+def warmup_compile(
+    config: FasterRCNNConfig,
+    include_eval: bool = True,
+    cache_n: Optional[int] = None,
+) -> Dict[str, float]:
+    """AOT-compile the programs a training run of ``config`` would jit.
+
+    Covers the per-step train program of the config's own feed (spmd
+    backend, ``--cache-device`` selection feed when ``cache_n`` supplies
+    the dataset length, host loader otherwise), the fused multi-step
+    program when ``train.steps_per_dispatch > 1``, and (``include_eval``)
+    the eval inference program. Returns {program_name: compile_seconds};
+    with the persistent cache enabled, a warmed second run shows
+    near-zero times here and — the point — at real-run startup.
+
+    Everything comes from :func:`build_program_specs`, so the compiled
+    executables are cache hits for the real run, not merely similar
+    programs. Cached-feed programs need the cache length ``cache_n``
+    (= len(dataset)) to pin shapes; without it the loader program is
+    warmed instead (same step math, different feed plumbing)."""
+    tracer = tspans.current_tracer()
+    if config.train.backend == "spmd":
+        feed = "spmd"
+    elif config.data.cache_device and cache_n is not None:
+        feed = "cached"
+    else:
+        feed = "loader"
+    k = max(1, config.train.steps_per_dispatch)
+    ks = (1,) if k == 1 else (1, k)
+    specs = build_program_specs(
+        config, feeds=(feed,), ks=ks, include_eval=include_eval, cache_n=cache_n
+    )
+    # legacy names: the CLI's warmup report (and its consumers) predate
+    # the registry's canonical feed-qualified names
+    legacy = {program_name(feed, 1): "train_step"}
+    if k > 1:
+        legacy[program_name(feed, k)] = "multi_step"
+
+    times: Dict[str, float] = {}
+    for spec in specs.values():
+        name = legacy.get(spec.name, spec.name) if spec.feed != "eval" else spec.name
+        with tracer.span(f"compile/{name}", cat="compile"):
+            t0 = time.perf_counter()
+            jitted, args = spec.build()
+            jitted.lower(*args).compile()
+            times[name] = round(time.perf_counter() - t0, 3)
     return times

@@ -1,0 +1,12 @@
+"""JX007 negative: explicit dtype (keyword or positional), tracer pass-through."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    pad = jnp.zeros((4, 4), jnp.float32)  # positional dtype: explicit
+    idx = jnp.arange(4, dtype=jnp.int32)  # keyword dtype: explicit
+    y = jnp.asarray(x)  # tracer in, dtype preserved — no promotion path
+    return y[:4, :4] + pad + idx[None, :].astype(jnp.float32)

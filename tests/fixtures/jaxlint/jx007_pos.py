@@ -1,0 +1,10 @@
+"""JX007 positive: implicit-dtype array creation inside jit."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    pad = jnp.zeros((4, 4))  # JX007: dtype follows weak-type/x64 promotion
+    return x[:4, :4] + pad

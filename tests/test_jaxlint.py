@@ -123,6 +123,48 @@ class TestSuppression:
         assert result.stale_waivers[0].rule == "JX001"
         assert not result.to_dict()["ok"]
 
+    def test_jx007_waive_then_unwaive_round_trip(self, tmp_path):
+        pos = str(FIXTURES / "jx007_pos.py")
+        raw = lint_paths([pos], pkg_root=str(FIXTURES))
+        assert [f.rule for f in raw.findings] == ["JX007"]
+        f = raw.findings[0]
+
+        waived = lint_paths(
+            [pos],
+            baseline=self._waiver_toml(tmp_path, f),
+            pkg_root=str(FIXTURES),
+        )
+        assert waived.findings == []
+        assert len(waived.suppressed) == 1
+        assert waived.suppressed[0][0].key() == f.key()
+        assert waived.stale_waivers == []
+
+        back = lint_paths([pos], pkg_root=str(FIXTURES))
+        assert [x.key() for x in back.findings] == [f.key()]
+
+    def test_stale_waiver_carries_baseline_line_number(self, tmp_path):
+        """Each [[waiver]] remembers the line of its header so the CLI
+        can point at the exact entry to delete (satellite: stale-waiver
+        diagnostics)."""
+        baseline = tmp_path / "baseline.toml"
+        baseline.write_text(
+            "# leading comment\n"
+            "\n"
+            "[[waiver]]\n"
+            'rule = "JX001"\n'
+            'path = "nowhere.py"\n'
+            'func = "*"\n'
+            'reason = "first entry"\n'
+            "\n"
+            "[[waiver]]\n"
+            'rule = "JX002"\n'
+            'path = "also_nowhere.py"\n'
+            'func = "*"\n'
+            'reason = "second entry"\n'
+        )
+        base = load_baseline(str(baseline))
+        assert [w.line for w in base.waivers] == [3, 9]
+
     def test_waiver_without_reason_rejected(self, tmp_path):
         toml = tmp_path / "bad.toml"
         toml.write_text('[[waiver]]\nrule = "JX001"\npath = "x.py"\n')
@@ -212,6 +254,32 @@ class TestCheckCLI:
         out = capsys.readouterr().out
         assert rc == 1
         assert "JX002" in out
+
+    def test_check_reports_stale_waiver_line_and_reason(
+        self, capsys, tmp_path
+    ):
+        from replication_faster_rcnn_tpu import cli
+
+        baseline = tmp_path / "baseline.toml"
+        baseline.write_text(
+            "[[waiver]]\n"
+            'rule = "JX001"\n'
+            'path = "jx001_neg.py"\n'
+            'func = "*"\n'
+            'reason = "fixed long ago"\n'
+        )
+        rc = cli.main(
+            [
+                "check",
+                "--baseline",
+                str(baseline),
+                str(FIXTURES / "jx001_neg.py"),
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert f"{baseline}:1" in out
+        assert "fixed long ago" in out
 
     def test_check_json_payload_on_findings(self, capsys):
         from replication_faster_rcnn_tpu import cli

@@ -96,6 +96,33 @@ class TestStrictHarnessUnits:
                 with h.dispatch("p", f):
                     f(x2)
 
+    def test_compile_events_scoped_per_session(self):
+        """Back-to-back harnesses must not claim each other's compiles:
+        the report counts start/end deltas of the process-wide listener,
+        not its lifetime total (satellite: per-session accounting)."""
+        from replication_faster_rcnn_tpu.analysis import strict as strict_mod
+
+        x = jnp.zeros(5)
+        h1 = StrictHarness()
+        with h1.session():
+            with h1.dispatch("warmup_prog", jax.jit(lambda v: v * 7)):
+                pass  # arm the listener without depending on a compile
+        baseline_total = strict_mod.compile_event_count()
+
+        # compile a fresh program OUTSIDE any session: the process-wide
+        # counter grows, but no harness may attribute it
+        jax.jit(lambda v: v * 11 + 1)(x).block_until_ready()
+        grew = strict_mod.compile_event_count() - baseline_total
+
+        h2 = StrictHarness()
+        with h2.session():
+            pass
+        assert h2.report()["compile_events_total"] == 0
+        assert h1.session_compile_events() <= baseline_total
+        if grew:
+            # the stray compile is visible globally yet owned by nobody
+            assert strict_mod.compile_event_count() >= baseline_total + 1
+
     def test_debug_config_validation(self):
         assert DebugConfig().strict is False
         assert DebugConfig(strict=True, strict_warmup=3).strict_warmup == 3
