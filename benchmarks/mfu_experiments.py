@@ -284,6 +284,30 @@ EXPERIMENTS = [
     },
 ]
 
+# Queue order by wedge risk (VERDICT round 5, item 5): round 5 lost the
+# devcache leg, FPN re-verify, the trace, and all the A/Bs to a
+# transfer-stress leg that ran before them. Safe validations go first
+# (re-records, sweeps, devcache, A/Bs, first-records), then the known
+# wedge classes in increasing blast order: FPN init compile, the
+# profiler trace, and the u8/transfer-stress legs dead last. Values are
+# indices into EXPERIMENTS — positions stay stable, new experiments
+# append and must be slotted here by risk class.
+DEFAULT_ORDER = [
+    13, 0,       # flagship re-records (default pair, top_k)
+    2, 3,        # NMS tile sweeps
+    4,           # mu-dtype A/B
+    6,           # eval throughput
+    18,          # device-cache fed trainer (safe validation)
+    15, 16, 17,  # trunk-BN A/Bs: frozen-BN, device-jitter, GroupNorm
+    10, 11,      # first on-chip records: voc12_align, coco_resnet50
+    14,          # grad breakdown
+    12,          # pallas in-step tombstone
+    1, 5,        # FPN legs (compile-heavy, the observed wedge trigger)
+    7,           # profiler trace (documented wedge risk)
+    8, 9,        # u8/transfer-stress legs dead last (round-5 wedge)
+]
+assert sorted(DEFAULT_ORDER) == list(range(len(EXPERIMENTS)))
+
 
 def _relay_alive() -> bool:
     r = subprocess.run(["pgrep", "-f", "[r]elay.py"], capture_output=True)
@@ -405,7 +429,8 @@ def main() -> None:
         print("relay is DEAD — refusing to run (verify SKILL.md discipline)")
         sys.exit(3)
 
-    todo = EXPERIMENTS
+    # no --only: run everything in the wedge-risk order, not list order
+    todo = [EXPERIMENTS[i] for i in DEFAULT_ORDER]
     if args.only:
         idx = [int(i) for i in args.only.split(",")]
         todo = [EXPERIMENTS[i] for i in idx]

@@ -1,0 +1,336 @@
+"""Serving load-generator benchmark + regression gate.
+
+Prices the serving engine's amortization claim: continuous micro-batched
+serving (serving/engine.py) vs the sequential one-image-per-dispatch
+loop that `predict_image` used to be, at the SAME bucket shape, on the
+same host. Batching wins by splitting the per-dispatch fixed cost
+(Python dispatch, program launch, device_put/get, host assembly) across
+the flush — which is exactly the regime of the tiny CI shape on a
+single-core CPU host, where fixed cost dominates per-image compute.
+
+Measured legs (serving/loadgen.py):
+  * sequential — Evaluator.predict_batch, batch 1, one dispatch per
+    image: the baseline `predict_image` pays.
+  * engine closed-loop per compiled batch size — saturation capacity and
+    latency (p50/p99) with flushes at full bucket batch.
+  * engine open-loop at ~70% of measured capacity — the latency a user
+    sees at a sane traffic level, queueing included.
+
+Banked under benchmarks/records/ (step_profile.py conventions: atomic
+save, --update to re-bank, --no-check to just measure). The gate fails
+(exit 1) when engine capacity regresses >tol vs the banked record or
+when the batched/sequential speedup falls below --min-speedup (default
+2.0, the PR-7 acceptance floor).
+
+Usage:
+  python benchmarks/serving_profile.py            # measure + gate
+  python benchmarks/serving_profile.py --update   # re-bank
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+RECORDS_DIR = os.path.join(_REPO, "benchmarks", "records")
+SCHEMA = "serving_profile/v1"
+DEFAULT_TOL = 0.15
+DEFAULT_MIN_SPEEDUP = 2.0
+# the gate: engine capacity at the largest compiled batch
+GATE_KEY = "engine_images_per_sec"
+
+
+def record_key(config_token: str, platform: str) -> str:
+    return f"{config_token}_{platform}"
+
+
+def record_path(key: str, records_dir: str = RECORDS_DIR) -> str:
+    return os.path.join(records_dir, f"serving_profile_{key}.json")
+
+
+def load_record(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_record(record, path: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def check_regression(
+    current,
+    banked,
+    tol: float = DEFAULT_TOL,
+    min_speedup: float = DEFAULT_MIN_SPEEDUP,
+):
+    """(failures, warnings) — pure, unit-testable. Failures: engine
+    capacity >tol below the banked record, or the measured batched-vs-
+    sequential speedup below the acceptance floor."""
+    failures, warnings = [], []
+    if banked is not None and banked.get("schema") != SCHEMA:
+        warnings.append(
+            f"banked record has schema {banked.get('schema')!r}, expected "
+            f"{SCHEMA!r}; skipping comparison"
+        )
+        banked = None
+    if banked is not None:
+        old = banked.get(GATE_KEY)
+        new = current.get(GATE_KEY)
+        if old and new:
+            drop = 1.0 - new / old
+            if drop > tol:
+                failures.append(
+                    f"{GATE_KEY} regressed {drop:+.1%}: {new:.3f} vs banked "
+                    f"{old:.3f} (tolerance {tol:.0%})"
+                )
+            elif drop > tol / 2:
+                warnings.append(
+                    f"{GATE_KEY} within tolerance but slipping {drop:+.1%}: "
+                    f"{new:.3f} vs banked {old:.3f}"
+                )
+        old_p99 = (banked.get("engine") or {}).get("p99_ms")
+        new_p99 = (current.get("engine") or {}).get("p99_ms")
+        if old_p99 and new_p99:
+            growth = new_p99 / old_p99 - 1.0
+            if growth > 4 * tol:  # latency tails are noisy; warn only
+                warnings.append(
+                    f"engine p99 latency grew {growth:+.1%}: {new_p99:.1f} ms "
+                    f"vs banked {old_p99:.1f} ms"
+                )
+    speedup = current.get("speedup")
+    if speedup is not None and speedup < min_speedup:
+        failures.append(
+            f"batched/sequential speedup {speedup:.2f}x below the "
+            f"{min_speedup:.1f}x acceptance floor (engine "
+            f"{current.get(GATE_KEY)} img/s vs sequential "
+            f"{current.get('sequential_images_per_sec')} img/s)"
+        )
+    return failures, warnings
+
+
+# ---------------------------------------------------------------------------
+# measurement
+
+
+def serving_config(image_size: int = 16, max_batch: int = 32):
+    """Trimmed-budget serving config: synthetic resnet18 with ONE serving
+    bucket at ``image_size`` and compiled batches (1, max_batch), so the
+    sequential and batched legs run the identical per-image math and the
+    comparison isolates dispatch amortization.
+
+    The defaults put the per-image forward in the overhead-bound regime
+    where micro-batching pays on a CPU host: at 16x16 the convs and the
+    per-ROI tail are dominated by per-op fixed cost, not FLOPs, so a
+    batch-32 flush amortizes it ~2.6x (measured raw on a 1-core CPU:
+    16.5 ms/img at batch 1 vs 6.4 at batch 32). At 32x32 with the
+    default NMS budgets the ResNet tail over 16 ROIs is compute-bound at
+    ~60 ms/image and batching is a wash (~1.1x) — use
+    --image-size/--max-batch to measure that regime explicitly."""
+    from replication_faster_rcnn_tpu.config import (
+        DataConfig,
+        EvalConfig,
+        FasterRCNNConfig,
+        MeshConfig,
+        ModelConfig,
+        ProposalConfig,
+        ROITargetConfig,
+        ServingConfig,
+        TrainConfig,
+    )
+
+    return FasterRCNNConfig(
+        model=ModelConfig(
+            backbone="resnet18", roi_op="align", compute_dtype="float32"
+        ),
+        data=DataConfig(
+            dataset="synthetic",
+            image_size=(image_size, image_size),
+            max_boxes=8,
+        ),
+        train=TrainConfig(batch_size=1, n_epoch=1),
+        mesh=MeshConfig(num_data=1),
+        proposals=ProposalConfig(
+            pre_nms_train=128,
+            post_nms_train=32,
+            pre_nms_test=16,
+            post_nms_test=2,
+        ),
+        roi_targets=ROITargetConfig(n_sample=8),
+        eval=EvalConfig(max_detections=2),
+        serving=ServingConfig(
+            resolutions=((image_size, image_size),),
+            batch_sizes=(1, max_batch),
+            # deadline >= a full flush's drain time: on a 1-core host the
+            # producer thread refills the queue while the worker computes,
+            # and a short deadline would cut partial flushes whose
+            # pad-to-bucket slots burn throughput
+            max_delay_ms=50.0,
+            queue_depth=64,
+            params_dtype="float32",
+        ),
+    )
+
+
+def profile(cfg, config_token: str, n_requests: int = 64):
+    import time
+
+    import jax
+    import numpy as np
+
+    from replication_faster_rcnn_tpu.eval.evaluator import Evaluator
+    from replication_faster_rcnn_tpu.models.faster_rcnn import init_variables
+    from replication_faster_rcnn_tpu.serving import loadgen
+    from replication_faster_rcnn_tpu.serving.engine import InferenceEngine
+
+    h, w = cfg.serving.bucket_resolutions(cfg.data.image_size)[0]
+    rng = np.random.RandomState(0)
+    # preprocessed float32 images at the bucket shape: both legs skip the
+    # host resize so the comparison is pure dispatch-path
+    images = [
+        rng.rand(h, w, 3).astype(np.float32) * 2.0 - 1.0 for _ in range(8)
+    ]
+    model, variables = init_variables(cfg, jax.random.PRNGKey(0))
+
+    # -- sequential baseline: one dispatch per image, batch 1 — what the
+    # old predict_image loop paid per call, minus file I/O
+    def sequential_rep():
+        lat = []
+        t0 = time.monotonic()
+        for i in range(n_requests):
+            t1 = time.monotonic()
+            ev.predict_batch(variables, images[i % len(images)][None])
+            lat.append(time.monotonic() - t1)
+        wall = time.monotonic() - t0
+        return {
+            "n_requests": n_requests,
+            "wall_s": round(wall, 4),
+            "images_per_sec": round(n_requests / wall, 3),
+            "p50_ms": round(loadgen.percentile_ms(lat, 50), 3),
+            "p99_ms": round(loadgen.percentile_ms(lat, 99), 3),
+        }
+
+    ev = Evaluator(cfg, model)
+    ev.predict_batch(variables, images[0][None])  # compile outside timing
+
+    engine = InferenceEngine(cfg, model, variables, warmup=True)
+    try:
+        loadgen.run_closed_loop(engine, images, 8)  # warm the queue path
+        # Interleave the legs and keep each leg's fastest rep: host speed
+        # on a shared single-core box drifts on a seconds scale, and
+        # measuring the legs back-to-back would fold that drift into the
+        # speedup ratio. Alternating reps samples both legs across the
+        # same conditions; best-of-N is the standard throughput anti-noise
+        # idiom.
+        seq_reps, closed_reps = [], []
+        for _ in range(3):
+            seq_reps.append(sequential_rep())
+            closed_reps.append(
+                loadgen.run_closed_loop(engine, images, n_requests)
+            )
+        sequential = max(seq_reps, key=lambda r: r["images_per_sec"])
+        closed = max(closed_reps, key=lambda r: r["images_per_sec"])
+        offered = max(1.0, 0.7 * closed["images_per_sec"])
+        open_loop = loadgen.run_open_loop(
+            engine, images, offered_rate=offered, n_requests=n_requests
+        )
+        flush_sizes = [n for _, n in engine._batcher.flush_log]
+        per_batch = {
+            str(bn): flush_sizes.count(bn) for bn in engine.batch_sizes
+        }
+        stats = dict(engine.stats)
+        compile_seconds = dict(engine.compile_seconds)
+    finally:
+        engine.close()
+
+    speedup = (
+        round(closed["images_per_sec"] / sequential["images_per_sec"], 3)
+        if sequential["images_per_sec"]
+        else None
+    )
+    return {
+        "schema": SCHEMA,
+        "config": config_token,
+        "platform": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+        "bucket": [h, w],
+        "batch_sizes": list(engine.batch_sizes),
+        "max_delay_ms": cfg.serving.max_delay_ms,
+        "sequential": sequential,
+        "sequential_images_per_sec": sequential["images_per_sec"],
+        "engine": closed,
+        GATE_KEY: closed["images_per_sec"],
+        "engine_open_loop": open_loop,
+        "flushes_by_size": per_batch,
+        "engine_stats": stats,
+        "compile_seconds": compile_seconds,
+        "speedup": speedup,
+        "measured": True,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--image-size", type=int, default=16)
+    p.add_argument("--max-batch", type=int, default=32)
+    p.add_argument("--requests", type=int, default=96)
+    p.add_argument("--update", action="store_true",
+                   help="write/overwrite the banked record")
+    p.add_argument("--no-check", action="store_true",
+                   help="measure + print only")
+    p.add_argument("--tol", type=float, default=DEFAULT_TOL)
+    p.add_argument("--min-speedup", type=float, default=DEFAULT_MIN_SPEEDUP,
+                   help="fail when batched/sequential speedup is below "
+                        "this floor (PR acceptance: 2.0)")
+    p.add_argument("--records-dir", default=RECORDS_DIR)
+    args = p.parse_args(argv)
+
+    cfg = serving_config(args.image_size, args.max_batch)
+    token = f"tiny{args.image_size}b{args.max_batch}"
+    record = profile(cfg, token, n_requests=args.requests)
+    path = record_path(record_key(token, record["platform"]), args.records_dir)
+    print(json.dumps(record, indent=1, sort_keys=True))
+
+    if args.update:
+        save_record(record, path)
+        print(f"serving_profile: banked {path}", file=sys.stderr)
+        return 0
+    if args.no_check:
+        return 0
+    banked = load_record(path) if os.path.exists(path) else None
+    if banked is None:
+        print(
+            f"serving_profile: no banked record at {path} — run with "
+            "--update to create one (still enforcing the speedup floor)",
+            file=sys.stderr,
+        )
+    failures, warnings = check_regression(
+        record, banked, tol=args.tol, min_speedup=args.min_speedup
+    )
+    for w in warnings:
+        print(f"serving_profile: WARN {w}", file=sys.stderr)
+    for f in failures:
+        print(f"serving_profile: FAIL {f}", file=sys.stderr)
+    if failures:
+        print(
+            f"serving_profile: REGRESSION vs {path} — if intentional, "
+            "re-bank with --update",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"serving_profile: OK vs {path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,7 +49,8 @@ HLO_RULES: Dict[str, str] = {
 }
 
 # the audited program matrix: every feed the Trainer can run, single-step
-# and fused, plus eval — 7 programs
+# and fused, plus eval (7 programs) and the serving engine's bucket
+# matrix (audit_config's 2 resolutions × 2 batch sizes = 4 more)
 AUDIT_FEEDS = ("loader", "cached", "spmd")
 AUDIT_KS = (1, 2)
 AUDIT_BANK_NAME = "ci"
@@ -104,6 +105,7 @@ def audit_config() -> FasterRCNNConfig:
         ModelConfig,
         ProposalConfig,
         ROITargetConfig,
+        ServingConfig,
         TrainConfig,
     )
 
@@ -120,6 +122,14 @@ def audit_config() -> FasterRCNNConfig:
         mesh=MeshConfig(num_data=2),
         proposals=ProposalConfig(pre_nms_train=128, post_nms_train=32),
         roi_targets=ROITargetConfig(n_sample=8),
+        # pinned (not derived) buckets so the audited serving matrix can't
+        # shift under an image_size change without an explicit re-bank;
+        # bf16 resident params = the serving default, exercised for real
+        serving=ServingConfig(
+            resolutions=((32, 32), (64, 64)),
+            batch_sizes=(1, 2),
+            params_dtype="bfloat16",
+        ),
     )
 
 
@@ -127,12 +137,20 @@ def expected_program_names(
     feeds: Sequence[str] = AUDIT_FEEDS,
     ks: Sequence[int] = AUDIT_KS,
     include_eval: bool = True,
+    config: Optional[FasterRCNNConfig] = None,
 ) -> List[str]:
-    from replication_faster_rcnn_tpu.train.warmup import program_name
+    """The audited program set; with ``config`` the serving engine's
+    bucket programs (serving.resolutions × batch_sizes) are included."""
+    from replication_faster_rcnn_tpu.train.warmup import (
+        program_name,
+        serving_program_names,
+    )
 
     names = [program_name(f, k) for f in feeds for k in ks]
     if include_eval:
         names.append("eval_infer")
+    if config is not None:
+        names.extend(serving_program_names(config))
     return names
 
 
@@ -145,11 +163,15 @@ def collect_fingerprints(
     and fingerprint each. This is the expensive arm — tens of seconds per
     program on CPU; the contract/drift rules below are pure functions
     over the returned dicts."""
-    from replication_faster_rcnn_tpu.train.warmup import build_program_specs
+    from replication_faster_rcnn_tpu.train.warmup import (
+        build_program_specs,
+        build_serving_specs,
+    )
 
     specs = build_program_specs(
         config, feeds=AUDIT_FEEDS, ks=AUDIT_KS, include_eval=True, cache_n=cache_n
     )
+    specs = {**specs, **build_serving_specs(config)}
     if programs is None:
         wanted = list(specs)
     else:
@@ -177,15 +199,17 @@ def check_contracts(
         params: Dict[str, List[int]] = fp.get("params", {})
         aliased = {a["parameter"] for a in fp.get("aliasing", [])}
 
-        # HX001 — donation as aliasing
-        if fp.get("feed") == "eval":
+        # HX001 — donation as aliasing (serving programs share eval's
+        # contract: pure inference, nothing may be donated/clobbered —
+        # the engine's resident params survive every dispatch)
+        if fp.get("feed") in ("eval", "serve"):
             if aliased:
                 out.append(
                     Violation(
                         "HX001",
                         name,
-                        f"eval program aliases params {sorted(aliased)[:8]} "
-                        "but nothing is donated to it",
+                        f"{fp.get('feed')} program aliases params "
+                        f"{sorted(aliased)[:8]} but nothing is donated to it",
                     )
                 )
         elif "state" in params:
@@ -401,7 +425,7 @@ def run_audit(
 
     if config is None:
         config = audit_config()
-    expected = expected_program_names()
+    expected = expected_program_names(config=config)
     if fingerprints is None:
         fingerprints = collect_fingerprints(config, programs, cache_n=cache_n)
     budget = (

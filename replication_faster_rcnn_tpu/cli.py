@@ -491,7 +491,11 @@ def cmd_warmup(args) -> int:
         tracer = tspans.SpanTracer(os.path.join(args.telemetry, "trace.json"))
         tspans.set_tracer(tracer)
     try:
-        times = warmup_compile(cfg, include_eval=not args.train_only)
+        times = warmup_compile(
+            cfg,
+            include_eval=not args.train_only,
+            include_serving=args.serving,
+        )
     finally:
         if tracer is not None:
             tracer.flush()
@@ -505,20 +509,117 @@ def cmd_warmup(args) -> int:
 def cmd_predict(args) -> int:
     _apply_device(args.device)
     import json
+    import os
 
     from replication_faster_rcnn_tpu.eval.predict import (
         draw_detections,
-        predict_image,
+        predict_images,
     )
     from replication_faster_rcnn_tpu.train.trainer import load_eval_variables
 
     cfg = _build_config(args)
     model, variables = load_eval_variables(cfg, args.workdir, args.checkpoint_step)
-    dets = predict_image(cfg, model, variables, args.image, args.score_thresh)
-    print(json.dumps(dets, indent=2))
+    paths = list(args.image)
+    # all paths go through the serving engine as one submission wave, so
+    # same-bucket images share micro-batched dispatches
+    dets = predict_images(cfg, model, variables, paths, args.score_thresh)
+    if len(paths) == 1:
+        print(json.dumps(dets[0], indent=2))
+    else:
+        print(json.dumps(dict(zip(paths, dets)), indent=2))
     if args.output:
-        draw_detections(args.image, dets, args.output)
-        print(f"annotated image written to {args.output}")
+        if len(paths) == 1:
+            draw_detections(paths[0], dets[0], args.output)
+            print(f"annotated image written to {args.output}")
+        else:
+            root, ext = os.path.splitext(args.output)
+            for i, (path, d) in enumerate(zip(paths, dets)):
+                out = f"{root}.{i}{ext or '.jpg'}"
+                draw_detections(path, d, out)
+                print(f"annotated image written to {out}")
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """Bucketed AOT serving (serving/): compile every (resolution x
+    batch) bucket program at startup, hold the inference params resident
+    on device, and serve HTTP requests through the continuous
+    micro-batching engine."""
+    _apply_device(args.device)
+    import contextlib
+    import dataclasses as _dc
+    import json
+
+    from replication_faster_rcnn_tpu.serving.engine import InferenceEngine
+    from replication_faster_rcnn_tpu.serving.server import make_server
+    from replication_faster_rcnn_tpu.train.trainer import load_eval_variables
+    from replication_faster_rcnn_tpu.train.warmup import (
+        maybe_enable_compile_cache,
+    )
+
+    cfg = _build_config(args)
+    serving = cfg.serving
+    if args.max_delay_ms is not None:
+        serving = _dc.replace(serving, max_delay_ms=args.max_delay_ms)
+    if args.bucket_batch_sizes:
+        serving = _dc.replace(
+            serving,
+            batch_sizes=tuple(
+                int(b) for b in args.bucket_batch_sizes.split(",")
+            ),
+        )
+    if args.resolutions:
+        serving = _dc.replace(
+            serving,
+            resolutions=tuple(
+                tuple(int(x) for x in r.split("x"))
+                for r in args.resolutions.split(",")
+            ),
+        )
+    if args.params_dtype:
+        serving = _dc.replace(serving, params_dtype=args.params_dtype)
+    cfg = cfg.replace(serving=serving)
+    maybe_enable_compile_cache(cfg)
+    model, variables = load_eval_variables(cfg, args.workdir, args.checkpoint_step)
+    engine = InferenceEngine(cfg, model, variables, warmup=True)
+    stack = contextlib.ExitStack()
+    if args.strict or cfg.debug.strict:
+        from replication_faster_rcnn_tpu.analysis.strict import StrictHarness
+
+        engine.strict = StrictHarness(
+            warmup_dispatches=cfg.debug.strict_warmup
+        )
+        stack.enter_context(engine.strict.session())
+    print(
+        json.dumps(
+            {
+                "buckets": [list(b) for b in engine.buckets],
+                "batch_sizes": list(engine.batch_sizes),
+                "max_delay_ms": cfg.serving.max_delay_ms,
+                "params_dtype": cfg.serving.params_dtype,
+                "compile_seconds": engine.compile_seconds,
+                "strict": engine.strict is not None,
+            },
+            indent=2,
+        )
+    )
+    server = make_server(
+        engine, args.host, args.port, score_thresh=args.score_thresh
+    )
+    host, port = server.server_address[:2]
+    print(
+        f"serving on http://{host}:{port}/ "
+        "(POST /predict {\"paths\": [...]}, GET /healthz, GET /stats)",
+        flush=True,
+    )
+    with stack:
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.server_close()
+            engine.close()
     return 0
 
 
@@ -753,19 +854,58 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_common(p_warm)
     p_warm.add_argument("--train-only", action="store_true",
                         help="skip the eval inference program")
+    p_warm.add_argument("--serving", action="store_true",
+                        help="also AOT-compile the serving engine's bucket "
+                             "matrix (serving.resolutions x batch_sizes), "
+                             "so a later 'serve' start is compile-free "
+                             "with --compile-cache")
     p_warm.add_argument("--telemetry", default=None, metavar="DIR",
                         help="write compile/* spans to DIR/trace.json")
     p_warm.set_defaults(fn=cmd_warmup)
 
-    p_pred = sub.add_parser("predict", help="detect objects in one image")
+    p_pred = sub.add_parser("predict", help="detect objects in images")
     _add_common(p_pred)
-    p_pred.add_argument("--image", required=True)
+    p_pred.add_argument("--image", required=True, nargs="+", metavar="PATH",
+                        help="image path(s); multiple paths route through "
+                             "the serving engine as one micro-batched wave")
     p_pred.add_argument("--workdir", default="checkpoints")
     p_pred.add_argument("--checkpoint-step", type=int, default=None)
     p_pred.add_argument("--score-thresh", type=float, default=0.5)
     p_pred.add_argument("--output", default=None,
-                        help="write the image with boxes drawn to this path")
+                        help="write the image with boxes drawn to this path "
+                             "(with multiple inputs: PATH.0.ext, PATH.1.ext, "
+                             "...)")
     p_pred.set_defaults(fn=cmd_predict)
+
+    p_serve = sub.add_parser(
+        "serve",
+        help="bucketed AOT inference serving: pre-compile every "
+             "(resolution x batch) bucket program, keep params resident "
+             "on device, micro-batch concurrent HTTP requests "
+             "(POST /predict)",
+    )
+    _add_common(p_serve)
+    p_serve.add_argument("--workdir", default="checkpoints")
+    p_serve.add_argument("--checkpoint-step", type=int, default=None)
+    p_serve.add_argument("--host", default="127.0.0.1")
+    p_serve.add_argument("--port", type=int, default=8008,
+                         help="TCP port (0 = pick a free one)")
+    p_serve.add_argument("--score-thresh", type=float, default=0.5)
+    p_serve.add_argument("--max-delay-ms", type=float, default=None,
+                         help="micro-batch deadline: max ms a request "
+                              "waits for batch-mates before a partial "
+                              "flush (serving.max_delay_ms)")
+    p_serve.add_argument("--bucket-batch-sizes", default=None, metavar="N,M",
+                         help="compiled batch sizes per bucket, e.g. '1,8' "
+                              "(serving.batch_sizes)")
+    p_serve.add_argument("--resolutions", default=None, metavar="HxW,HxW",
+                         help="bucket resolutions, e.g. '300x300,600x600' "
+                              "(default: image_size and its half)")
+    p_serve.add_argument("--params-dtype", default=None,
+                         choices=[None, "float32", "bfloat16"],
+                         help="resident inference param dtype "
+                              "(serving.params_dtype; bf16 halves HBM)")
+    p_serve.set_defaults(fn=cmd_serve)
 
     p_viz = sub.add_parser("viz", help="visual sanity artifacts "
                                        "(anchor centers / gt overlay)")

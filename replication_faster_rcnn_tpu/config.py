@@ -513,6 +513,91 @@ class AnalysisConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Bucketed AOT inference serving (serving/engine.py).
+
+    The engine compiles one inference program per (resolution bucket ×
+    batch size) at startup, holds the inference params device-resident in
+    ``params_dtype``, and coalesces concurrent requests into bucket-sized
+    micro-batches (flush on size OR ``max_delay_ms``). Requests larger
+    than every bucket follow ``oversize``: "downscale" routes them to the
+    largest bucket (the one-shot ``predict_image`` behavior), "reject"
+    raises so a front-end can shed them instead of silently degrading.
+    """
+
+    # () = derived: the configured train/eval resolution plus its half —
+    # two buckets cover "full-size" and "thumbnail" traffic without any
+    # per-deployment tuning. Explicit tuples override, smallest-area
+    # bucket tried first.
+    resolutions: Tuple[Tuple[int, int], ...] = ()
+    # compiled batch sizes per bucket; a flush picks the smallest
+    # compiled batch >= the number of waiting requests and pads to it
+    batch_sizes: Tuple[int, ...] = (1, 8)
+    # deadline trigger: a waiting request is never delayed longer than
+    # this hoping for batch-mates (0 = flush whenever the queue idles)
+    max_delay_ms: float = 10.0
+    # bounded submission queue depth — backpressure, same discipline as
+    # data/prefetch_device.py (submit blocks/raises rather than queueing
+    # unboundedly while the device falls behind)
+    queue_depth: int = 64
+    # dtype the resident inference params are cast to on upload; bf16
+    # halves HBM residency and the flax modules cast per-layer anyway
+    params_dtype: str = "bfloat16"  # float32 | bfloat16
+    oversize: str = "downscale"  # downscale | reject
+
+    def __post_init__(self):
+        object.__setattr__(
+            self,
+            "resolutions",
+            tuple(
+                (int(r[0]), int(r[1])) for r in self.resolutions
+            ),
+        )
+        object.__setattr__(
+            self, "batch_sizes", tuple(int(b) for b in self.batch_sizes)
+        )
+        for h, w in self.resolutions:
+            if h < 1 or w < 1:
+                raise ValueError(
+                    f"serving.resolutions entries must be positive, got {(h, w)}"
+                )
+        if not self.batch_sizes or any(b < 1 for b in self.batch_sizes):
+            raise ValueError(
+                "serving.batch_sizes must be a non-empty tuple of ints >= 1, "
+                f"got {self.batch_sizes!r}"
+            )
+        if self.max_delay_ms < 0:
+            raise ValueError(
+                f"serving.max_delay_ms must be >= 0, got {self.max_delay_ms}"
+            )
+        if self.queue_depth < 1:
+            raise ValueError(
+                f"serving.queue_depth must be >= 1, got {self.queue_depth}"
+            )
+        if self.params_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                "serving.params_dtype must be float32|bfloat16, got "
+                f"{self.params_dtype!r}"
+            )
+        if self.oversize not in ("downscale", "reject"):
+            raise ValueError(
+                "serving.oversize must be 'downscale' or 'reject', got "
+                f"{self.oversize!r}"
+            )
+
+    def bucket_resolutions(
+        self, image_size: Tuple[int, int]
+    ) -> Tuple[Tuple[int, int], ...]:
+        """The resolved bucket list, smallest area first."""
+        if self.resolutions:
+            res = set(self.resolutions)
+        else:
+            h, w = image_size
+            res = {(max(1, h // 2), max(1, w // 2)), (h, w)}
+        return tuple(sorted(res, key=lambda r: (r[0] * r[1], r)))
+
+
+@dataclasses.dataclass(frozen=True)
 class FasterRCNNConfig:
     anchors: AnchorConfig = dataclasses.field(default_factory=AnchorConfig)
     proposals: ProposalConfig = dataclasses.field(default_factory=ProposalConfig)
@@ -526,6 +611,7 @@ class FasterRCNNConfig:
     compile: CompileConfig = dataclasses.field(default_factory=CompileConfig)
     debug: DebugConfig = dataclasses.field(default_factory=DebugConfig)
     analysis: AnalysisConfig = dataclasses.field(default_factory=AnalysisConfig)
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
 
     def feature_size(self, image_size: Optional[Tuple[int, int]] = None) -> Tuple[int, int]:
         """Spatial size of the stride-16 feature map for a given image size.

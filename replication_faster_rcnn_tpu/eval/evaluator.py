@@ -29,6 +29,41 @@ from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
 from replication_faster_rcnn_tpu.telemetry import spans as tspans
 
 
+def make_infer_fn(model: FasterRCNN, config: FasterRCNNConfig, image_size=None):
+    """The inference program: combined forward + fixed-shape decode, as a
+    pure ``(variables, images) -> detections`` function ready for jit.
+
+    ``image_size`` overrides ``config.data.image_size`` — the serving
+    engine compiles this same program once per resolution bucket, so the
+    eval sweep and every serving bucket share one definition (and the
+    eval program's audited fingerprint covers the serving math too)."""
+    h, w = image_size if image_size is not None else config.data.image_size
+
+    def _forward(variables: Any, images):
+        logits, deltas, rois, valid, cls, reg, _ = model.apply(
+            variables, images, train=False
+        )
+        return rois, valid, cls, reg
+
+    def infer(variables: Any, images):
+        plain = _forward(variables, images)
+        if config.eval.tta_hflip:
+            # second pass on the mirrored image; its candidates stay
+            # in the mirrored frame until the decode reflects them
+            mirrored = _forward(variables, images[:, :, ::-1, :])
+            return batched_decode_tta(
+                plain, mirrored, float(h), float(w),
+                config.eval, config.roi_targets,
+            )
+        rois, valid, cls, reg = plain
+        return batched_decode(
+            rois, valid, cls, reg, float(h), float(w),
+            config.eval, config.roi_targets,
+        )
+
+    return infer
+
+
 class Evaluator:
     def __init__(
         self,
@@ -39,30 +74,8 @@ class Evaluator:
         self.config = config
         self.model = model if model is not None else FasterRCNN(config)
         self.devices = devices
-        h, w = config.data.image_size
 
-        def _forward(variables: Any, images):
-            logits, deltas, rois, valid, cls, reg, _ = self.model.apply(
-                variables, images, train=False
-            )
-            return rois, valid, cls, reg
-
-        def infer(variables: Any, images):
-            plain = _forward(variables, images)
-            if config.eval.tta_hflip:
-                # second pass on the mirrored image; its candidates stay
-                # in the mirrored frame until the decode reflects them
-                mirrored = _forward(variables, images[:, :, ::-1, :])
-                return batched_decode_tta(
-                    plain, mirrored, float(h), float(w),
-                    config.eval, config.roi_targets,
-                )
-            rois, valid, cls, reg = plain
-            return batched_decode(
-                rois, valid, cls, reg, float(h), float(w),
-                config.eval, config.roi_targets,
-            )
-
+        infer = make_infer_fn(self.model, config)
         self._jit_infer = jax.jit(infer)
 
         def infer_cached(variables: Any, image_cache, idx):

@@ -1,38 +1,77 @@
-"""Single-image prediction — the user-facing inference path the reference
+"""Image prediction — the user-facing inference path the reference
 planned but never wrote (`test_eval.py` empty, `readme.md:7`).
 
-Loads an image, runs the combined forward + decode at the configured input
-size, maps boxes back to original-image coordinates, and optionally draws
-them (PIL) to an output file.
+Requests route through the serving engine (`serving/engine.py`): the
+engine owns the compiled-program cache (one AOT program per resolution
+bucket × batch size), keeps the inference params device-resident, and
+coalesces multi-image calls into micro-batches. Box de-normalization
+back to original image coordinates happens inside the engine; this
+module just thresholds, attaches class names, and optionally draws.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
-
-import numpy as np
+from typing import Any, Dict, List, Optional, Sequence
 
 from replication_faster_rcnn_tpu.config import FasterRCNNConfig, VOC_CLASSES
-from replication_faster_rcnn_tpu.eval.evaluator import Evaluator
 
-# one-entry Evaluator cache for repeated predict_image calls on the same
-# (config, model): the Evaluator holds the jitted inference function, so a
-# fresh instance per call re-traced and re-compiled the whole forward pass
-# for every image — image 2..N each paid image 1's compile
-_cached_evaluator: Optional[Evaluator] = None
-_cached_key = None
+# re-export: the one-entry Evaluator cache moved into the serving engine
+# (which owns every "keep the compiled inference program warm" concern),
+# but callers historically import it from here
+from replication_faster_rcnn_tpu.serving.engine import (  # noqa: F401
+    get_engine,
+    get_evaluator,
+)
 
 
-def get_evaluator(config: FasterRCNNConfig, model) -> Evaluator:
-    """The cached Evaluator for (config, model), built on first use.
-    Config is a frozen dataclass (value-hashable); the model is keyed by
-    identity — a new model instance gets a fresh Evaluator."""
-    global _cached_evaluator, _cached_key
-    key = (config, id(model))
-    if _cached_evaluator is None or _cached_key != key:
-        _cached_evaluator = Evaluator(config, model)
-        _cached_key = key
-    return _cached_evaluator
+def _class_names(config: FasterRCNNConfig) -> List[str]:
+    return list(
+        VOC_CLASSES
+        if config.model.num_classes == len(VOC_CLASSES)
+        else [str(i) for i in range(config.model.num_classes)]
+    )
+
+
+def _to_detections(out: Dict[str, Any], thresh: float, names) -> List[Dict]:
+    """Engine result (boxes already in original-image coords) ->
+    thresholded, score-sorted list of detection dicts."""
+    results = []
+    for i in range(out["valid"].shape[0]):
+        if not out["valid"][i] or out["scores"][i] < thresh:
+            continue
+        cls = int(out["classes"][i])
+        results.append(
+            {
+                "box": out["boxes"][i].tolist(),
+                "score": float(out["scores"][i]),
+                "class_id": cls,
+                "class_name": names[cls],
+            }
+        )
+    results.sort(key=lambda d: -d["score"])
+    return results
+
+
+def predict_images(
+    config: FasterRCNNConfig,
+    model,
+    variables: Any,
+    image_paths: Sequence[str],
+    score_thresh: Optional[float] = None,
+    engine=None,
+) -> List[List[Dict[str, Any]]]:
+    """Run detection on many images as one micro-batched engine pass.
+
+    All paths are submitted before any result is awaited, so same-bucket
+    images coalesce into shared dispatches instead of paying per-image
+    dispatch cost. Returns one detection list per input path, each a list
+    of {'box' [4] in original image coords (row-major), 'score',
+    'class_id', 'class_name'} sorted by score."""
+    eng = engine if engine is not None else get_engine(config, model, variables)
+    futures = [eng.submit_path(p) for p in image_paths]
+    thresh = config.eval.score_thresh if score_thresh is None else score_thresh
+    names = _class_names(config)
+    return [_to_detections(f.result(), thresh, names) for f in futures]
 
 
 def predict_image(
@@ -41,44 +80,16 @@ def predict_image(
     variables: Any,
     image_path: str,
     score_thresh: Optional[float] = None,
-    evaluator: Optional[Evaluator] = None,
+    engine=None,
 ) -> List[Dict[str, Any]]:
-    """-> list of {'box' [4] in original image coords (row-major),
-    'score', 'class_id', 'class_name'} sorted by score.
+    """Single-image convenience wrapper over :func:`predict_images`.
 
-    ``evaluator`` reuses a caller-owned Evaluator (its jitted inference
-    fn stays warm); otherwise the module-level cache supplies one."""
-    from replication_faster_rcnn_tpu.data.voc import _load_image
-
-    h, w = config.data.image_size
-    image, orig_h, orig_w = _load_image(
-        image_path, (h, w), config.data.pixel_mean, config.data.pixel_std
-    )
-    ev = evaluator if evaluator is not None else get_evaluator(config, model)
-    out = ev.predict_batch(variables, image[None])
-    thresh = config.eval.score_thresh if score_thresh is None else score_thresh
-
-    names = (
-        VOC_CLASSES
-        if config.model.num_classes == len(VOC_CLASSES)
-        else [str(i) for i in range(config.model.num_classes)]
-    )
-    back = np.asarray([orig_h / h, orig_w / w, orig_h / h, orig_w / w])
-    results = []
-    for i in range(out["valid"].shape[1]):
-        if not out["valid"][0, i] or out["scores"][0, i] < thresh:
-            continue
-        cls = int(out["classes"][0, i])
-        results.append(
-            {
-                "box": (out["boxes"][0, i] * back).tolist(),
-                "score": float(out["scores"][0, i]),
-                "class_id": cls,
-                "class_name": names[cls],
-            }
-        )
-    results.sort(key=lambda d: -d["score"])
-    return results
+    ``engine`` reuses a caller-owned InferenceEngine (its AOT-compiled
+    programs stay warm); otherwise the module-level cache supplies one.
+    """
+    return predict_images(
+        config, model, variables, [image_path], score_thresh, engine
+    )[0]
 
 
 def draw_detections(image_path: str, detections, out_path: str) -> None:

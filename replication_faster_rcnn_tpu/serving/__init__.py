@@ -1,0 +1,26 @@
+"""Bucketed AOT inference serving.
+
+`engine.InferenceEngine` compiles one inference program per
+(resolution bucket × batch size) ahead of time, keeps the inference
+params device-resident, and coalesces concurrent requests into
+bucket-sized micro-batches through `batcher.MicroBatcher` — the
+Fast R-CNN amortization argument applied to the serving tier: one
+dispatch's fixed cost (Python dispatch, program launch, transfers)
+shared across every request in the flush.
+"""
+
+from replication_faster_rcnn_tpu.serving.batcher import MicroBatcher
+from replication_faster_rcnn_tpu.serving.engine import (
+    InferenceEngine,
+    OversizedImageError,
+    get_engine,
+    select_bucket,
+)
+
+__all__ = [
+    "InferenceEngine",
+    "MicroBatcher",
+    "OversizedImageError",
+    "get_engine",
+    "select_bucket",
+]

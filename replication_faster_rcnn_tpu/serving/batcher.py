@@ -1,0 +1,179 @@
+"""Continuous micro-batching queue — bounded producer/consumer with
+size- and deadline-triggered flushes.
+
+Same discipline as `data/prefetch_device.py::DevicePrefetcher`: a
+bounded ``queue.Queue`` between submitters and one worker thread (so the
+queue itself is the backpressure — a full queue makes ``submit`` block
+or raise instead of buffering unboundedly), a sentinel-driven clean
+shutdown that drains everything already accepted, and error
+transparency (a failing ``process`` call fails exactly the requests in
+that flush, through their futures, and the worker keeps serving).
+
+The worker groups waiting requests by ``key`` (the engine keys by
+resolution bucket) and flushes a group when it reaches ``max_batch(key)``
+requests OR when its oldest request has waited ``max_delay_s`` — the
+classic continuous-batching tradeoff knob between per-request latency
+and per-dispatch amortization. ``max_delay_s=0`` degrades to greedy
+batching: flush whatever has accumulated the moment the queue idles.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["MicroBatcher"]
+
+_CLOSE = object()  # shutdown sentinel; queue order guarantees drain
+
+
+class MicroBatcher:
+    """Coalesce ``submit`` calls into batched ``process`` calls.
+
+    ``process(key, items) -> results`` runs on the worker thread with
+    ``len(results) == len(items)``; result ``i`` resolves the future of
+    item ``i``. ``max_batch`` is an int or a ``key -> int`` callable.
+    """
+
+    def __init__(
+        self,
+        process: Callable[[Any, List[Any]], List[Any]],
+        max_batch,
+        max_delay_s: float = 0.01,
+        depth: int = 64,
+        name: str = "micro-batcher",
+    ) -> None:
+        if not callable(max_batch):
+            if max_batch < 1:
+                raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+            _n = int(max_batch)
+            max_batch = lambda key: _n  # noqa: E731
+        if max_delay_s < 0:
+            raise ValueError(f"max_delay_s must be >= 0, got {max_delay_s}")
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._process = process
+        self._max_batch = max_batch
+        self._max_delay_s = float(max_delay_s)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._closed = False
+        self._flushes: List[Tuple[Any, int]] = []  # (key, size) history
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=name
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- producer
+
+    def submit(
+        self, key: Any, item: Any, timeout: Optional[float] = None
+    ) -> Future:
+        """Enqueue one request; returns its Future.
+
+        Blocks while the queue is at depth (bounded-queue backpressure);
+        with ``timeout`` raises ``queue.Full`` instead of waiting
+        forever. Raises ``RuntimeError`` once closed.
+        """
+        if self._closed:
+            raise RuntimeError("MicroBatcher is closed")
+        fut: Future = Future()
+        self._queue.put((key, item, fut, time.monotonic()), timeout=timeout)
+        return fut
+
+    def close(self, join_timeout: float = 60.0) -> None:
+        """Drain-and-stop: everything accepted before close is processed
+        (partial groups flush), then the worker exits. Idempotent."""
+        if self._closed:
+            self._thread.join(timeout=join_timeout)
+            return
+        self._closed = True
+        # the sentinel rides the same queue, so FIFO order guarantees the
+        # worker sees every accepted request first; put() may need to wait
+        # for the worker to free a slot, in a loop that notices worker death
+        while True:
+            try:
+                self._queue.put(_CLOSE, timeout=0.1)
+                break
+            except queue.Full:
+                if not self._thread.is_alive():  # pragma: no cover - crashed
+                    break
+        self._thread.join(timeout=join_timeout)
+        # requests that raced past the closed flag after the sentinel: fail
+        # them explicitly rather than leaving their futures pending forever
+        while True:
+            try:
+                entry = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if entry is not _CLOSE:
+                entry[2].set_exception(
+                    RuntimeError("MicroBatcher closed before processing")
+                )
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def flush_log(self) -> List[Tuple[Any, int]]:
+        """(key, n_requests) per flush, oldest first (introspection/tests)."""
+        return list(self._flushes)
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    # --------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        # key -> list of (item, future, t_submitted); dict preserves
+        # insertion order so deadline scans see oldest groups first
+        pending: Dict[Any, List[Tuple[Any, Future, float]]] = {}
+        while True:
+            timeout = None
+            if pending:
+                oldest = min(group[0][2] for group in pending.values())
+                timeout = max(0.0, oldest + self._max_delay_s - time.monotonic())
+            try:
+                entry = self._queue.get(timeout=timeout)
+            except queue.Empty:
+                entry = None  # a group's deadline expired
+            if entry is _CLOSE:
+                for key in list(pending):
+                    self._flush(key, pending)
+                return
+            if entry is not None:
+                key, item, fut, t0 = entry
+                group = pending.setdefault(key, [])
+                group.append((item, fut, t0))
+                if len(group) >= self._max_batch(key):
+                    self._flush(key, pending)
+                continue
+            now = time.monotonic()
+            for key in list(pending):
+                group = pending[key]
+                if group and now >= group[0][2] + self._max_delay_s:
+                    self._flush(key, pending)
+
+    def _flush(
+        self, key: Any, pending: Dict[Any, List[Tuple[Any, Future, float]]]
+    ) -> None:
+        group = pending.pop(key)
+        self._flushes.append((key, len(group)))
+        try:
+            results = self._process(key, [item for item, _, _ in group])
+            if len(results) != len(group):
+                raise RuntimeError(
+                    f"process returned {len(results)} results for "
+                    f"{len(group)} items (key={key!r})"
+                )
+        except BaseException as e:  # noqa: BLE001 - relayed through futures
+            for _, fut, _ in group:
+                fut.set_exception(e)
+            return
+        for (_, fut, _), res in zip(group, results):
+            fut.set_result(res)

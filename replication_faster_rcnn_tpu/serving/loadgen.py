@@ -1,0 +1,120 @@
+"""In-process load generator for the serving engine.
+
+Two canonical load shapes (the serving-benchmark literature's pair):
+
+* **closed loop** — submit every request as fast as the engine's bounded
+  queue accepts them; measures capacity (max throughput) and the latency
+  distribution under saturation. With a deadline-triggered micro-batcher
+  this is the regime where flushes run at full bucket batch size.
+* **open loop** — submit at a fixed offered rate regardless of
+  completions (sleep-paced); measures the latency a user sees at a given
+  traffic level, including queueing. Offered > capacity shows up as
+  latency blowing past ``max_delay_ms`` — the signature of an overloaded
+  tier, which a closed loop structurally cannot show.
+
+Latency is measured per request from submit to future resolution
+(``Future.add_done_callback`` stamps completion on the worker thread),
+so it includes queue wait + batching delay + dispatch + de-normalization
+— the full engine-side request path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["percentile_ms", "run_closed_loop", "run_open_loop"]
+
+
+def percentile_ms(latencies_s: Sequence[float], q: float) -> float:
+    """q-th percentile (0..100) of a latency list, in milliseconds."""
+    if not latencies_s:
+        return 0.0
+    return float(np.percentile(np.asarray(latencies_s, np.float64), q) * 1e3)
+
+
+def _summarize(
+    latencies_s: List[float], wall_s: float, n: int, **extra: Any
+) -> Dict[str, Any]:
+    return {
+        "n_requests": n,
+        "wall_s": round(wall_s, 4),
+        "images_per_sec": round(n / wall_s, 3) if wall_s > 0 else 0.0,
+        "p50_ms": round(percentile_ms(latencies_s, 50), 3),
+        "p99_ms": round(percentile_ms(latencies_s, 99), 3),
+        "mean_ms": round(float(np.mean(latencies_s)) * 1e3, 3)
+        if latencies_s
+        else 0.0,
+        **extra,
+    }
+
+
+def _submit_timed(engine, image, latencies: List[float], lock: threading.Lock):
+    t0 = time.monotonic()
+
+    def _done(_fut) -> None:
+        dt = time.monotonic() - t0
+        with lock:
+            latencies.append(dt)
+
+    fut = engine.submit(image)
+    fut.add_done_callback(_done)
+    return fut
+
+
+def run_closed_loop(
+    engine, images: Sequence[np.ndarray], n_requests: int
+) -> Dict[str, Any]:
+    """Saturation: fire ``n_requests`` submits back-to-back (the bounded
+    queue throttles the producer) and wait for all results."""
+    latencies: List[float] = []
+    lock = threading.Lock()
+    t0 = time.monotonic()
+    futures = [
+        _submit_timed(engine, images[i % len(images)], latencies, lock)
+        for i in range(n_requests)
+    ]
+    for f in futures:
+        f.result()
+    wall = time.monotonic() - t0
+    return _summarize(latencies, wall, n_requests, mode="closed")
+
+
+def run_open_loop(
+    engine,
+    images: Sequence[np.ndarray],
+    offered_rate: float,
+    n_requests: Optional[int] = None,
+    duration_s: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Fixed offered load: one submit every ``1/offered_rate`` seconds
+    (absolute schedule, so a slow submit doesn't silently lower the
+    offered rate), for ``n_requests`` or ``duration_s``."""
+    if offered_rate <= 0:
+        raise ValueError(f"offered_rate must be > 0, got {offered_rate}")
+    if n_requests is None:
+        if duration_s is None:
+            raise ValueError("need n_requests or duration_s")
+        n_requests = max(1, int(offered_rate * duration_s))
+    latencies: List[float] = []
+    lock = threading.Lock()
+    interval = 1.0 / offered_rate
+    t0 = time.monotonic()
+    futures = []
+    for i in range(n_requests):
+        target = t0 + i * interval
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        futures.append(
+            _submit_timed(engine, images[i % len(images)], latencies, lock)
+        )
+    for f in futures:
+        f.result()
+    wall = time.monotonic() - t0
+    return _summarize(
+        latencies, wall, n_requests, mode="open", offered_rate=offered_rate
+    )

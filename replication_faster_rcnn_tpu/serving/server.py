@@ -1,0 +1,132 @@
+"""Minimal stdlib HTTP front-end over the inference engine.
+
+Each request-handler thread submits its images to the engine and blocks
+on the futures — so concurrent clients' requests coalesce into shared
+micro-batches inside the engine (ThreadingHTTPServer gives one thread
+per connection; the engine's bounded queue is the backpressure).
+
+Endpoints:
+  POST /predict  {"paths": ["a.jpg", ...]} or {"path": "a.jpg"}, optional
+                 "score_thresh" — detections per image (boxes in original
+                 image coordinates, row-major [r1, c1, r2, c2])
+  GET  /healthz  liveness + bucket inventory
+  GET  /stats    request/flush/padding counters + queue depth
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from replication_faster_rcnn_tpu.config import VOC_CLASSES
+
+__all__ = ["make_server"]
+
+
+def _detections_json(config, out, thresh: float):
+    names = (
+        VOC_CLASSES
+        if config.model.num_classes == len(VOC_CLASSES)
+        else [str(i) for i in range(config.model.num_classes)]
+    )
+    dets = []
+    for i in range(out["valid"].shape[0]):
+        if not out["valid"][i] or out["scores"][i] < thresh:
+            continue
+        cls = int(out["classes"][i])
+        dets.append(
+            {
+                "box": out["boxes"][i].tolist(),
+                "score": float(out["scores"][i]),
+                "class_id": cls,
+                "class_name": names[cls],
+            }
+        )
+    dets.sort(key=lambda d: -d["score"])
+    return dets
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the engine/config/default threshold hang off the server instance
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload, indent=2).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *fmt_args):  # quiet: one line per request
+        pass  # noqa: D401 - stdlib signature
+
+    def do_GET(self) -> None:  # noqa: N802 - stdlib casing
+        engine = self.server.engine
+        if self.path == "/healthz":
+            self._reply(
+                200,
+                {
+                    "ok": True,
+                    "buckets": [list(b) for b in engine.buckets],
+                    "batch_sizes": list(engine.batch_sizes),
+                },
+            )
+        elif self.path == "/stats":
+            self._reply(
+                200,
+                {
+                    "stats": dict(engine.stats),
+                    "queue_depth": engine._batcher.queue_depth(),
+                    "compile_seconds": dict(engine.compile_seconds),
+                },
+            )
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 - stdlib casing
+        if self.path != "/predict":
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        engine = self.server.engine
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            paths = req.get("paths") or ([req["path"]] if "path" in req else [])
+            if not paths:
+                raise ValueError('need "path" or non-empty "paths"')
+            thresh = float(req.get("score_thresh", self.server.score_thresh))
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        try:
+            # submit everything first: same-bucket paths coalesce into
+            # shared flushes (also across concurrent handler threads)
+            futures = [engine.submit_path(p) for p in paths]
+            results = {
+                p: _detections_json(engine.config, f.result(), thresh)
+                for p, f in zip(paths, futures)
+            }
+        except FileNotFoundError as e:
+            self._reply(400, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - surfaced to the client
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._reply(200, {"detections": results})
+
+
+def make_server(
+    engine,
+    host: str = "127.0.0.1",
+    port: int = 8008,
+    score_thresh: Optional[float] = None,
+) -> ThreadingHTTPServer:
+    """A ready-to-``serve_forever`` HTTP server bound to ``engine``.
+    ``port=0`` binds a free port (read ``server.server_address``)."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    server.engine = engine
+    server.score_thresh = (
+        engine.config.eval.score_thresh if score_thresh is None else score_thresh
+    )
+    return server

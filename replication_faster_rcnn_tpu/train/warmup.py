@@ -117,6 +117,78 @@ def program_name(feed: str, k: int) -> str:
     return "eval_infer" if feed == "eval" else f"train_{feed}_k{k}"
 
 
+def serve_program_name(h: int, w: int, batch: int) -> str:
+    """Canonical name of one serving bucket program."""
+    return f"serve_{h}x{w}_b{batch}"
+
+
+def serving_program_names(config: FasterRCNNConfig) -> Tuple[str, ...]:
+    """Every serving bucket program the config's engine would compile."""
+    return tuple(
+        serve_program_name(h, w, n)
+        for h, w in config.serving.bucket_resolutions(config.data.image_size)
+        for n in sorted(set(config.serving.batch_sizes))
+    )
+
+
+def build_serving_specs(
+    config: FasterRCNNConfig, model=None
+) -> Dict[str, ProgramSpec]:
+    """{program_name: ProgramSpec} for the serving engine's bucket matrix
+    (``serving.resolutions × serving.batch_sizes``).
+
+    Each bucket program is the SAME inference function the eval sweep
+    jits (`eval/evaluator.py::make_infer_fn`, re-closed over the bucket
+    resolution) against abstract inputs with every float variable leaf in
+    ``serving.params_dtype`` — the dtype the engine holds its resident
+    params in. Routing serving through this registry is what lets the
+    persistent compile cache pre-warm `frcnn serve` and `frcnn audit`
+    enforce HX001-HX006 on the serving programs.
+    """
+    from replication_faster_rcnn_tpu.eval.evaluator import make_infer_fn
+    from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
+
+    if model is None:
+        model = FasterRCNN(config)
+    dtype = np.dtype(jax.numpy.dtype(config.serving.params_dtype))
+    h0, w0 = config.data.image_size
+    variables_abs = jax.eval_shape(
+        lambda rng, img: model.init({"params": rng}, img, train=False),
+        jax.ShapeDtypeStruct((2,), np.uint32),
+        jax.ShapeDtypeStruct((1, h0, w0, 3), np.float32),
+    )
+    variables_abs = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(
+            x.shape, dtype if np.issubdtype(x.dtype, np.floating) else x.dtype
+        ),
+        variables_abs,
+    )
+
+    specs: Dict[str, ProgramSpec] = {}
+    for h, w in config.serving.bucket_resolutions(config.data.image_size):
+        for n in sorted(set(config.serving.batch_sizes)):
+            name = serve_program_name(h, w, n)
+
+            def _build(hh=h, ww=w, nn=n):
+                jitted = jax.jit(make_infer_fn(model, config, (hh, ww)))
+                images_abs = jax.ShapeDtypeStruct((nn, hh, ww, 3), np.float32)
+                return jitted, (variables_abs, images_abs)
+
+            specs[name] = ProgramSpec(
+                name=name,
+                feed="serve",
+                k=0,
+                arg_roles=("variables", "images"),
+                build=_build,
+                meta={
+                    "bucket": [h, w],
+                    "batch": n,
+                    "params_dtype": config.serving.params_dtype,
+                },
+            )
+    return specs
+
+
 def build_program_specs(
     config: FasterRCNNConfig,
     feeds: Sequence[str] = ("loader",),
@@ -318,6 +390,7 @@ def warmup_compile(
     config: FasterRCNNConfig,
     include_eval: bool = True,
     cache_n: Optional[int] = None,
+    include_serving: bool = False,
 ) -> Dict[str, float]:
     """AOT-compile the programs a training run of ``config`` would jit.
 
@@ -346,6 +419,11 @@ def warmup_compile(
     specs = build_program_specs(
         config, feeds=(feed,), ks=ks, include_eval=include_eval, cache_n=cache_n
     )
+    if include_serving:
+        # pre-warm the serving engine's bucket matrix too, so a `frcnn
+        # serve` start against the same persistent cache deserializes
+        # instead of compiling
+        specs = {**specs, **build_serving_specs(config)}
     # legacy names: the CLI's warmup report (and its consumers) predate
     # the registry's canonical feed-qualified names
     legacy = {program_name(feed, 1): "train_step"}

@@ -363,7 +363,7 @@ class TestAuditGate:
         assert bank["platform"] == jax.default_backend()
         assert bank["n_devices"] == len(jax.devices())
         assert sorted(bank["programs"]) == sorted(
-            hlolint.expected_program_names()
+            hlolint.expected_program_names(config=hlolint.audit_config())
         )
 
     def test_audit_gate_clean_against_committed_bank(self, collected):
