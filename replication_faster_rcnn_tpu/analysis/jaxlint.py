@@ -30,6 +30,10 @@ Jit-reachability is computed by walking the call graph from every
 ``jax.jit`` / ``shard_map`` entry point in the package (the known roots
 live in train/train_step.py, parallel/spmd.py, eval/evaluator.py; the
 discovery scans every module so new roots are picked up automatically).
+The call-graph machinery itself — module indexing, name/callee
+resolution, factory-return and alias following, edge building — lives in
+:mod:`analysis.callgraph`, shared with :mod:`analysis.threadlint` (which
+walks the same graph from *thread* entry points instead of jit roots).
 The walker follows factory returns (``jax.jit(make_train_step(...))``),
 tuple-assignment aliasing (``body, spec = per_shard_multi, P(...)``),
 ``self.attr`` bindings (``self.jitted_step = jax.jit(...)``) and
@@ -39,9 +43,11 @@ method name for ``.apply(..., method="name")`` call sites.
 
 Findings resolve against a committed suppression file
 (``analysis/baseline.toml``): every pre-existing violation is either fixed
-or explicitly waived with a reason. ``frcnn check`` runs this standalone
-(``--json`` for machine-readable output, nonzero exit on unsuppressed
-findings) and tests/test_jaxlint.py asserts the package lints clean.
+or explicitly waived with a reason. The baseline file is shared with
+threadlint; each analyzer only matches (and stale-checks) waivers for its
+own rule set. ``frcnn check`` runs this standalone (``--json`` for
+machine-readable output, nonzero exit on unsuppressed findings) and
+tests/test_jaxlint.py asserts the package lints clean.
 
 Known limits (deliberate — this is a reviewer, not a verifier): taint is
 per-function and flow-insensitive across branches; dynamic dispatch other
@@ -55,6 +61,30 @@ import ast
 import dataclasses
 import os
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from replication_faster_rcnn_tpu.analysis.callgraph import (  # noqa: F401
+    _JIT_NAMES,
+    _REMAT_NAMES,
+    _SHARD_MAP_NAMES,
+    _STATIC_ANNOTATION_HEADS,
+    _STATIC_PARAM_NAMES,
+    FunctionInfo,
+    Index,
+    ModuleInfo,
+    _ann_str,
+    _annotation_static,
+    _callable_from_expr,
+    _dotted,
+    _int_tuple,
+    _local_aliases,
+    _resolve_callee,
+    _resolve_dotted_prefix,
+    _resolve_name,
+    _str_tuple,
+    build_edges,
+    parse_modules,
+    reachable_from,
+)
 
 RULES: Dict[str, str] = {
     "JX001": "host-sync hazard: float()/int()/.item()/np.asarray on a jnp value",
@@ -70,26 +100,6 @@ PACKAGE = "replication_faster_rcnn_tpu"
 
 # attribute reads that are static under tracing (no device value involved)
 _SHAPE_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding", "weak_type"}
-# parameters that are static by convention even without an annotation
-# (cfg/config are the repo's frozen host dataclasses)
-_STATIC_PARAM_NAMES = {"self", "cls", "train", "training", "deterministic", "cfg", "config"}
-# annotation heads that mark a parameter host-static
-_STATIC_ANNOTATION_HEADS = {"bool", "int", "str", "float", "Sequence", "Tuple", "tuple", "List", "list", "Dict", "dict"}
-
-
-def _annotation_static(ann: Optional[str]) -> bool:
-    """True when the annotation names a host-side (non-array) type:
-    scalars, host containers, Optional/| None of those, and the repo's
-    frozen ``*Config`` dataclasses."""
-    if ann is None:
-        return False
-    ann = ann.strip()
-    if ann.startswith("Optional[") and ann.endswith("]"):
-        ann = ann[len("Optional["):-1].strip()
-    if ann.endswith("| None"):
-        ann = ann[: -len("| None")].strip()
-    head = ann.split("[", 1)[0].split(".")[-1]
-    return head in _STATIC_ANNOTATION_HEADS or head.endswith("Config")
 # dotted-call prefixes whose results are tracer-typed
 _TRACER_CALL_PREFIXES = (
     "jax.numpy.",
@@ -108,12 +118,6 @@ _PASSTHROUGH_CALLS = {
     "jax.remat",
 }
 _SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
-_JIT_NAMES = {"jax.jit"}
-_SHARD_MAP_NAMES = {
-    "jax.shard_map",
-    "jax.experimental.shard_map.shard_map",
-}
-_REMAT_NAMES = {"flax.linen.remat", "nn.remat", "jax.checkpoint", "jax.remat"}
 # jnp creation calls whose result dtype follows weak-type/x64 promotion
 # unless pinned; value = index of the positional dtype parameter (the
 # package idiom `jnp.zeros((), jnp.int32)` counts as explicit)
@@ -181,6 +185,15 @@ class Baseline:
                 return w
         return None
 
+    def restricted(self, rules: "Set[str] | Dict[str, str]") -> "Baseline":
+        """A view keeping only waivers/excludes for ``rules`` — the shared
+        baseline.toml carries entries for several analyzers; each must
+        stale-check only its own."""
+        return Baseline(
+            waivers=[w for w in self.waivers if w.rule in rules],
+            excludes={r: p for r, p in self.excludes.items() if r in rules},
+        )
+
 
 @dataclasses.dataclass
 class LintResult:
@@ -240,513 +253,18 @@ def load_baseline(path: str) -> Baseline:
     return Baseline(waivers=waivers, excludes=excludes)
 
 
-# --------------------------------------------------------------- module index
-
-
-def _dotted(node: ast.AST) -> Optional[str]:
-    """'a.b.c' for a Name/Attribute chain; 'self.x' for self attributes."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    if isinstance(node, ast.Call):
-        # e.g. tspans.current_tracer().span — dotted of the outer attrs only
-        inner = _dotted(node.func)
-        if inner is not None and parts:
-            return inner + "()." + ".".join(reversed(parts))
-    return None
-
-
-def _ann_str(node: Optional[ast.AST]) -> Optional[str]:
-    if node is None:
-        return None
-    try:
-        return ast.unparse(node)
-    except Exception:  # pragma: no cover - defensive
-        return None
-
-
-class FunctionInfo:
-    def __init__(self, module: "ModuleInfo", qualname: str, node: ast.AST,
-                 parent: Optional["FunctionInfo"], cls: Optional[str]):
-        self.module = module
-        self.qualname = qualname
-        self.node = node
-        self.parent = parent
-        self.cls = cls  # enclosing class name, if a method
-        self.nested: Dict[str, FunctionInfo] = {}
-        self.jit_reachable = False
-        self._returns_tracer: Optional[bool] = None
-        self._return_elts: Optional[List[List[Optional[ast.AST]]]] = None
-        # static params: annotated host types, conventional names, and any
-        # marked by a static_argnums/argnames jit/remat wrapper
-        self.params: List[str] = []
-        self.static_params: Set[str] = set()
-        args = getattr(node, "args", None)
-        if args is not None:
-            allargs = (
-                list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
-            )
-            for a in allargs:
-                self.params.append(a.arg)
-                if a.arg in _STATIC_PARAM_NAMES or _annotation_static(
-                    _ann_str(a.annotation)
-                ):
-                    self.static_params.add(a.arg)
-
-    @property
-    def name(self) -> str:
-        return self.qualname.rsplit(".", 1)[-1]
-
-    def returns(self) -> List[List[Optional[ast.AST]]]:
-        """Per-return list of element exprs ([expr] or tuple elements)."""
-        if self._return_elts is None:
-            elts: List[List[Optional[ast.AST]]] = []
-            body = getattr(self.node, "body", [])
-            for stmt in ast.walk(ast.Module(body=list(body), type_ignores=[])):
-                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-                    continue  # walk() still descends; nested returns filtered below
-            for stmt in _returns_of(self.node):
-                v = stmt.value
-                if isinstance(v, ast.Tuple):
-                    elts.append(list(v.elts))
-                else:
-                    elts.append([v])
-            self._return_elts = elts
-        return self._return_elts
-
-
-def _returns_of(fn_node: ast.AST) -> List[ast.Return]:
-    """Return statements belonging to fn_node itself (not nested defs)."""
-    out: List[ast.Return] = []
-
-    def visit(stmts):
-        for s in stmts:
-            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-                continue
-            if isinstance(s, ast.Return):
-                out.append(s)
-            for attr in ("body", "orelse", "finalbody"):
-                visit(getattr(s, attr, []))
-            for h in getattr(s, "handlers", []):
-                visit(h.body)
-
-    visit(getattr(fn_node, "body", []))
-    return out
-
-
-class ModuleInfo:
-    def __init__(self, path: str, relpath: str, modname: str, tree: ast.Module):
-        self.path = path
-        self.relpath = relpath
-        self.modname = modname  # dotted, e.g. pkg.train.trainer
-        self.tree = tree
-        self.imports: Dict[str, str] = {}  # local name -> dotted target
-        self.functions: Dict[str, FunctionInfo] = {}  # qualname -> info
-        self.toplevel: Dict[str, FunctionInfo] = {}
-        # class name -> attr name -> list of resolution dicts
-        self.class_attrs: Dict[str, Dict[str, List[Dict[str, Any]]]] = {}
-
-
-class Index:
-    """Cross-module symbol index + call graph + jit-reachability."""
-
-    def __init__(self) -> None:
-        self.modules: Dict[str, ModuleInfo] = {}  # modname -> info
-        self.by_dotted: Dict[str, FunctionInfo] = {}  # pkg.mod.qualname -> fn
-        self.methods_by_name: Dict[str, List[FunctionInfo]] = {}
-        self.edges: Dict[FunctionInfo, Set[FunctionInfo]] = {}
-        self.roots: Set[FunctionInfo] = set()
-        # donating callables: identifier -> donated positional indices.
-        # identifiers: "Class.attr" for self-attrs, "mod.qual" for locals
-        self.donating: Dict[str, Tuple[int, ...]] = {}
-        # static-arg callables: dotted fn -> static param names
-        self.static_args: Dict[str, Set[str]] = {}
-        # memo caches (also cycle-breakers for mutually-recursive factories)
-        self._returned_memo: Dict[Any, Tuple[List[FunctionInfo], Optional[Tuple[int, ...]]]] = {}
-        self._aliases_memo: Dict["FunctionInfo", Dict[str, List[Any]]] = {}
-
-
-def _module_name(path: str, package_root: str) -> str:
-    rel = os.path.relpath(path, os.path.dirname(package_root))
-    mod = rel[:-3] if rel.endswith(".py") else rel
-    mod = mod.replace(os.sep, ".")
-    if mod.endswith(".__init__"):
-        mod = mod[: -len(".__init__")]
-    return mod
-
-
-def _collect_imports(mi: ModuleInfo) -> None:
-    pkg_parts = mi.modname.split(".")
-    for node in ast.walk(mi.tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                mi.imports[alias.asname or alias.name.split(".")[0]] = (
-                    alias.name if alias.asname else alias.name.split(".")[0]
-                )
-                if alias.asname:
-                    mi.imports[alias.asname] = alias.name
-        elif isinstance(node, ast.ImportFrom):
-            if node.level:  # relative import
-                base = pkg_parts[: -(node.level)]
-                mod = ".".join(base + ([node.module] if node.module else []))
-            else:
-                mod = node.module or ""
-            for alias in node.names:
-                mi.imports[alias.asname or alias.name] = f"{mod}.{alias.name}"
-    # module-level simple aliases (e.g. `_shard_map = jax.shard_map`)
-    for stmt in mi.tree.body:
-        if isinstance(stmt, (ast.If, ast.Try)):
-            bodies = [stmt.body] + [getattr(stmt, "orelse", [])]
-            for b in bodies:
-                for s in b:
-                    _maybe_module_alias(mi, s)
-        else:
-            _maybe_module_alias(mi, stmt)
-
-
-def _maybe_module_alias(mi: ModuleInfo, stmt: ast.stmt) -> None:
-    if (
-        isinstance(stmt, ast.Assign)
-        and len(stmt.targets) == 1
-        and isinstance(stmt.targets[0], ast.Name)
-    ):
-        d = _dotted(stmt.value)
-        if d is not None:
-            root = d.split(".")[0]
-            resolved = mi.imports.get(root)
-            if resolved is not None:
-                d = resolved + d[len(root):]
-            mi.imports.setdefault(stmt.targets[0].id, d)
-
-
-def _collect_functions(mi: ModuleInfo) -> None:
-    def visit(stmts, prefix: str, parent: Optional[FunctionInfo], cls: Optional[str]):
-        for s in stmts:
-            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                qual = f"{prefix}{s.name}" if prefix else s.name
-                fi = FunctionInfo(mi, qual, s, parent, cls)
-                mi.functions[qual] = fi
-                if parent is None and cls is None:
-                    mi.toplevel[s.name] = fi
-                elif parent is not None:
-                    parent.nested[s.name] = fi
-                visit(s.body, qual + ".", fi, None)
-            elif isinstance(s, ast.ClassDef):
-                visit(s.body, f"{prefix}{s.name}.", None, s.name)
-            elif isinstance(s, (ast.If, ast.Try, ast.With, ast.For, ast.While)):
-                for attr in ("body", "orelse", "finalbody"):
-                    visit(getattr(s, attr, []), prefix, parent, cls)
-                for h in getattr(s, "handlers", []):
-                    visit(h.body, prefix, parent, cls)
-
-    visit(mi.tree.body, "", None, None)
+# ----------------------------------------------------------- index + roots
 
 
 def build_index(paths: Sequence[str], package_root: str) -> Index:
-    idx = Index()
-    repo_root = os.path.dirname(os.path.abspath(package_root))
-    for path in paths:
-        with open(path) as f:
-            src = f.read()
-        tree = ast.parse(src, filename=path)
-        ap = os.path.abspath(path)
-        if ap.startswith(repo_root + os.sep):
-            rel = os.path.relpath(ap, repo_root)
-        else:
-            rel = os.path.basename(ap)
-        mi = ModuleInfo(ap, rel.replace(os.sep, "/"), _module_name(ap, package_root), tree)
-        _collect_imports(mi)
-        _collect_functions(mi)
-        idx.modules[mi.modname] = mi
-        for qual, fi in mi.functions.items():
-            idx.by_dotted[f"{mi.modname}.{qual}"] = fi
-            idx.methods_by_name.setdefault(fi.name, []).append(fi)
-    _resolve_class_attrs(idx)
+    """Parse, discover jit/shard_map roots, build edges, mark
+    jit-reachability. The parsing/resolution half lives in callgraph."""
+    idx = parse_modules(list(paths), package_root)
     _discover(idx)
-    _mark_reachable(idx)
+    build_edges(idx)
+    for f in reachable_from(idx, idx.roots):
+        f.jit_reachable = True
     return idx
-
-
-# ------------------------------------------------------------- resolution
-
-
-def _resolve_dotted_prefix(mi: ModuleInfo, dotted: str) -> str:
-    """Substitute the leading import alias in a dotted chain."""
-    root, _, rest = dotted.partition(".")
-    target = mi.imports.get(root)
-    if target is None:
-        return dotted
-    return f"{target}.{rest}" if rest else target
-
-
-def _resolve_name(
-    idx: Index, fn: Optional[FunctionInfo], mi: ModuleInfo, name: str,
-    aliases: Optional[Dict[str, List[Any]]] = None, _depth: int = 0,
-) -> List[Any]:
-    """Resolve a bare name to FunctionInfo(s) or a dotted external string."""
-    if _depth > 6:
-        return []
-    if aliases and name in aliases:
-        out: List[Any] = []
-        for tgt in aliases[name]:
-            if isinstance(tgt, str):
-                out.extend(
-                    _resolve_name(idx, fn, mi, tgt, aliases=None, _depth=_depth + 1)
-                )
-            else:
-                out.append(tgt)
-        if out:
-            return out
-    scope = fn
-    while scope is not None:
-        if name in scope.nested:
-            return [scope.nested[name]]
-        if scope.cls is None and scope.parent is None and name == scope.name:
-            break
-        scope = scope.parent
-    if name in mi.toplevel:
-        return [mi.toplevel[name]]
-    if name in mi.imports:
-        dotted = mi.imports[name]
-        target = idx.by_dotted.get(dotted)
-        if target is not None:
-            return [target]
-        # maybe a re-export through an __init__: try "<mod>.<name>" tails
-        for modname, m in idx.modules.items():
-            if dotted == f"{modname}.{name}" and name in m.toplevel:
-                return [m.toplevel[name]]
-        # package __init__ re-export: resolve one indirection
-        mod_part = dotted.rsplit(".", 1)[0]
-        m = idx.modules.get(mod_part)
-        if m is not None and name in m.imports:
-            return _resolve_name(idx, None, m, name, _depth=_depth + 1)
-        return [dotted]
-    return []
-
-
-def _resolve_callee(
-    idx: Index, fn: Optional[FunctionInfo], mi: ModuleInfo, node: ast.AST,
-    aliases: Optional[Dict[str, List[Any]]] = None,
-) -> List[Any]:
-    """Resolve a call target expr to FunctionInfo(s) and/or dotted strings."""
-    if isinstance(node, ast.Name):
-        return _resolve_name(idx, fn, mi, node.id, aliases)
-    if isinstance(node, ast.Attribute):
-        d = _dotted(node)
-        if d is None:
-            return []
-        if d.startswith("self.") and fn is not None and fn.cls is not None:
-            entries = mi.class_attrs.get(fn.cls, {}).get(d[len("self."):], [])
-            out = []
-            for e in entries:
-                if e.get("func") is not None:
-                    out.append(e["func"])
-            return out or [d]
-        resolved = _resolve_dotted_prefix(mi, d)
-        target = idx.by_dotted.get(resolved)
-        if target is not None:
-            return [target]
-        # a method path like pkg.mod.Class.method
-        return [resolved]
-    return []
-
-
-def _callable_from_expr(
-    idx: Index, fn: Optional[FunctionInfo], mi: ModuleInfo, expr: ast.AST,
-    aliases: Optional[Dict[str, List[Any]]] = None, _depth: int = 0,
-) -> Tuple[List[FunctionInfo], Optional[Tuple[int, ...]]]:
-    """(functions, donate) for an expr that evaluates to a callable.
-
-    Handles: a bare function reference, ``jax.jit(fn, ...)``,
-    ``shard_map(fn, ...)``, ``partial(jax.jit, ...)`` decorators, a
-    factory call whose return is a nested def, and aliases of any of
-    those. ``donate`` is the donate_argnums tuple if a jit wrapper in the
-    chain donates.
-    """
-    if _depth > 6:
-        return [], None
-    donate: Optional[Tuple[int, ...]] = None
-    if isinstance(expr, (ast.Name, ast.Attribute)):
-        targets = _resolve_callee(idx, fn, mi, expr, aliases)
-        return [t for t in targets if isinstance(t, FunctionInfo)], None
-    if isinstance(expr, ast.Call):
-        callee = _resolve_callee(idx, fn, mi, expr.func, aliases)
-        dotted = [t for t in callee if isinstance(t, str)]
-        fis = [t for t in callee if isinstance(t, FunctionInfo)]
-        if any(d in _JIT_NAMES for d in dotted):
-            for kw in expr.keywords:
-                if kw.arg == "donate_argnums":
-                    donate = _int_tuple(kw.value)
-            if expr.args:
-                inner, inner_donate = _callable_from_expr(
-                    idx, fn, mi, expr.args[0], aliases, _depth + 1
-                )
-                return inner, donate if donate is not None else inner_donate
-            return [], donate
-        if any(d in _SHARD_MAP_NAMES for d in dotted):
-            if expr.args:
-                return _callable_from_expr(
-                    idx, fn, mi, expr.args[0], aliases, _depth + 1
-                )[:1] + (None,) if False else (
-                    _callable_from_expr(idx, fn, mi, expr.args[0], aliases, _depth + 1)[0],
-                    None,
-                )
-            return [], None
-        if any(d.endswith("functools.partial") or d == "partial" for d in dotted):
-            if expr.args:
-                return _callable_from_expr(
-                    idx, fn, mi, expr.args[0], aliases, _depth + 1
-                )
-            return [], None
-        # factory call: follow the factory's returned function(s)
-        out: List[FunctionInfo] = []
-        for factory in fis:
-            rf, rd = _returned_functions(idx, factory, index=None)
-            out.extend(rf)
-            donate = donate if donate is not None else rd
-        return out, donate
-    return [], None
-
-
-def _int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
-    if isinstance(node, ast.Constant) and isinstance(node.value, int):
-        return (node.value,)
-    if isinstance(node, (ast.Tuple, ast.List)):
-        vals = []
-        for e in node.elts:
-            if isinstance(e, ast.Constant) and isinstance(e.value, int):
-                vals.append(e.value)
-        return tuple(vals)
-    return None
-
-
-def _str_tuple(node: ast.AST) -> Tuple[str, ...]:
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return (node.value,)
-    if isinstance(node, (ast.Tuple, ast.List)):
-        return tuple(
-            e.value
-            for e in node.elts
-            if isinstance(e, ast.Constant) and isinstance(e.value, str)
-        )
-    return ()
-
-
-def _returned_functions(
-    idx: Index, factory: FunctionInfo, index: Optional[int]
-) -> Tuple[List[FunctionInfo], Optional[Tuple[int, ...]]]:
-    """Functions a factory returns (element ``index`` of tuple returns,
-    or any element when None); plus donate info from a jit wrapper."""
-    memo_key = (factory, index)
-    if memo_key in idx._returned_memo:
-        return idx._returned_memo[memo_key]
-    # seed with the empty answer to cut cycles (mutually-recursive
-    # factories resolve to nothing rather than recursing forever)
-    idx._returned_memo[memo_key] = ([], None)
-    out: List[FunctionInfo] = []
-    donate: Optional[Tuple[int, ...]] = None
-    aliases = _local_aliases(idx, factory)
-    for elts in factory.returns():
-        chosen = elts if index is None else (
-            [elts[index]] if index < len(elts) else []
-        )
-        for e in chosen:
-            if e is None:
-                continue
-            fis, d = _callable_from_expr(
-                idx, factory, factory.module, e, aliases, _depth=1
-            )
-            out.extend(fis)
-            if d is not None:
-                donate = d
-    idx._returned_memo[memo_key] = (out, donate)
-    return out, donate
-
-
-def _local_aliases(idx: Index, fn: FunctionInfo) -> Dict[str, List[Any]]:
-    """name -> [FunctionInfo|name] for simple aliasing assignments inside
-    ``fn`` (incl. tuple-assign pairs like ``body, spec = f, P(...)``)."""
-    if fn in idx._aliases_memo:
-        return idx._aliases_memo[fn]
-    aliases: Dict[str, List[Any]] = {}
-    idx._aliases_memo[fn] = aliases  # pre-register to cut cycles
-
-    def add(name: str, value: ast.AST) -> None:
-        if isinstance(value, ast.Name):
-            aliases.setdefault(name, []).append(value.id)
-        elif isinstance(value, (ast.Attribute, ast.Call)):
-            fis, _ = _callable_from_expr(idx, fn, fn.module, value, None)
-            for f in fis:
-                aliases.setdefault(name, []).append(f)
-
-    for stmt in ast.walk(fn.node):
-        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
-            tgt, val = stmt.targets[0], stmt.value
-            if isinstance(tgt, ast.Name):
-                add(tgt.id, val)
-            elif (
-                isinstance(tgt, ast.Tuple)
-                and isinstance(val, ast.Tuple)
-                and len(tgt.elts) == len(val.elts)
-            ):
-                for t, v in zip(tgt.elts, val.elts):
-                    if isinstance(t, ast.Name):
-                        add(t.id, v)
-    return aliases
-
-
-def _resolve_class_attrs(idx: Index) -> None:
-    """Fill ModuleInfo.class_attrs: ``self.x = ...`` bindings resolved to
-    functions where possible (jit wrappers recording donate_argnums)."""
-    for mi in idx.modules.values():
-        for qual, fi in mi.functions.items():
-            if fi.cls is None:
-                continue
-            table = mi.class_attrs.setdefault(fi.cls, {})
-            for stmt in ast.walk(fi.node):
-                if not isinstance(stmt, ast.Assign):
-                    continue
-                targets = stmt.targets
-                if len(targets) != 1:
-                    continue
-                tgt = targets[0]
-                if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
-                    fis, donate = _callable_from_expr(idx, fi, mi, stmt.value)
-                    entry: Dict[str, Any] = {
-                        "func": fis[0] if fis else None,
-                        "funcs": fis,
-                        "donate": donate,
-                    }
-                    # value may instead be a tracer-returning call result
-                    table.setdefault(tgt.attr, []).append(entry)
-                    if donate:
-                        idx.donating[f"{fi.cls}.{tgt.attr}"] = donate
-                elif isinstance(tgt, ast.Tuple) and isinstance(stmt.value, ast.Call):
-                    # self.a, self.b = factory(...)
-                    callee = _resolve_callee(idx, fi, mi, stmt.value.func)
-                    factories = [t for t in callee if isinstance(t, FunctionInfo)]
-                    for i, t in enumerate(tgt.elts):
-                        if not (
-                            isinstance(t, ast.Attribute)
-                            and isinstance(t.value, ast.Name)
-                            and t.value.id == "self"
-                        ):
-                            continue
-                        fis: List[FunctionInfo] = []
-                        donate = None
-                        for fac in factories:
-                            rf, rd = _returned_functions(idx, fac, index=i)
-                            fis.extend(rf)
-                            donate = donate if donate is not None else rd
-                        table.setdefault(t.attr, []).append(
-                            {"func": fis[0] if fis else None, "funcs": fis, "donate": donate}
-                        )
-                        if donate:
-                            idx.donating[f"{fi.cls}.{t.attr}"] = donate
 
 
 def _discover(idx: Index) -> None:
@@ -843,49 +361,6 @@ def _record_static_for(idx: Index, fi: FunctionInfo, kw: ast.keyword) -> None:
         for n in nums:
             if 0 <= n < len(fi.params):
                 names.add(fi.params[n])
-
-
-def _mark_reachable(idx: Index) -> None:
-    """BFS the call graph from the jit roots."""
-    # build edges
-    for mi in idx.modules.values():
-        for fi in mi.functions.values():
-            aliases = _local_aliases(idx, fi)
-            edges = idx.edges.setdefault(fi, set())
-            for node in ast.walk(fi.node):
-                if not isinstance(node, ast.Call):
-                    continue
-                for t in _resolve_callee(idx, fi, mi, node.func, aliases):
-                    if isinstance(t, FunctionInfo):
-                        edges.add(t)
-                # function-reference arguments: lax.scan(body, ...),
-                # value_and_grad(loss_fn), tree_map(keep, ...)
-                for arg in list(node.args) + [k.value for k in node.keywords]:
-                    if isinstance(arg, ast.Name):
-                        for t in _resolve_name(idx, fi, mi, arg.id, aliases):
-                            if isinstance(t, FunctionInfo):
-                                edges.add(t)
-                # flax dynamic dispatch: X.apply(..., method="name")
-                fd = _dotted(node.func)
-                if fd is not None and fd.endswith(".apply"):
-                    method = None
-                    for kw in node.keywords:
-                        if kw.arg == "method" and isinstance(kw.value, ast.Constant):
-                            method = kw.value.value
-                    for m in idx.methods_by_name.get(method or "__call__", []):
-                        if m.cls is not None:
-                            edges.add(m)
-            # nested defs are reachable from their parent by construction
-            edges.update(fi.nested.values())
-    seen: Set[FunctionInfo] = set()
-    frontier = list(idx.roots)
-    while frontier:
-        f = frontier.pop()
-        if f in seen:
-            continue
-        seen.add(f)
-        f.jit_reachable = True
-        frontier.extend(idx.edges.get(f, ()))
 
 
 # ----------------------------------------------------------- taint + rules
@@ -1499,7 +974,7 @@ def lint_paths(
             _RuleWalker(idx, fi, raw).walk()
     _static_defaults(idx, raw)
     raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    base = load_baseline(baseline) if baseline else Baseline()
+    base = load_baseline(baseline).restricted(RULES) if baseline else Baseline()
     findings: List[Finding] = []
     suppressed: List[Tuple[Finding, str]] = []
     excluded: List[Finding] = []

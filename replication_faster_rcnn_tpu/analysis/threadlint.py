@@ -1,0 +1,1093 @@
+"""threadlint — AST lint for host-thread concurrency contracts.
+
+The package runs five thread-based host subsystems on the critical path
+(DevicePrefetcher, AsyncCheckpointWriter, MicroBatcher, the HTTP serving
+tier, loader workers + the telemetry watchdog). `frcnn check` (jaxlint),
+`frcnn audit` (hlolint) and ``--strict`` cover jitted code and compiled
+programs but say nothing about host concurrency — an unlocked shared
+attribute or a lock-order inversion is invisible to tier-1 until it
+deadlocks under load. This analyzer walks the call graph from every
+*thread entry point* (the same :mod:`analysis.callgraph` machinery
+jaxlint walks from jit roots) and enforces:
+
+  TL001  instance attribute written from >= 2 thread roots without a
+         common lock held at every write (``with self._lock:`` context
+         tracking; ``__init__`` writes are pre-publication and exempt).
+  TL002  unbounded ``queue.Queue`` shared by a producer/consumer pair,
+         or a blocking ``get``/``put`` without a timeout inside a
+         shutdown-path method (``close``/``stop``/...): a dead peer
+         deadlocks teardown.
+  TL003  a blocking consumer loop (``q.get()`` with no timeout inside a
+         loop) must have a close-sentinel ``put`` on the same queue
+         reachable from a shutdown-path method — otherwise shutdown can
+         leave the consumer blocked forever.
+  TL004  cycle in the static lock-order graph (lock B acquired while
+         holding A in one function, A while holding B elsewhere —
+         including one level of interprocedural acquisition through
+         resolvable calls made under a lock). A plain ``Lock`` re-
+         acquired while already held is a self-cycle.
+  TL005  ``time.sleep`` while holding a lock: the sleeper serializes
+         every other thread contending for that lock.
+  TL006  a daemon thread performing durable file writes (``open`` for
+         write/append, ``os.replace``/``os.rename``, ``shutil.move``):
+         daemon threads are killed mid-write at interpreter exit.
+
+Thread roots: ``threading.Thread(target=...)`` / ``threading.Timer``
+spawns (resolving ``self._method``, nested defs and bare names),
+``Thread``-subclass ``run`` methods, ``BaseHTTPRequestHandler`` subclass
+``do_*`` methods (one thread per connection — concurrent with
+*themselves*, so a single handler root counts as two writers for TL001),
+and callables submitted to a ``ThreadPoolExecutor``. Everything not
+reachable exclusively through a spawn is attributed to the synthetic
+``main`` root; a function reachable both ways gets both attributions
+(e.g. ``StallWatchdog.snapshot`` from the watchdog thread and ``beat``).
+
+Findings resolve against the same ``analysis/baseline.toml`` as jaxlint
+(each analyzer restricts the shared file to its own rule set, so
+waivers never cross-report as stale) and ship through ``frcnn check``
+(``--rules TL001,...`` filters).
+
+Known limits (deliberate — the runtime half is :mod:`analysis.threadsan`):
+callables passed as constructor parameters (``MicroBatcher(process=...)``)
+and attr-of-attr dispatch (``self.watchdog.beat(...)``) are not followed,
+so cross-object thread reachability is under-approximated; lock tracking
+sees ``with`` statements only (bare ``acquire()`` calls are invisible);
+``lambda`` spawn targets are not resolved.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from replication_faster_rcnn_tpu.analysis.callgraph import (
+    FunctionInfo,
+    Index,
+    ModuleInfo,
+    _dotted,
+    _local_aliases,
+    _resolve_dotted_prefix,
+    _resolve_name,
+    build_edges,
+    parse_modules,
+    reachable_from,
+)
+from replication_faster_rcnn_tpu.analysis.jaxlint import (
+    Baseline,
+    Finding,
+    Waiver,
+    default_baseline_path,
+    iter_package_files,
+    load_baseline,
+    package_root,
+)
+
+RULES: Dict[str, str] = {
+    "TL001": "attribute written from >=2 thread roots without a common lock",
+    "TL002": "unbounded shared queue, or blocking queue op without timeout in a shutdown path",
+    "TL003": "blocking consumer loop with no close-sentinel put from a shutdown method",
+    "TL004": "lock-order cycle in the static lock acquisition graph",
+    "TL005": "time.sleep while holding a lock",
+    "TL006": "daemon thread performs durable file writes",
+}
+
+_THREAD_CTORS = {"threading.Thread", "threading.Timer"}
+_LOCK_CTORS = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+}
+_RLOCK_CTORS = {"threading.RLock", "threading.Condition"}
+_QUEUE_CTORS = {
+    "queue.Queue",
+    "queue.LifoQueue",
+    "queue.PriorityQueue",
+    "queue.SimpleQueue",
+}
+# method names whose call on an attribute mutates the underlying object
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "pop", "popitem", "popleft", "remove", "discard", "clear",
+}
+_SHUTDOWN_NAMES = {
+    "close", "stop", "shutdown", "join", "drain", "finish", "terminate",
+    "__exit__", "__del__",
+}
+_INIT_NAMES = {"__init__", "__post_init__", "__new__"}
+# durable-write calls for TL006 (reads are fine; a daemon thread that
+# only consumes data dies harmlessly)
+_WRITE_MODE_CHARS = ("w", "a", "x", "+")
+_RENAME_CALLS = {"os.replace", "os.rename", "shutil.move"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ThreadRoot:
+    label: str  # e.g. "thread:device-prefetch", "http:do_GET"
+    fn: FunctionInfo
+    daemon: bool = False
+    multi: bool = False  # many instances run concurrently (HTTP/pool)
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: List[Tuple[Finding, str]]
+    excluded: List[Finding]
+    stale_waivers: List[Waiver]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rules": RULES,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [
+                {**f.to_dict(), "reason": r} for f, r in self.suppressed
+            ],
+            "excluded_count": len(self.excluded),
+            "stale_waivers": [dataclasses.asdict(w) for w in self.stale_waivers],
+            "ok": not self.findings and not self.stale_waivers,
+        }
+
+
+# ------------------------------------------------------------------ discovery
+
+
+def _dotted_names(
+    idx: Index, fi: Optional[FunctionInfo], mi: ModuleInfo, expr: ast.AST,
+    aliases: Optional[Dict[str, List[Any]]] = None,
+) -> List[str]:
+    """Every dotted spelling an expression's callee may denote (both the
+    raw text and the import-resolved form)."""
+    out: List[str] = []
+    d = _dotted(expr)
+    if d is not None:
+        out.append(d)
+        out.append(_resolve_dotted_prefix(mi, d))
+    if isinstance(expr, ast.Name):
+        for t in _resolve_name(idx, fi, mi, expr.id, aliases):
+            if isinstance(t, str):
+                out.append(t)
+    return out
+
+
+def _owner_prefix(fi: FunctionInfo) -> Optional[str]:
+    """Qualname prefix of the class owning ``fi`` (walks out of nested
+    defs), or None for free functions."""
+    return fi.owner_class()
+
+
+def _resolve_callable_ref(
+    idx: Index, fi: FunctionInfo, mi: ModuleInfo, expr: ast.AST,
+    aliases: Dict[str, List[Any]],
+) -> List[FunctionInfo]:
+    """A function reference used as a spawn target: bare name, nested
+    def, or ``self.method``."""
+    if isinstance(expr, ast.Name):
+        return [
+            t
+            for t in _resolve_name(idx, fi, mi, expr.id, aliases)
+            if isinstance(t, FunctionInfo)
+        ]
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        cls = _owner_prefix(fi)
+        if cls is not None:
+            m = mi.functions.get(f"{cls}.{expr.attr}")
+            if m is not None:
+                return [m]
+    return []
+
+
+def _const_kw(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant):
+            return kw.value.value
+    return None
+
+
+def discover_thread_roots(
+    idx: Index,
+) -> Tuple[List[ThreadRoot], Set[int]]:
+    """All thread entry points, plus the AST node ids of the spawn-target
+    expressions (so edge augmentation does not turn ``target=self._run``
+    into a caller→callee edge: a spawn is not a call)."""
+    roots: List[ThreadRoot] = []
+    spawn_ref_ids: Set[int] = set()
+    for mi in idx.modules.values():
+        # Thread subclasses and HTTP handler classes
+        for cls, bases in mi.class_bases.items():
+            for b in bases:
+                if b == "Thread" or b.endswith(".Thread"):
+                    run = mi.functions.get(f"{cls}.run")
+                    if run is not None:
+                        roots.append(
+                            ThreadRoot(f"thread:{cls}.run", run, daemon=False)
+                        )
+                if b.endswith("BaseHTTPRequestHandler"):
+                    for qual, f in mi.functions.items():
+                        if (
+                            qual.startswith(f"{cls}.do_")
+                            and f.cls == cls
+                        ):
+                            roots.append(
+                                ThreadRoot(
+                                    f"http:{f.name}", f, daemon=True, multi=True
+                                )
+                            )
+        # spawn call sites
+        for fi in mi.functions.values():
+            aliases = _local_aliases(idx, fi)
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _dotted_names(idx, fi, mi, node.func, aliases)
+                if any(d in _THREAD_CTORS for d in dotted):
+                    is_timer = any(d == "threading.Timer" for d in dotted)
+                    target = None
+                    for kw in node.keywords:
+                        if kw.arg in ("target", "function"):
+                            target = kw.value
+                    if target is None and is_timer and len(node.args) >= 2:
+                        target = node.args[1]
+                    elif target is None and not is_timer and node.args:
+                        # Thread(group, target) positional — rare
+                        if len(node.args) >= 2:
+                            target = node.args[1]
+                    if target is None:
+                        continue
+                    spawn_ref_ids.add(id(target))
+                    daemon = bool(_const_kw(node, "daemon"))
+                    tname = _const_kw(node, "name")
+                    for f in _resolve_callable_ref(idx, fi, mi, target, aliases):
+                        label = f"thread:{tname or f.name}"
+                        roots.append(ThreadRoot(label, f, daemon=daemon))
+                # pool.submit(fn, ...) / pool.map(fn, ...): fn runs on pool
+                # threads, concurrently with itself
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("submit", "map")
+                    and node.args
+                ):
+                    recv = _dotted(node.func.value) or ""
+                    low = recv.lower()
+                    if "pool" in low or "executor" in low:
+                        spawn_ref_ids.add(id(node.args[0]))
+                        for f in _resolve_callable_ref(
+                            idx, fi, mi, node.args[0], aliases
+                        ):
+                            roots.append(
+                                ThreadRoot(f"pool:{f.name}", f, multi=True)
+                            )
+    # dedupe (a call site walked from both a method and its nested defs)
+    seen: Set[Tuple[str, FunctionInfo]] = set()
+    out = []
+    for r in roots:
+        key = (r.label, r.fn)
+        if key not in seen:
+            seen.add(key)
+            out.append(r)
+    return out, spawn_ref_ids
+
+
+def _augment_self_method_edges(idx: Index, spawn_ref_ids: Set[int]) -> None:
+    """jaxlint's edge builder does not follow ``self.method`` (jitted code
+    is free functions); thread code is all methods, so add those edges.
+    Any ``self.m`` *reference* counts (``on_skip = self._on_sample_skip``
+    then calling ``on_skip`` later is still a potential call) — except
+    spawn targets, which become roots, not edges."""
+    for mi in idx.modules.values():
+        for fi in mi.functions.values():
+            cls = _owner_prefix(fi)
+            if cls is None:
+                continue
+            edges = idx.edges.setdefault(fi, set())
+            for node in ast.walk(fi.node):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and id(node) not in spawn_ref_ids
+                ):
+                    m = mi.functions.get(f"{cls}.{node.attr}")
+                    if m is not None and m is not fi:
+                        edges.add(m)
+
+
+def build_thread_index(
+    paths: Sequence[str], pkg_root: str
+) -> Tuple[Index, List[ThreadRoot], Dict[FunctionInfo, Set[str]]]:
+    """Index + thread roots + per-function root attribution.
+
+    Attribution: each worker root's BFS closure gets that root's label; a
+    synthetic ``main`` label goes to everything reachable from functions
+    that no worker reaches (the code the controlling thread can run).
+    Worker entries are only ever *spawned*, so they are removed from all
+    call-edge sets first — otherwise the parent→nested-def containment
+    edge would smear ``main`` over every worker body.
+    """
+    idx = parse_modules(list(paths), pkg_root)
+    build_edges(idx)
+    roots, spawn_ref_ids = discover_thread_roots(idx)
+    _augment_self_method_edges(idx, spawn_ref_ids)
+    entry_fns = {r.fn for r in roots}
+    for edges in idx.edges.values():
+        edges -= entry_fns
+    attribution: Dict[FunctionInfo, Set[str]] = {}
+    worker_union: Set[FunctionInfo] = set()
+    for r in roots:
+        for f in reachable_from(idx, {r.fn}):
+            attribution.setdefault(f, set()).add(r.label)
+            worker_union.add(f)
+    main_entries = [
+        f
+        for mi in idx.modules.values()
+        for f in mi.functions.values()
+        if f not in worker_union
+    ]
+    for f in reachable_from(idx, main_entries):
+        attribution.setdefault(f, set()).add("main")
+    return idx, roots, attribution
+
+
+# ----------------------------------------------------------- contract walker
+
+
+@dataclasses.dataclass
+class _WriteSite:
+    fn: FunctionInfo
+    attr: str
+    lockset: frozenset
+    node: ast.AST
+
+
+@dataclasses.dataclass
+class _QueueOp:
+    qkey: Tuple  # queue identity
+    op: str  # put | get | put_nowait | get_nowait
+    blocking: bool  # would wait forever (no timeout, block not False)
+    in_loop: bool
+    fn: FunctionInfo
+    node: ast.AST
+
+
+@dataclasses.dataclass
+class _LockEdge:
+    src: str
+    dst: str
+    fn: FunctionInfo
+    node: ast.AST
+
+
+class _Collector:
+    """One pass over every function: attribute writes with held-lock
+    context, queue registry + ops, lock acquisition order, sleeps under
+    locks, daemon-reachable file writes."""
+
+    def __init__(self, idx: Index, roots: List[ThreadRoot],
+                 attribution: Dict[FunctionInfo, Set[str]]):
+        self.idx = idx
+        self.roots = roots
+        self.attribution = attribution
+        self.writes: Dict[Tuple[str, str, str], List[_WriteSite]] = {}
+        self.queues: Dict[Tuple, bool] = {}  # qkey -> bounded
+        self.queue_ctor: Dict[Tuple, Tuple[FunctionInfo, ast.AST]] = {}
+        self.queue_ops: List[_QueueOp] = []
+        self.class_locks: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self.module_locks: Dict[Tuple[str, str], str] = {}
+        self.rlocks: Set[str] = set()  # lock ids that are re-entrant
+        self.lock_edges: List[_LockEdge] = []
+        self.direct_acquires: Dict[FunctionInfo, Set[str]] = {}
+        # (held lockset, resolved callee, caller, call node)
+        self.calls_under_lock: List[
+            Tuple[frozenset, FunctionInfo, FunctionInfo, ast.AST]
+        ] = []
+        self.sleeps: List[Tuple[FunctionInfo, ast.AST, str]] = []
+        self.file_writes: Dict[FunctionInfo, List[Tuple[ast.AST, str]]] = {}
+        self.findings: List[Finding] = []
+
+    # -------------------------------------------------------------- prepass
+
+    @staticmethod
+    def _name_call_assign(stmt: ast.stmt) -> Optional[Tuple[ast.Name, ast.Call]]:
+        """(Name target, Call value) for ``x = Ctor(...)`` — plain or
+        annotated assignment."""
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and isinstance(stmt.value, ast.Call)
+        ):
+            return stmt.targets[0], stmt.value
+        if (
+            isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)
+            and isinstance(stmt.value, ast.Call)
+        ):
+            return stmt.target, stmt.value
+        return None
+
+    @staticmethod
+    def _self_call_assign(
+        stmt: ast.stmt,
+    ) -> Optional[Tuple[ast.Attribute, ast.Call]]:
+        """(self.X target, Call value) for ``self.x = Ctor(...)``."""
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Attribute)
+            and isinstance(stmt.targets[0].value, ast.Name)
+            and stmt.targets[0].value.id == "self"
+            and isinstance(stmt.value, ast.Call)
+        ):
+            return stmt.targets[0], stmt.value
+        if (
+            isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Attribute)
+            and isinstance(stmt.target.value, ast.Name)
+            and stmt.target.value.id == "self"
+            and isinstance(stmt.value, ast.Call)
+        ):
+            return stmt.target, stmt.value
+        return None
+
+    def prepass(self) -> None:
+        """Register locks and queues (class attrs + module level) before
+        the main walk needs them."""
+        for mi in self.idx.modules.values():
+            for stmt in mi.tree.body:
+                hit = self._name_call_assign(stmt)
+                if hit is None:
+                    continue
+                target, call = hit
+                name = target.id
+                dotted = _dotted_names(self.idx, None, mi, call.func)
+                if any(d in _LOCK_CTORS for d in dotted):
+                    lock_id = f"{mi.modname}.{name}"
+                    self.module_locks[(mi.modname, name)] = lock_id
+                    if any(d in _RLOCK_CTORS for d in dotted):
+                        self.rlocks.add(lock_id)
+                if any(d in _QUEUE_CTORS for d in dotted):
+                    qkey = (mi.modname, name)
+                    self.queues[qkey] = self._bounded(call)
+                    self.queue_ctor.setdefault(qkey, (None, call))
+            for fi in mi.functions.values():
+                cls = _owner_prefix(fi)
+                if cls is None:
+                    continue
+                for node in ast.walk(fi.node):
+                    if not isinstance(node, ast.stmt):
+                        continue
+                    hit = self._self_call_assign(node)
+                    if hit is None:
+                        continue
+                    target, call = hit
+                    attr = target.attr
+                    dotted = _dotted_names(self.idx, fi, mi, call.func)
+                    if any(d in _LOCK_CTORS for d in dotted):
+                        lock_id = f"{mi.modname}.{cls}.{attr}"
+                        self.class_locks.setdefault(
+                            (mi.modname, cls), {}
+                        )[attr] = lock_id
+                        if any(d in _RLOCK_CTORS for d in dotted):
+                            self.rlocks.add(lock_id)
+                    if any(d in _QUEUE_CTORS for d in dotted):
+                        qkey = (mi.modname, cls, attr)
+                        self.queues[qkey] = self._bounded(call)
+                        self.queue_ctor.setdefault(qkey, (fi, call))
+
+    @staticmethod
+    def _bounded(call: ast.Call) -> bool:
+        if call.args:
+            return True  # positional maxsize
+        return any(kw.arg == "maxsize" for kw in call.keywords)
+
+    # ------------------------------------------------------------ main walk
+
+    def collect(self) -> None:
+        self.prepass()
+        for mi in self.idx.modules.values():
+            for fi in mi.functions.values():
+                self._walk_function(fi)
+
+    def _family_root(self, fi: FunctionInfo) -> FunctionInfo:
+        while fi.parent is not None:
+            fi = fi.parent
+        return fi
+
+    def _local_queues(self, fi: FunctionInfo) -> Dict[str, Tuple]:
+        """name -> qkey for queues assigned to local names anywhere in this
+        function's top-level family (closures share the enclosing scope)."""
+        fam = self._family_root(fi)
+        out: Dict[str, Tuple] = {}
+        mi = fi.module
+        for node in ast.walk(fam.node):
+            hit = self._name_call_assign(node) if isinstance(node, ast.stmt) else None
+            if hit is not None:
+                target, call = hit
+                dotted = _dotted_names(self.idx, fam, mi, call.func)
+                if any(d in _QUEUE_CTORS for d in dotted):
+                    qkey = (mi.modname, fam.qualname, target.id)
+                    out[target.id] = qkey
+                    self.queues.setdefault(qkey, self._bounded(call))
+                    self.queue_ctor.setdefault(qkey, (fam, call))
+        return out
+
+    def _local_locks(self, fi: FunctionInfo) -> Dict[str, str]:
+        fam = self._family_root(fi)
+        out: Dict[str, str] = {}
+        mi = fi.module
+        for node in ast.walk(fam.node):
+            hit = self._name_call_assign(node) if isinstance(node, ast.stmt) else None
+            if hit is not None:
+                target, call = hit
+                dotted = _dotted_names(self.idx, fam, mi, call.func)
+                if any(d in _LOCK_CTORS for d in dotted):
+                    lock_id = f"{mi.modname}.{fam.qualname}.{target.id}"
+                    out[target.id] = lock_id
+                    if any(d in _RLOCK_CTORS for d in dotted):
+                        self.rlocks.add(lock_id)
+        return out
+
+    def _walk_function(self, fi: FunctionInfo) -> None:
+        mi = fi.module
+        cls = _owner_prefix(fi)
+        ctx = {
+            "fi": fi,
+            "mi": mi,
+            "cls": cls,
+            "aliases": _local_aliases(self.idx, fi),
+            "locals_q": self._local_queues(fi),
+            "locals_l": self._local_locks(fi),
+        }
+        self.direct_acquires.setdefault(fi, set())
+        self._walk_stmts(getattr(fi.node, "body", []), frozenset(), 0, ctx)
+
+    def _lock_of_expr(self, expr: ast.AST, ctx) -> Optional[str]:
+        """Lock id of a with-item expression, if it names a known lock."""
+        if isinstance(expr, ast.Call):
+            # `with lock.acquire_timeout(...)`-style helpers: not tracked
+            return None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and ctx["cls"] is not None
+        ):
+            table = self.class_locks.get((ctx["mi"].modname, ctx["cls"]), {})
+            return table.get(expr.attr)
+        if isinstance(expr, ast.Name):
+            if expr.id in ctx["locals_l"]:
+                return ctx["locals_l"][expr.id]
+            return self.module_locks.get((ctx["mi"].modname, expr.id))
+        return None
+
+    def _queue_of_expr(self, expr: ast.AST, ctx) -> Optional[Tuple]:
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and ctx["cls"] is not None
+        ):
+            qkey = (ctx["mi"].modname, ctx["cls"], expr.attr)
+            return qkey if qkey in self.queues else None
+        if isinstance(expr, ast.Name):
+            if expr.id in ctx["locals_q"]:
+                return ctx["locals_q"][expr.id]
+            qkey = (ctx["mi"].modname, expr.id)
+            return qkey if qkey in self.queues else None
+        return None
+
+    def _walk_stmts(
+        self, stmts, lockset: frozenset, loop_depth: int, ctx
+    ) -> None:
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested defs walked as their own functions
+            if isinstance(s, ast.With):
+                acquired = []
+                for item in s.items:
+                    self._scan_expr(item.context_expr, lockset, loop_depth, ctx)
+                    lock = self._lock_of_expr(item.context_expr, ctx)
+                    if lock is not None:
+                        acquired.append((lock, item.context_expr))
+                for lock, node in acquired:
+                    self.direct_acquires[ctx["fi"]].add(lock)
+                    for held in lockset:
+                        self.lock_edges.append(
+                            _LockEdge(held, lock, ctx["fi"], node)
+                        )
+                    if lock in lockset and lock not in self.rlocks:
+                        # immediate self-deadlock: plain Lock re-acquired
+                        self.lock_edges.append(
+                            _LockEdge(lock, lock, ctx["fi"], node)
+                        )
+                inner = lockset | {lk for lk, _ in acquired}
+                self._walk_stmts(s.body, frozenset(inner), loop_depth, ctx)
+                continue
+            if isinstance(s, (ast.For, ast.While)):
+                if isinstance(s, ast.While):
+                    self._scan_expr(s.test, lockset, loop_depth, ctx)
+                else:
+                    self._scan_expr(s.iter, lockset, loop_depth, ctx)
+                self._walk_stmts(s.body, lockset, loop_depth + 1, ctx)
+                self._walk_stmts(s.orelse, lockset, loop_depth, ctx)
+                continue
+            if isinstance(s, ast.If):
+                self._scan_expr(s.test, lockset, loop_depth, ctx)
+                self._walk_stmts(s.body, lockset, loop_depth, ctx)
+                self._walk_stmts(s.orelse, lockset, loop_depth, ctx)
+                continue
+            if isinstance(s, ast.Try):
+                self._walk_stmts(s.body, lockset, loop_depth, ctx)
+                for h in s.handlers:
+                    self._walk_stmts(h.body, lockset, loop_depth, ctx)
+                self._walk_stmts(s.orelse, lockset, loop_depth, ctx)
+                self._walk_stmts(s.finalbody, lockset, loop_depth, ctx)
+                continue
+            # leaf statements: record writes, then scan expressions
+            if isinstance(s, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                self._record_writes(s, lockset, ctx)
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self._scan_expr(child, lockset, loop_depth, ctx)
+
+    # ------------------------------------------------------------- recorders
+
+    def _attr_write_targets(self, s: ast.stmt) -> List[Tuple[str, ast.AST]]:
+        """self-attribute names stored to by this statement (direct
+        assigns, tuple elements, subscript/attribute stores through a
+        self attr)."""
+        targets: List[ast.expr] = []
+        if isinstance(s, ast.Assign):
+            targets = list(s.targets)
+        elif isinstance(s, (ast.AugAssign, ast.AnnAssign)):
+            targets = [s.target]
+        out: List[Tuple[str, ast.AST]] = []
+
+        def base_self_attr(node: ast.AST) -> Optional[str]:
+            # innermost self.X of an attribute/subscript chain
+            while isinstance(node, (ast.Attribute, ast.Subscript)):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                ):
+                    return node.attr
+                node = node.value
+            return None
+
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                attr = base_self_attr(e)
+                if attr is not None:
+                    out.append((attr, e))
+        return out
+
+    def _record_writes(self, s: ast.stmt, lockset: frozenset, ctx) -> None:
+        fi, cls = ctx["fi"], ctx["cls"]
+        if cls is None or fi.name in _INIT_NAMES:
+            return
+        for attr, node in self._attr_write_targets(s):
+            key = (ctx["mi"].modname, cls, attr)
+            self.writes.setdefault(key, []).append(
+                _WriteSite(fi, attr, lockset, node)
+            )
+
+    def _scan_expr(
+        self, expr: ast.AST, lockset: frozenset, loop_depth: int, ctx
+    ) -> None:
+        fi, mi = ctx["fi"], ctx["mi"]
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Lambda,)):
+                continue  # executes later, in an unknown lock context
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted_names(self.idx, fi, mi, node.func, ctx["aliases"])
+            # -- TL005: sleeping with a lock held
+            if any(d == "time.sleep" for d in dotted) and lockset:
+                self.sleeps.append((fi, node, ", ".join(sorted(lockset))))
+            # -- mutator calls count as writes (self.xs.append(...))
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS
+                and isinstance(node.func.value, ast.Attribute)
+                and isinstance(node.func.value.value, ast.Name)
+                and node.func.value.value.id == "self"
+                and ctx["cls"] is not None
+                and fi.name not in _INIT_NAMES
+            ):
+                attr = node.func.value.attr
+                key = (mi.modname, ctx["cls"], attr)
+                self.writes.setdefault(key, []).append(
+                    _WriteSite(fi, attr, lockset, node)
+                )
+            # -- queue ops
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("put", "get", "put_nowait", "get_nowait")
+            ):
+                qkey = self._queue_of_expr(node.func.value, ctx)
+                if qkey is not None:
+                    op = node.func.attr
+                    blocking = self._op_blocking(node, op)
+                    self.queue_ops.append(
+                        _QueueOp(qkey, op, blocking, loop_depth > 0, fi, node)
+                    )
+            # -- TL004: resolvable call made while holding locks acquires
+            #    the callee's (transitive) locks — expanded in finish()
+            if lockset:
+                for g in self._resolved_callees(node, ctx):
+                    self.calls_under_lock.append((lockset, g, fi, node))
+            # -- TL006 raw material: durable file writes
+            w = self._file_write_kind(node, dotted)
+            if w is not None:
+                self.file_writes.setdefault(fi, []).append((node, w))
+
+    @staticmethod
+    def _op_blocking(call: ast.Call, op: str) -> bool:
+        if op.endswith("_nowait"):
+            return False
+        # put(item, block=?, timeout=?) / get(block=?, timeout=?)
+        pos_offset = 1 if op == "put" else 0
+        args = call.args
+        if len(args) > pos_offset:  # block positional
+            b = args[pos_offset]
+            if isinstance(b, ast.Constant) and b.value is False:
+                return False
+        if len(args) > pos_offset + 1:  # timeout positional
+            return False
+        for kw in call.keywords:
+            if kw.arg == "block" and isinstance(kw.value, ast.Constant) and kw.value.value is False:
+                return False
+            if kw.arg == "timeout":
+                if isinstance(kw.value, ast.Constant) and kw.value.value is None:
+                    continue  # timeout=None is still forever
+                return False
+        return True
+
+    @staticmethod
+    def _file_write_kind(call: ast.Call, dotted: List[str]) -> Optional[str]:
+        if any(d in _RENAME_CALLS for d in dotted):
+            return next(d for d in dotted if d in _RENAME_CALLS)
+        is_open = (
+            isinstance(call.func, ast.Name) and call.func.id == "open"
+        ) or any(d == "open" for d in dotted)
+        if is_open:
+            mode = None
+            if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+                mode = call.args[1].value
+            for kw in call.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            if isinstance(mode, str) and any(c in mode for c in _WRITE_MODE_CHARS):
+                return f"open(..., {mode!r})"
+        return None
+
+    # --------------------------------------------------------------- verdicts
+
+    def _emit(self, rule: str, fi: FunctionInfo, node: ast.AST, msg: str) -> None:
+        self.findings.append(
+            Finding(
+                rule=rule,
+                path=fi.module.relpath,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                func=fi.qualname,
+                message=msg,
+            )
+        )
+
+    def _root_labels(self, fi: FunctionInfo) -> Set[str]:
+        return self.attribution.get(fi, set())
+
+    def _effective_writers(self, sites: List[_WriteSite]) -> Tuple[Set[str], bool]:
+        """(labels, concurrent): labels writing the attr, and True when a
+        single multi-instance root (HTTP handler, pool task) writes — it
+        races with itself."""
+        multi_labels = {r.label for r in self.roots if r.multi}
+        labels: Set[str] = set()
+        for s in sites:
+            labels |= self._root_labels(s.fn)
+        concurrent = len(labels) >= 2 or bool(labels & multi_labels)
+        return labels, concurrent
+
+    def finish(self) -> List[Finding]:
+        self._tl001()
+        self._tl002()
+        self._tl003()
+        self._tl004()
+        self._tl005()
+        self._tl006()
+        self.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return self.findings
+
+    def _tl001(self) -> None:
+        for (modname, cls, attr), sites in sorted(
+            self.writes.items(), key=lambda kv: str(kv[0])
+        ):
+            labels, concurrent = self._effective_writers(sites)
+            if not concurrent:
+                continue
+            common = None
+            for s in sites:
+                common = s.lockset if common is None else (common & s.lockset)
+            if common:
+                continue  # every write holds a shared lock
+            anchor = min(
+                (s for s in sites if not s.lockset),
+                default=sites[0],
+                key=lambda s: (getattr(s.node, "lineno", 0)),
+            )
+            shown = ", ".join(sorted(labels)) or "one multi-instance root"
+            self._emit(
+                "TL001",
+                anchor.fn,
+                anchor.node,
+                f"`self.{attr}` of {cls} is written from {shown} without a "
+                "common lock — wrap every write (and the paired reads) in "
+                "one `with self._lock:`",
+            )
+
+    def _tl002(self) -> None:
+        # (a) unbounded queue bridging two roots
+        for qkey, bounded in sorted(self.queues.items(), key=str):
+            if bounded:
+                continue
+            ops = [o for o in self.queue_ops if o.qkey == qkey]
+            put_labels: Set[str] = set()
+            get_labels: Set[str] = set()
+            for o in ops:
+                labels = self._root_labels(o.fn)
+                if o.op.startswith("put"):
+                    put_labels |= labels
+                else:
+                    get_labels |= labels
+            if put_labels and get_labels and len(put_labels | get_labels) >= 2:
+                ctor_fn, ctor_node = self.queue_ctor[qkey]
+                fn = ctor_fn or next(o.fn for o in ops)
+                self._emit(
+                    "TL002",
+                    fn,
+                    ctor_node,
+                    f"unbounded queue {qkey[-1]!r} bridges producer "
+                    f"({', '.join(sorted(put_labels))}) and consumer "
+                    f"({', '.join(sorted(get_labels))}) — give it a maxsize "
+                    "so a stalled consumer applies backpressure instead of "
+                    "filling RAM",
+                )
+        # (b) blocking op without timeout in a shutdown path
+        for o in self.queue_ops:
+            if not o.blocking:
+                continue
+            if o.fn.name in _SHUTDOWN_NAMES:
+                self._emit(
+                    "TL002",
+                    o.fn,
+                    o.node,
+                    f"blocking `{o.op}` on {o.qkey[-1]!r} inside shutdown "
+                    f"path `{o.fn.name}` with no timeout — a dead peer "
+                    "thread deadlocks teardown; use a timeout loop that "
+                    "checks thread liveness, or the _nowait variant",
+                )
+
+    def _tl003(self) -> None:
+        shutdown_put_queues: Set[Tuple] = {
+            o.qkey
+            for o in self.queue_ops
+            if o.op.startswith("put") and o.fn.name in _SHUTDOWN_NAMES
+        }
+        seen: Set[Tuple] = set()
+        for o in self.queue_ops:
+            if o.op != "get" or not o.blocking or not o.in_loop:
+                continue
+            if o.qkey in shutdown_put_queues or o.qkey in seen:
+                continue
+            seen.add(o.qkey)
+            self._emit(
+                "TL003",
+                o.fn,
+                o.node,
+                f"blocking consumer loop on {o.qkey[-1]!r} has no close-"
+                "sentinel `put` reachable from a close()/stop()/shutdown() "
+                "method — shutdown can leave this loop blocked forever; "
+                "put a sentinel in close() or give the get a timeout",
+            )
+
+    def _tl004(self) -> None:
+        # interprocedural one-hop: transitive acquires per function
+        trans: Dict[FunctionInfo, Set[str]] = {
+            f: set(a) for f, a in self.direct_acquires.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for f, edges in self.idx.edges.items():
+                cur = trans.setdefault(f, set())
+                for g in edges:
+                    extra = trans.get(g, set()) - cur
+                    if extra:
+                        cur |= extra
+                        changed = True
+        # graph + cycle detection
+        graph: Dict[str, Set[str]] = {}
+        site: Dict[Tuple[str, str], _LockEdge] = {}
+        for e in self.lock_edges:
+            graph.setdefault(e.src, set()).add(e.dst)
+            site.setdefault((e.src, e.dst), e)
+        # augment with call-under-lock edges recorded during the walk
+        for e in self._call_under_lock_edges(trans):
+            graph.setdefault(e.src, set()).add(e.dst)
+            site.setdefault((e.src, e.dst), e)
+        reported: Set[frozenset] = set()
+        for a in sorted(graph):
+            for b in sorted(graph[a]):
+                if a == b:
+                    cyc = frozenset((a,))
+                    if cyc in reported:
+                        continue
+                    reported.add(cyc)
+                    e = site[(a, b)]
+                    self._emit(
+                        "TL004", e.fn, e.node,
+                        f"lock `{a}` re-acquired while already held — a "
+                        "non-reentrant Lock self-deadlocks; use RLock or "
+                        "restructure",
+                    )
+                    continue
+                if self._reaches(graph, b, a):
+                    cyc = frozenset((a, b))
+                    if cyc in reported:
+                        continue
+                    reported.add(cyc)
+                    e = site[(a, b)]
+                    self._emit(
+                        "TL004", e.fn, e.node,
+                        f"lock-order cycle: `{a}` -> `{b}` here, but `{b}` "
+                        f"-> `{a}` elsewhere — two threads taking the two "
+                        "orders deadlock; pick one global order",
+                    )
+
+    def _call_under_lock_edges(self, trans) -> List[_LockEdge]:
+        """A resolvable call inside `with lock:` pulls in the callee's
+        transitive acquisitions as ordered edges."""
+        out: List[_LockEdge] = []
+        for lockset, callee, caller, node in self.calls_under_lock:
+            for dst in trans.get(callee, ()):
+                for src in lockset:
+                    if src != dst:
+                        out.append(_LockEdge(src, dst, caller, node))
+                    elif src == dst and dst not in self.rlocks:
+                        # call re-acquires a plain Lock the caller holds
+                        out.append(_LockEdge(src, dst, caller, node))
+        return out
+
+    def _resolved_callees(self, call: ast.Call, ctx) -> List[FunctionInfo]:
+        fi, mi = ctx["fi"], ctx["mi"]
+        out: List[FunctionInfo] = []
+        if isinstance(call.func, ast.Name):
+            for t in _resolve_name(self.idx, fi, mi, call.func.id, ctx["aliases"]):
+                if isinstance(t, FunctionInfo):
+                    out.append(t)
+        elif (
+            isinstance(call.func, ast.Attribute)
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id == "self"
+            and ctx["cls"] is not None
+        ):
+            m = mi.functions.get(f"{ctx['cls']}.{call.func.attr}")
+            if m is not None:
+                out.append(m)
+        return out
+
+    def _reaches(self, graph: Dict[str, Set[str]], a: str, b: str) -> bool:
+        seen: Set[str] = set()
+        frontier = [a]
+        while frontier:
+            x = frontier.pop()
+            if x == b:
+                return True
+            if x in seen:
+                continue
+            seen.add(x)
+            frontier.extend(graph.get(x, ()))
+        return False
+
+    def _tl005(self) -> None:
+        for fi, node, held in self.sleeps:
+            self._emit(
+                "TL005",
+                fi,
+                node,
+                f"time.sleep while holding {held} — every thread contending "
+                "for the lock serializes behind the sleeper; sleep outside "
+                "the critical section or use Condition.wait with a timeout",
+            )
+
+    def _tl006(self) -> None:
+        daemon_roots = [r for r in self.roots if r.daemon]
+        emitted: Set[int] = set()
+        for r in daemon_roots:
+            for f in reachable_from(self.idx, {r.fn}):
+                for node, kind in self.file_writes.get(f, ()):  # noqa: B020
+                    if id(node) in emitted:
+                        continue
+                    emitted.add(id(node))
+                    self._emit(
+                        "TL006",
+                        f,
+                        node,
+                        f"durable write ({kind}) reachable from daemon "
+                        f"thread root {r.label} — daemon threads are killed "
+                        "mid-write at interpreter exit; make the thread "
+                        "non-daemon or move the write to the controlling "
+                        "thread",
+                    )
+
+
+# ----------------------------------------------------------------- drivers
+
+
+def lint_paths(
+    paths: Sequence[str],
+    baseline: Optional[str] = None,
+    pkg_root: Optional[str] = None,
+) -> LintResult:
+    idx, roots, attribution = build_thread_index(
+        list(paths), pkg_root or package_root()
+    )
+    col = _Collector(idx, roots, attribution)
+    col.collect()
+    raw = col.finish()
+    base = (
+        load_baseline(baseline).restricted(RULES) if baseline else Baseline()
+    )
+    findings: List[Finding] = []
+    suppressed: List[Tuple[Finding, str]] = []
+    excluded: List[Finding] = []
+    for f in raw:
+        if base.excluded(f):
+            excluded.append(f)
+            continue
+        w = base.waive(f)
+        if w is not None:
+            suppressed.append((f, w.reason))
+        else:
+            findings.append(f)
+    stale = [w for w in base.waivers if not w.used]
+    return LintResult(findings, suppressed, excluded, stale)
+
+
+def lint_package(baseline: Optional[str] = "default") -> LintResult:
+    if baseline == "default":
+        import os
+
+        baseline = default_baseline_path()
+        if not os.path.exists(baseline):
+            baseline = None
+    return lint_paths(iter_package_files(), baseline=baseline)

@@ -1,0 +1,354 @@
+"""threadsan — opt-in runtime lock/queue sanitizer (lightweight lockdep).
+
+The static half (:mod:`analysis.threadlint`) proves contracts about code
+it can resolve; this harness watches the contracts it cannot — callables
+passed through constructors, attr-of-attr dispatch, locks taken in any
+order the scheduler happens to produce. ``--threadsan`` installs it for
+the whole run:
+
+* ``threading.Lock`` / ``threading.RLock`` / ``queue.Queue`` factories
+  are patched so objects **created by package code** (decided by the
+  caller's filename — stdlib and third-party callers get the real thing)
+  come back instrumented.
+* Every acquisition is recorded against the thread's currently-held
+  stack, building a global lock-order graph at runtime. Acquiring B
+  while holding A when some thread previously acquired A while holding B
+  is a lock-order inversion: the classic AB/BA deadlock, observable even
+  when the interleaving that would actually deadlock never happens in
+  this run. Default policy raises :class:`LockOrderInversion` (after
+  releasing the just-taken lock, so the raise itself cannot wedge).
+* Held-duration per lock and live/peak queue depth are exported as
+  gauges; :meth:`ThreadSanitizer.register_gauges` plugs them into the
+  telemetry watchdog's provider map so every stall snapshot and incident
+  carries them. (The watchdog itself attaches all-thread faulthandler
+  tracebacks to stall incidents — between the two, a hung run records
+  who held what, for how long, and where every thread was.)
+
+Scope and cost: only locks/queues created *after* :meth:`install` and
+*by package files* are wrapped — module-level locks created at import
+time stay real (they are single-purpose leaf locks; threadlint covers
+them statically). Acquisition adds one thread-local list append and,
+for first-time edges, one dict insert under a meta-lock — microseconds,
+fine for CI tiers and bringup, not meant for production serving.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as queue_module
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "LockOrderInversion",
+    "ThreadSanitizer",
+    "current",
+]
+
+_CURRENT: Optional["ThreadSanitizer"] = None
+
+
+def current() -> Optional["ThreadSanitizer"]:
+    """The installed sanitizer, if any (None outside --threadsan runs)."""
+    return _CURRENT
+
+
+class LockOrderInversion(RuntimeError):
+    """Two locks were acquired in opposite orders by different code paths."""
+
+
+class _LockProxy:
+    """Wraps a real lock; reports acquire/release to the sanitizer.
+
+    Supports the full Lock/RLock surface the package uses: context
+    manager, explicit acquire/release, locked().
+    """
+
+    __slots__ = ("_lock", "_san", "name", "reentrant")
+
+    def __init__(self, lock, san: "ThreadSanitizer", name: str, reentrant: bool):
+        self._lock = lock
+        self._san = san
+        self.name = name
+        self.reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            try:
+                self._san._note_acquire(self)
+            except LockOrderInversion:
+                self._lock.release()
+                raise
+        return ok
+
+    def release(self) -> None:
+        self._san._note_release(self)
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "_LockProxy":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        kind = "RLock" if self.reentrant else "Lock"
+        return f"<threadsan {kind} {self.name}>"
+
+
+class _SanQueue(queue_module.Queue):
+    """queue.Queue that tracks peak depth (updated under the queue's own
+    mutex, where qsize is consistent)."""
+
+    def __init__(self, maxsize: int = 0, *, san: "ThreadSanitizer", name: str):
+        super().__init__(maxsize)
+        self._san = san
+        self.tsname = name
+        self.peak_depth = 0
+
+    def _put(self, item) -> None:
+        super()._put(item)
+        depth = len(self.queue)
+        if depth > self.peak_depth:
+            self.peak_depth = depth
+
+
+class ThreadSanitizer:
+    """Install/uninstall pair (also a context manager) around a run.
+
+    Args:
+        raise_on_inversion: raise :class:`LockOrderInversion` in the
+            acquiring thread (default). False records only — the run
+            finishes and :meth:`report` carries the evidence.
+    """
+
+    def __init__(self, raise_on_inversion: bool = True):
+        self.raise_on_inversion = raise_on_inversion
+        self._meta = threading.Lock()  # real lock: created pre-install
+        self._tls = threading.local()
+        # (held.name, acquired.name) -> "thread-name @ site" of first sighting
+        self._edges: Dict[Tuple[str, str], str] = {}
+        self.inversions: List[Dict[str, Any]] = []
+        self._held_total_s: Dict[str, float] = {}
+        self._held_max_s: Dict[str, float] = {}
+        self._acquire_count: Dict[str, int] = {}
+        self._queues: List[_SanQueue] = []
+        self._lock_count = 0
+        self._installed = False
+        self._orig: Dict[str, Any] = {}
+        here = os.path.abspath(__file__)
+        self._pkg_dir = os.path.dirname(os.path.dirname(here)) + os.sep
+
+    # -- installation ------------------------------------------------------
+
+    def install(self) -> "ThreadSanitizer":
+        global _CURRENT
+        if self._installed:
+            return self
+        self._orig = {
+            "Lock": threading.Lock,
+            "RLock": threading.RLock,
+            "Queue": queue_module.Queue,
+        }
+        san = self
+        real_lock, real_rlock = self._orig["Lock"], self._orig["RLock"]
+        real_queue = self._orig["Queue"]
+
+        def Lock():  # noqa: N802 - must shadow threading.Lock
+            if san._caller_in_pkg():
+                return san._new_lock(real_lock(), reentrant=False, depth=2)
+            return real_lock()
+
+        def RLock():  # noqa: N802
+            if san._caller_in_pkg():
+                return san._new_lock(real_rlock(), reentrant=True, depth=2)
+            return real_rlock()
+
+        def Queue(maxsize: int = 0):  # noqa: N802
+            if san._caller_in_pkg():
+                q = _SanQueue(maxsize, san=san, name=san._site(depth=2))
+                with san._meta:
+                    san._queues.append(q)
+                return q
+            return real_queue(maxsize)
+
+        threading.Lock = Lock
+        threading.RLock = RLock
+        queue_module.Queue = Queue
+        self._installed = True
+        _CURRENT = self
+        return self
+
+    def uninstall(self) -> None:
+        global _CURRENT
+        if not self._installed:
+            return
+        threading.Lock = self._orig["Lock"]
+        threading.RLock = self._orig["RLock"]
+        queue_module.Queue = self._orig["Queue"]
+        self._installed = False
+        if _CURRENT is self:
+            _CURRENT = None
+
+    def __enter__(self) -> "ThreadSanitizer":
+        return self.install()
+
+    def __exit__(self, *exc) -> bool:
+        self.uninstall()
+        return False
+
+    def _caller_in_pkg(self) -> bool:
+        # frames: 0=_caller_in_pkg, 1=factory, 2=creating code
+        frame = sys._getframe(2)
+        return frame.f_code.co_filename.startswith(self._pkg_dir)
+
+    def _site(self, depth: int) -> str:
+        frame = sys._getframe(depth + 1)
+        fname = frame.f_code.co_filename
+        if fname.startswith(self._pkg_dir):
+            fname = fname[len(self._pkg_dir):]
+        return f"{fname}:{frame.f_lineno}"
+
+    def _new_lock(self, lock, reentrant: bool, depth: int) -> _LockProxy:
+        proxy = _LockProxy(lock, self, self._site(depth + 1), reentrant)
+        with self._meta:
+            self._lock_count += 1
+        return proxy
+
+    def wrap_lock(self, name: str, reentrant: bool = False) -> _LockProxy:
+        """Explicitly instrumented lock (tests, code outside the package)."""
+        ctor = self._orig.get("RLock" if reentrant else "Lock") or (
+            threading.RLock if reentrant else threading.Lock
+        )
+        proxy = _LockProxy(ctor(), self, name, reentrant)
+        with self._meta:
+            self._lock_count += 1
+        return proxy
+
+    # -- event recording ---------------------------------------------------
+
+    def _stack(self) -> List[Tuple[_LockProxy, float]]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _note_acquire(self, proxy: _LockProxy) -> None:
+        stack = self._stack()
+        now = time.monotonic()
+        if any(p is proxy for p, _ in stack):
+            # re-entrant re-acquire: no new ordering information
+            stack.append((proxy, now))
+            return
+        if stack:
+            tname = threading.current_thread().name
+            with self._meta:
+                inversion = None
+                for held, _ in stack:
+                    if held is proxy:
+                        continue
+                    edge = (held.name, proxy.name)
+                    reverse = (proxy.name, held.name)
+                    if reverse in self._edges and edge not in self._edges:
+                        inversion = {
+                            "first": reverse,
+                            "second": edge,
+                            "thread": tname,
+                            "prior": self._edges[reverse],
+                        }
+                        self.inversions.append(inversion)
+                    self._edges.setdefault(edge, f"{tname}")
+                if inversion is not None and self.raise_on_inversion:
+                    raise LockOrderInversion(
+                        f"lock-order inversion in thread {tname!r}: acquired "
+                        f"{inversion['second'][1]} while holding "
+                        f"{inversion['second'][0]}, but thread "
+                        f"{inversion['prior']!r} previously acquired them in "
+                        "the opposite order — two such threads interleaved "
+                        "deadlock"
+                    )
+        stack.append((proxy, now))
+
+    def _note_release(self, proxy: _LockProxy) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if not stack:
+            return  # released on a thread that never acquired (Lock-as-event)
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] is proxy:
+                _, t0 = stack.pop(i)
+                held = time.monotonic() - t0
+                with self._meta:
+                    self._held_total_s[proxy.name] = (
+                        self._held_total_s.get(proxy.name, 0.0) + held
+                    )
+                    if held > self._held_max_s.get(proxy.name, 0.0):
+                        self._held_max_s[proxy.name] = held
+                    self._acquire_count[proxy.name] = (
+                        self._acquire_count.get(proxy.name, 0) + 1
+                    )
+                return
+
+    # -- reporting ---------------------------------------------------------
+
+    def gauges(self) -> Dict[str, Any]:
+        """Live sanitizer state, shaped for a watchdog provider: small,
+        JSON-safe, never raises."""
+        with self._meta:
+            max_held = max(self._held_max_s.values(), default=0.0)
+            queues = list(self._queues)
+            inversions = len(self.inversions)
+            locks = self._lock_count
+        return {
+            "inversions": inversions,
+            "locks_tracked": locks,
+            "queues_tracked": len(queues),
+            "max_lock_held_ms": round(max_held * 1e3, 3),
+            "queue_depth": max((q.qsize() for q in queues), default=0),
+            "queue_peak_depth": max(
+                (q.peak_depth for q in queues), default=0
+            ),
+        }
+
+    def register_gauges(self, watchdog) -> None:
+        """Export gauges into a StallWatchdog's provider map — every stall
+        snapshot / incident then carries the sanitizer's view."""
+        watchdog.providers["threadsan"] = self.gauges
+
+    def report(self) -> Dict[str, Any]:
+        """Full end-of-run summary (also what the CLI prints)."""
+        with self._meta:
+            held = {
+                name: {
+                    "acquires": self._acquire_count.get(name, 0),
+                    "total_ms": round(self._held_total_s[name] * 1e3, 3),
+                    "max_ms": round(self._held_max_s.get(name, 0.0) * 1e3, 3),
+                }
+                for name in sorted(self._held_total_s)
+            }
+            queues = {
+                q.tsname: {
+                    "depth": q.qsize(),
+                    "peak_depth": q.peak_depth,
+                    "maxsize": q.maxsize,
+                }
+                for q in self._queues
+            }
+            inversions = list(self.inversions)
+        return {
+            "inversions": inversions,
+            "locks": held,
+            "queues": queues,
+            **{
+                k: v
+                for k, v in self.gauges().items()
+                if k in ("locks_tracked", "queues_tracked")
+            },
+        }

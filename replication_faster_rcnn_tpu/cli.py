@@ -106,6 +106,10 @@ def _build_config(args):
         cfg = cfg.replace(
             debug=dataclasses.replace(cfg.debug, strict=True)
         )
+    if getattr(args, "threadsan", False):
+        cfg = cfg.replace(
+            debug=dataclasses.replace(cfg.debug, threadsan=True)
+        )
     if (args.backbone or args.roi_op or getattr(args, "remat", False)
             or getattr(args, "frozen_bn", False)
             or getattr(args, "norm", None)):
@@ -153,6 +157,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "session + a per-program recompile check after "
                         "warmup — implicit transfers and silent recompiles "
                         "raise instead of eating throughput")
+    p.add_argument("--threadsan", action="store_true",
+                   help="runtime lock sanitizer (debug.threadsan): "
+                        "package-created locks/queues are instrumented, "
+                        "lock-order inversions raise (lightweight lockdep), "
+                        "and held-duration + queue-depth gauges feed the "
+                        "telemetry watchdog; runtime half of the TL rules "
+                        "in 'frcnn check'")
     p.add_argument("--dataset", default=None, choices=[None, "voc", "coco", "synthetic"])
     p.add_argument("--data-root", default=None)
     p.add_argument("--image-size", type=int, default=None)
@@ -269,7 +280,41 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "partitioning; GSPMD conv halo exchange)")
 
 
+def _threadsan_session(enabled: bool):
+    """Context manager installing the runtime lock sanitizer BEFORE the
+    threaded subsystems are constructed (their instance locks/queues must
+    be created under the patched factories), printing the report on exit."""
+    import contextlib
+
+    if not enabled:
+        return contextlib.nullcontext(None)
+
+    @contextlib.contextmanager
+    def session():
+        from replication_faster_rcnn_tpu.analysis.threadsan import (
+            ThreadSanitizer,
+        )
+
+        san = ThreadSanitizer()
+        with san:
+            yield san
+        rep = san.report()
+        print(
+            f"threadsan: {len(rep['inversions'])} lock-order inversion(s), "
+            f"{rep['locks_tracked']} lock(s) and "
+            f"{rep['queues_tracked']} queue(s) tracked",
+            file=sys.stderr,
+        )
+
+    return session()
+
+
 def cmd_train(args) -> int:
+    with _threadsan_session(getattr(args, "threadsan", False)) as san:
+        return _cmd_train_impl(args, san)
+
+
+def _cmd_train_impl(args, san=None) -> int:
     _apply_device(args.device)
     if args.debug_nans:
         from replication_faster_rcnn_tpu.utils.debug import enable_nan_checks
@@ -284,6 +329,8 @@ def cmd_train(args) -> int:
         telemetry_dir=args.telemetry,
         stall_timeout_s=args.stall_timeout,
     )
+    if san is not None and trainer.watchdog is not None:
+        san.register_gauges(trainer.watchdog)
     if args.pretrained_backbone:
         trainer.load_pretrained_backbone(args.pretrained_backbone)
     from replication_faster_rcnn_tpu.utils.profiling import trace
@@ -545,6 +592,11 @@ def cmd_serve(args) -> int:
     batch) bucket program at startup, hold the inference params resident
     on device, and serve HTTP requests through the continuous
     micro-batching engine."""
+    with _threadsan_session(getattr(args, "threadsan", False)):
+        return _cmd_serve_impl(args)
+
+
+def _cmd_serve_impl(args) -> int:
     _apply_device(args.device)
     import contextlib
     import dataclasses as _dc
@@ -666,31 +718,86 @@ def cmd_trace_summary(args) -> int:
 
 
 def cmd_check(args) -> int:
-    """jaxlint over the package (or explicit paths): jit-hygiene rules
-    JX001-JX007 resolved against analysis/baseline.toml. Pure AST work —
-    no jax import, fast enough to gate every PR. Exits nonzero on any
-    unsuppressed finding or stale waiver."""
+    """Static lint gate over the package (or explicit paths): jaxlint's
+    jit-hygiene rules JX001-JX007 plus threadlint's host-concurrency
+    rules TL001-TL006, resolved against the shared analysis/baseline.toml.
+    Pure AST work — no jax import, fast enough to gate every PR. Exits
+    nonzero on any unsuppressed finding or stale waiver; --rules narrows
+    to a comma-separated subset (an analyzer with no selected rule is
+    skipped entirely)."""
     import json
 
-    from replication_faster_rcnn_tpu.analysis.jaxlint import (
-        RULES,
-        lint_package,
-        lint_paths,
-    )
+    from replication_faster_rcnn_tpu.analysis import jaxlint, threadlint
 
-    if args.paths:
-        result = lint_paths(args.paths, baseline=args.baseline)
-    elif args.baseline is not None:
-        result = lint_package(baseline=args.baseline)
-    else:
-        result = lint_package()
+    analyzers = [("jaxlint", jaxlint), ("threadlint", threadlint)]
+    selected = None
+    if getattr(args, "rules", None):
+        selected = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
+        known = set(jaxlint.RULES) | set(threadlint.RULES)
+        unknown = selected - known
+        if unknown:
+            print(
+                f"unknown rule(s): {', '.join(sorted(unknown))} "
+                f"(known: {', '.join(sorted(known))})",
+                file=sys.stderr,
+            )
+            return 2
+        analyzers = [
+            (name, mod) for name, mod in analyzers if selected & set(mod.RULES)
+        ]
+
+    def run(mod):
+        if args.paths:
+            return mod.lint_paths(args.paths, baseline=args.baseline)
+        if args.baseline is not None:
+            return mod.lint_package(baseline=args.baseline)
+        return mod.lint_package()
+
+    def keep(rule):
+        return selected is None or rule in selected
+
+    results = [(name, run(mod), mod.RULES) for name, mod in analyzers]
+    findings = [
+        f for _, r, _ in results for f in r.findings if keep(f.rule)
+    ]
+    stale = [
+        w for _, r, _ in results for w in r.stale_waivers if keep(w.rule)
+    ]
+    suppressed = [
+        (f, reason)
+        for _, r, _ in results
+        for f, reason in r.suppressed
+        if keep(f.rule)
+    ]
+    excluded_count = sum(
+        1 for _, r, _ in results for f in r.excluded if keep(f.rule)
+    )
+    rules = {
+        rule: desc
+        for _, _, mod_rules in results
+        for rule, desc in mod_rules.items()
+        if keep(rule)
+    }
     if args.json:
-        print(json.dumps(result.to_dict(), indent=2))
+        payload = {
+            "rules": rules,
+            "findings": [f.to_dict() for f in findings],
+            "suppressed": [
+                {**f.to_dict(), "reason": reason} for f, reason in suppressed
+            ],
+            "excluded_count": excluded_count,
+            "stale_waivers": [
+                dataclasses.asdict(w) for _, r, _ in results
+                for w in r.stale_waivers if keep(w.rule)
+            ],
+            "ok": not findings and not stale,
+        }
+        print(json.dumps(payload, indent=2))
     else:
-        for f in result.findings:
+        for f in findings:
             print(f)
         baseline_name = args.baseline or "analysis/baseline.toml"
-        for w in result.stale_waivers:
+        for w in stale:
             print(
                 f"stale waiver ({baseline_name}:{w.line}): {w.rule} "
                 f"{w.path} [{w.func}] matched nothing — the violation it "
@@ -698,16 +805,17 @@ def cmd_check(args) -> int:
                 f"[[waiver]] entry at line {w.line}"
             )
         if args.verbose:
-            for f, reason in result.suppressed:
+            for f, reason in suppressed:
                 print(f"waived: {f}\n    reason: {reason}")
+        names = "+".join(name for name, _, _ in results) or "no analyzers"
         print(
-            f"jaxlint: {len(result.findings)} finding(s), "
-            f"{len(result.suppressed)} waived, "
-            f"{len(result.excluded)} excluded, "
-            f"{len(result.stale_waivers)} stale waiver(s) "
-            f"({len(RULES)} rules)"
+            f"{names}: {len(findings)} finding(s), "
+            f"{len(suppressed)} waived, "
+            f"{excluded_count} excluded, "
+            f"{len(stale)} stale waiver(s) "
+            f"({len(rules)} rules)"
         )
-    return 1 if (result.findings or result.stale_waivers) else 0
+    return 1 if (findings or stale) else 0
 
 
 def cmd_audit(args) -> int:
@@ -941,12 +1049,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     p_check = sub.add_parser(
         "check",
-        help="static jit-hygiene lint (jaxlint rules JX001-JX007) against "
+        help="static lint gate: jit-hygiene (jaxlint JX001-JX007) + "
+             "host-concurrency contracts (threadlint TL001-TL006) against "
              "the committed suppression baseline; exits nonzero on any "
              "unsuppressed finding",
     )
     p_check.add_argument("paths", nargs="*",
                          help="files to lint (default: the whole package)")
+    p_check.add_argument("--rules", default=None, metavar="R1,R2,...",
+                         help="run/report only these rules (e.g. "
+                              "'TL001,TL004'; default: all JX + TL rules)")
     p_check.add_argument("--baseline", default=None, metavar="TOML",
                          help="suppression file (default: the committed "
                               "analysis/baseline.toml; pass /dev/null to "

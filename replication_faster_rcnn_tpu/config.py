@@ -472,10 +472,17 @@ class DebugConfig:
 
     ``strict_warmup`` is the number of dispatches per program allowed to
     compile (and stage constants) before the gate arms; ≥ 1.
+
+    ``threadsan`` engages the runtime lock sanitizer
+    (analysis/threadsan.py): package-created locks and queues are
+    instrumented, lock-order inversions raise, and held-duration /
+    queue-depth gauges feed the telemetry watchdog. The runtime half of
+    the threadlint static gate; CI-tier cost, not for production serving.
     """
 
     strict: bool = False
     strict_warmup: int = 1
+    threadsan: bool = False
 
     def __post_init__(self):
         if not isinstance(self.strict_warmup, int) or self.strict_warmup < 1:

@@ -197,7 +197,8 @@ class DataLoader:
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
-        self._epoch_skips = 0  # the skip budget is per-epoch
+        with self._skip_lock:  # pool workers bump the counter concurrently
+            self._epoch_skips = 0  # the skip budget is per-epoch
 
     def _on_sample_skip(self, idx: int, exc: Exception) -> None:
         """Budget + telemetry for one abandoned sample (thread path; pool

@@ -45,7 +45,16 @@ class MicroBatcher:
         max_delay_s: float = 0.01,
         depth: int = 64,
         name: str = "micro-batcher",
+        clock: Callable[[], float] = time.monotonic,
+        start: bool = True,
+        poll_hook: Optional[Callable[[], None]] = None,
     ) -> None:
+        """``clock``, ``start`` and ``poll_hook`` are test seams:
+        ``clock`` replaces ``time.monotonic`` for deadline math (inject
+        scheduler delay without sleeping), ``start=False`` skips the
+        worker thread so tests drive :meth:`_service_once` directly, and
+        ``poll_hook`` runs at the top of every worker iteration (an
+        Event-based rendezvous point — deterministic, no sleep races)."""
         if not callable(max_batch):
             if max_batch < 1:
                 raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -58,13 +67,22 @@ class MicroBatcher:
         self._process = process
         self._max_batch = max_batch
         self._max_delay_s = float(max_delay_s)
+        self._clock = clock
+        self._poll_hook = poll_hook
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._closed = False
+        # worker appends while flush_log snapshots from other threads
+        self._log_lock = threading.Lock()
         self._flushes: List[Tuple[Any, int]] = []  # (key, size) history
-        self._thread = threading.Thread(
-            target=self._run, daemon=True, name=name
-        )
-        self._thread.start()
+        # worker-loop state; touched by the controlling thread only in
+        # the threadless (start=False) test mode
+        self._pending: Dict[Any, List[Tuple[Any, Future, float]]] = {}
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name=name
+            )
+            self._thread.start()
 
     # ------------------------------------------------------------- producer
 
@@ -80,14 +98,15 @@ class MicroBatcher:
         if self._closed:
             raise RuntimeError("MicroBatcher is closed")
         fut: Future = Future()
-        self._queue.put((key, item, fut, time.monotonic()), timeout=timeout)
+        self._queue.put((key, item, fut, self._clock()), timeout=timeout)
         return fut
 
     def close(self, join_timeout: float = 60.0) -> None:
         """Drain-and-stop: everything accepted before close is processed
         (partial groups flush), then the worker exits. Idempotent."""
         if self._closed:
-            self._thread.join(timeout=join_timeout)
+            if self._thread is not None:
+                self._thread.join(timeout=join_timeout)
             return
         self._closed = True
         # the sentinel rides the same queue, so FIFO order guarantees the
@@ -98,9 +117,18 @@ class MicroBatcher:
                 self._queue.put(_CLOSE, timeout=0.1)
                 break
             except queue.Full:
-                if not self._thread.is_alive():  # pragma: no cover - crashed
+                if self._thread is None:
+                    # threadless test mode: make room inline
+                    self._service_once(block=False)
+                elif not self._thread.is_alive():  # pragma: no cover - crashed
                     break
-        self._thread.join(timeout=join_timeout)
+        if self._thread is not None:
+            self._thread.join(timeout=join_timeout)
+        else:
+            # threadless test mode: run the drain loop to the sentinel
+            # (each iteration consumes one queue entry, so this terminates)
+            while self._service_once(block=False):
+                pass
         # requests that raced past the closed flag after the sentinel: fail
         # them explicitly rather than leaving their futures pending forever
         while True:
@@ -122,7 +150,8 @@ class MicroBatcher:
     @property
     def flush_log(self) -> List[Tuple[Any, int]]:
         """(key, n_requests) per flush, oldest first (introspection/tests)."""
-        return list(self._flushes)
+        with self._log_lock:
+            return list(self._flushes)
 
     def queue_depth(self) -> int:
         return self._queue.qsize()
@@ -130,40 +159,60 @@ class MicroBatcher:
     # --------------------------------------------------------------- worker
 
     def _run(self) -> None:
-        # key -> list of (item, future, t_submitted); dict preserves
-        # insertion order so deadline scans see oldest groups first
-        pending: Dict[Any, List[Tuple[Any, Future, float]]] = {}
-        while True:
-            timeout = None
-            if pending:
-                oldest = min(group[0][2] for group in pending.values())
-                timeout = max(0.0, oldest + self._max_delay_s - time.monotonic())
-            try:
+        while self._service_once(block=True):
+            pass
+
+    def _service_once(self, block: bool = True) -> bool:
+        """One worker iteration: take at most one queue entry (waiting up
+        to the nearest group deadline when ``block``), then flush every
+        size-complete or deadline-expired group. Returns False once the
+        close sentinel has been processed (pending fully drained).
+
+        The deadline scan runs EVERY iteration, not only when the get
+        times out: under a sustained backlog the get always returns an
+        entry immediately, and a scan gated on ``queue.Empty`` (the
+        original shape of this loop) never runs — one hot key's arrivals
+        starve every other key's deadline flush indefinitely.
+        """
+        if self._poll_hook is not None:
+            self._poll_hook()
+        # _pending preserves insertion order (dict), so deadline scans
+        # see oldest groups first
+        pending = self._pending
+        timeout = None
+        if pending:
+            oldest = min(group[0][2] for group in pending.values())
+            timeout = max(0.0, oldest + self._max_delay_s - self._clock())
+        try:
+            if block:
                 entry = self._queue.get(timeout=timeout)
-            except queue.Empty:
-                entry = None  # a group's deadline expired
-            if entry is _CLOSE:
-                for key in list(pending):
-                    self._flush(key, pending)
-                return
-            if entry is not None:
-                key, item, fut, t0 = entry
-                group = pending.setdefault(key, [])
-                group.append((item, fut, t0))
-                if len(group) >= self._max_batch(key):
-                    self._flush(key, pending)
-                continue
-            now = time.monotonic()
+            else:
+                entry = self._queue.get_nowait()
+        except queue.Empty:
+            entry = None  # a group's deadline expired (or nothing queued)
+        if entry is _CLOSE:
             for key in list(pending):
-                group = pending[key]
-                if group and now >= group[0][2] + self._max_delay_s:
-                    self._flush(key, pending)
+                self._flush(key, pending)
+            return False
+        if entry is not None:
+            key, item, fut, t0 = entry
+            group = pending.setdefault(key, [])
+            group.append((item, fut, t0))
+            if len(group) >= self._max_batch(key):
+                self._flush(key, pending)
+        now = self._clock()
+        for key in list(pending):
+            group = pending[key]
+            if group and now >= group[0][2] + self._max_delay_s:
+                self._flush(key, pending)
+        return True
 
     def _flush(
         self, key: Any, pending: Dict[Any, List[Tuple[Any, Future, float]]]
     ) -> None:
         group = pending.pop(key)
-        self._flushes.append((key, len(group)))
+        with self._log_lock:
+            self._flushes.append((key, len(group)))
         try:
             results = self._process(key, [item for item, _, _ in group])
             if len(results) != len(group):

@@ -143,7 +143,11 @@ class StallWatchdog:
             if should_fire:
                 self.fired_count += 1
                 snap = self.snapshot(reason="stall", elapsed_s=elapsed)
-                self.last_snapshot = snap
+                stacks = self._thread_stacks()
+                if stacks is not None:
+                    snap["threads"] = stacks
+                with self._lock:  # raced by incident() on the main thread
+                    self.last_snapshot = snap
                 self._record_incident(snap)
                 if self.on_stall is not None:
                     try:
@@ -164,7 +168,8 @@ class StallWatchdog:
         for key, value in fields.items():
             if key != "kind":
                 snap[key] = value
-        self.last_snapshot = snap
+        with self._lock:  # raced by the watchdog thread's stall path
+            self.last_snapshot = snap
         self._record_incident(snap)
         return snap
 
@@ -196,6 +201,23 @@ class StallWatchdog:
         if gauges:
             snap["gauges"] = gauges
         return snap
+
+    @staticmethod
+    def _thread_stacks() -> Optional[list]:
+        """All-thread tracebacks as a list of lines, so a hung prefetch or
+        serving thread is diagnosable from the incident file post-mortem.
+        Uses faulthandler (C-level frame walk, no per-thread cooperation
+        needed) through a spooled temp file — it only writes to fds."""
+        try:
+            import faulthandler
+            import tempfile
+
+            with tempfile.TemporaryFile(mode="w+") as f:
+                faulthandler.dump_traceback(file=f, all_threads=True)
+                f.seek(0)
+                return f.read().rstrip("\n").split("\n")
+        except Exception:  # pragma: no cover - diagnostics must not raise
+            return None
 
     def _record_incident(self, snap: Dict[str, Any]) -> None:
         if self.snapshot_path is None:

@@ -51,6 +51,9 @@ class AsyncCheckpointWriter:
 
     def __init__(self) -> None:
         self._thread: Optional[threading.Thread] = None
+        # _error crosses the writer->trainer boundary: written by the
+        # writer on failure, read-and-cleared by the trainer at drain.
+        self._lock = threading.Lock()
         self._error: Optional[Tuple[int, BaseException]] = None
         self._last_step: Optional[int] = None
 
@@ -71,7 +74,8 @@ class AsyncCheckpointWriter:
         if t is not None:
             t.join()
             self._thread = None
-        err, self._error = self._error, None
+        with self._lock:
+            err, self._error = self._error, None
         return err
 
     def submit(
@@ -88,9 +92,13 @@ class AsyncCheckpointWriter:
             try:
                 work()
             except BaseException as e:  # noqa: BLE001 — surfaced at drain
-                self._error = (int(step), e)
+                with self._lock:
+                    self._error = (int(step), e)
 
-        t = threading.Thread(target=_run, name="ckpt-writer", daemon=True)
+        # Non-daemon: a checkpoint caught mid-fsync by interpreter exit
+        # must finish, not be killed — the thread always terminates once
+        # work() returns, so this never wedges shutdown.
+        t = threading.Thread(target=_run, name="ckpt-writer", daemon=False)
         self._thread = t
         t.start()
         return err

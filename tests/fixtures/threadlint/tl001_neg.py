@@ -1,0 +1,19 @@
+"""TL001 negative: every cross-thread write holds the same lock."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        with self._lock:
+            self._n = self._n + 1
+
+    def bump(self):
+        with self._lock:
+            self._n += 1

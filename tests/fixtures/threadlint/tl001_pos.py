@@ -1,0 +1,17 @@
+"""TL001 positive: attribute written from two thread roots, no lock."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        self._n = self._n + 1  # worker write, lock not held
+
+    def bump(self):
+        self._n += 1  # main-thread write, lock not held

@@ -1,0 +1,27 @@
+"""TL002 negative: bounded queue; shutdown put is non-blocking."""
+
+import queue
+import threading
+
+
+class Pipe:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=8)
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is None:
+                return
+
+    def send(self, item):
+        self._q.put(item, timeout=0.1)
+
+    def close(self):
+        self._q.put_nowait(None)
+        self._thread.join(timeout=1.0)

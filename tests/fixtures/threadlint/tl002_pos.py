@@ -1,0 +1,28 @@
+"""TL002 positive: unbounded queue bridging producer and consumer, and a
+blocking put with no timeout inside the shutdown path."""
+
+import queue
+import threading
+
+
+class Pipe:
+    def __init__(self):
+        self._q = queue.Queue()  # unbounded
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is None:
+                return
+
+    def send(self, item):
+        self._q.put(item, timeout=0.1)
+
+    def close(self):
+        self._q.put(None)  # blocks forever if the worker is dead
+        self._thread.join(timeout=1.0)

@@ -1,0 +1,21 @@
+"""TL003 negative: close() puts the sentinel the consumer loop exits on."""
+
+import queue
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=4)
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+
+    def close(self):
+        self._q.put_nowait(None)  # close sentinel unblocks the consumer
+        self._thread.join(timeout=1.0)

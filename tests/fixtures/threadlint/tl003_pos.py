@@ -1,0 +1,21 @@
+"""TL003 positive: blocking consumer loop, no close-sentinel put from any
+shutdown method — close() just joins and can hang forever."""
+
+import queue
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=4)
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        while True:
+            item = self._q.get()  # blocks forever once producers stop
+            if item is None:
+                return
+
+    def close(self):
+        self._thread.join(timeout=1.0)

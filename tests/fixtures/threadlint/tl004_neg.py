@@ -1,0 +1,19 @@
+"""TL004 negative: both paths honor one global lock order (a before b)."""
+
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def debit(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def credit(self):
+        with self._a:
+            with self._b:
+                pass

@@ -1,0 +1,20 @@
+"""TL004 positive: two functions take the same two locks in opposite
+orders — a classic AB/BA deadlock."""
+
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def debit(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def credit(self):
+        with self._b:
+            with self._a:
+                pass

@@ -1,0 +1,17 @@
+"""TL005 negative: the sleep happens outside the critical section."""
+
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ready = False
+
+    def wait_ready(self):
+        while True:
+            with self._lock:
+                if self.ready:
+                    return
+            time.sleep(0.01)

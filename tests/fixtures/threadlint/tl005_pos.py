@@ -1,0 +1,16 @@
+"""TL005 positive: polling sleep while holding the lock serializes every
+other thread contending for it."""
+
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ready = False
+
+    def wait_ready(self):
+        with self._lock:
+            while not self.ready:
+                time.sleep(0.01)

@@ -1,0 +1,15 @@
+"""TL006 negative: the writer thread is non-daemon, so the interpreter
+waits for the write to finish before exiting."""
+
+import threading
+
+
+class Saver:
+    def __init__(self, path):
+        self.path = path
+        self._thread = threading.Thread(target=self._work, daemon=False)
+        self._thread.start()
+
+    def _work(self):
+        with open(self.path, "w") as f:
+            f.write("state")

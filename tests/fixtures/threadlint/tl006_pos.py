@@ -1,0 +1,15 @@
+"""TL006 positive: a daemon thread performs a durable file write — the
+interpreter kills it mid-write at exit."""
+
+import threading
+
+
+class Saver:
+    def __init__(self, path):
+        self.path = path
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        with open(self.path, "w") as f:
+            f.write("state")

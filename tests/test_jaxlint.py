@@ -244,7 +244,12 @@ class TestCheckCLI:
         assert rc == 0
         payload = json.loads(capsys.readouterr().out)
         assert payload["ok"] is True
-        assert sorted(payload["rules"]) == ALL_RULES
+        # check runs jaxlint + threadlint; every JX rule must be present
+        from replication_faster_rcnn_tpu.analysis.threadlint import (
+            RULES as TL_RULES,
+        )
+
+        assert sorted(payload["rules"]) == sorted([*RULES, *TL_RULES])
         assert payload["findings"] == []
 
     def test_check_nonzero_on_findings(self, capsys):

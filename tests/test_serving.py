@@ -147,6 +147,97 @@ class TestMicroBatcher:
             MicroBatcher(lambda k, i: i, max_batch=1, depth=0)
 
 
+class TestMicroBatcherDeterministic:
+    """Worker-loop ordering audited with injected time (``clock``) and a
+    thread-free drive (``start=False`` + ``_service_once``) — no sleeps,
+    no scheduler races (ISSUE 8 satellite: deadline-flush and
+    ``_CLOSE``-drain audit)."""
+
+    def _mb(self, clock, **kw):
+        kw.setdefault("max_batch", 100)
+        kw.setdefault("max_delay_s", 1.0)
+        return MicroBatcher(
+            lambda k, items: list(items), clock=clock, start=False, **kw
+        )
+
+    def test_hot_key_backlog_cannot_starve_other_deadlines(self):
+        """The deadline scan runs on EVERY iteration. Before the fix it
+        ran only when the queue read timed out, so a sustained backlog on
+        one key deferred every other key's deadline flush indefinitely."""
+        now = [0.0]
+        mb = self._mb(lambda: now[0])
+        cold = mb.submit("cold", "victim")
+        for i in range(8):
+            mb.submit("hot", i)  # backlog: the get never goes Empty
+        now[0] = 2.0  # cold's deadline long past
+        # one iteration consumes ONE hot entry — and must still flush cold
+        assert mb._service_once(block=False)
+        assert ("cold", 1) in mb.flush_log
+        assert cold.result(timeout=0) == "victim"
+        assert mb.queue_depth() > 0  # hot backlog still queued; no starving
+        mb.close()
+
+    def test_deadline_is_measured_from_oldest_entry_of_group(self):
+        now = [0.0]
+        mb = self._mb(lambda: now[0])
+        mb.submit("k", "old")
+        assert mb._service_once(block=False)  # into pending at t=0
+        now[0] = 0.9
+        mb.submit("k", "young")  # same group, later arrival
+        assert mb._service_once(block=False)
+        assert mb.flush_log == []  # 0.9 < 1.0: not due yet
+        now[0] = 1.05  # oldest entry (t=0) is now past max_delay_s
+        assert mb._service_once(block=False)
+        assert mb.flush_log == [("k", 2)]
+        mb.close()
+
+    def test_close_sentinel_flushes_all_pending_groups(self):
+        now = [0.0]
+        mb = self._mb(lambda: now[0])
+        fa, fb = mb.submit("a", 1), mb.submit("b", 2)
+        mb.close()  # threadless: drains inline through _service_once
+        assert fa.result(timeout=0) == 1 and fb.result(timeout=0) == 2
+        assert sorted(mb.flush_log) == [("a", 1), ("b", 1)]
+
+    def test_close_on_full_queue_makes_room_inline(self):
+        """The sentinel must get a slot even when the queue is at depth
+        and no worker thread exists to drain it."""
+        now = [0.0]
+        mb = self._mb(lambda: now[0], depth=2)
+        futs = [mb.submit("k", i) for i in range(2)]  # queue full
+        mb.close()  # put(_CLOSE) hits queue.Full -> inline service
+        assert [f.result(timeout=0) for f in futs] == [0, 1]
+
+    def test_poll_hook_runs_every_iteration(self):
+        beats = []
+        now = [0.0]
+        mb = MicroBatcher(
+            lambda k, items: list(items),
+            max_batch=100,
+            max_delay_s=1.0,
+            clock=lambda: now[0],
+            start=False,
+            poll_hook=lambda: beats.append(now[0]),
+        )
+        mb.submit("k", 1)
+        mb._service_once(block=False)
+        now[0] = 5.0
+        mb._service_once(block=False)
+        assert beats == [0.0, 5.0]
+        mb.close()
+
+    def test_size_trigger_beats_deadline_under_injected_clock(self):
+        now = [0.0]
+        mb = self._mb(lambda: now[0], max_batch=2)
+        mb.submit("k", 1)
+        mb.submit("k", 2)
+        mb._service_once(block=False)
+        assert mb.flush_log == []  # one entry in pending: below size
+        mb._service_once(block=False)
+        assert mb.flush_log == [("k", 2)]  # size trigger, clock untouched
+        mb.close()
+
+
 # ---------------------------------------------------------- bucket routing
 
 

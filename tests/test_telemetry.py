@@ -164,6 +164,32 @@ class TestWatchdog:
         assert stall["gauges"]["loader_queue_depth"] == 2
         assert "error" in stall["gauges"]["sick_gauge"]
 
+    def test_stall_snapshot_attaches_all_thread_stacks(self, tmp_path):
+        """Stall incidents carry a faulthandler dump of EVERY thread —
+        the hung prefetch/serving/writer thread is diagnosable from the
+        incident file post-mortem (ISSUE 8 satellite)."""
+        snap_path = str(tmp_path / "watchdog.jsonl")
+        wd = StallWatchdog(
+            timeout_s=0.1, poll_s=0.02, snapshot_path=snap_path
+        )
+        wd.start()
+        try:
+            assert _wait_until(lambda: wd.fired_count == 1)
+        finally:
+            wd.stop()
+        events = [json.loads(line) for line in open(snap_path)]
+        stall = next(e for e in events if e["kind"] == "stall")
+        assert isinstance(stall["threads"], list)
+        joined = "\n".join(stall["threads"])
+        # faulthandler's format: one header per thread, frames beneath
+        assert "thread" in joined.lower() and 'File "' in joined
+        # more than one thread is visible (main + the watchdog poller)
+        headers = [
+            ln for ln in stall["threads"]
+            if ln.startswith(("Thread ", "Current thread "))
+        ]
+        assert len(headers) >= 2, joined
+
     def test_progress_file_tracks_beats(self, tmp_path):
         path = str(tmp_path / "progress.json")
         wd = StallWatchdog(timeout_s=60.0, progress_path=path)
