@@ -1,0 +1,383 @@
+"""Scale-out profile: ZeRO-1 memory/collective/throughput gate, banked.
+
+One command measures what the ZeRO-1 optimizer-state sharding
+(`parallel/zero.py` + the sharded branch of `parallel/spmd.py`) actually
+buys on a data mesh, and fails loudly when the win rots:
+
+* **per-device optimizer-state bytes** — read from the placed arrays'
+  ``addressable_shards`` (what the runtime committed to memory, not what
+  a sharding annotation promised), for the replicated baseline and the
+  ZeRO placement of the SAME train state. The gate: the ZeRO placement
+  must hold at most ``1/N + slack`` of the replicated bytes per device,
+  i.e. the (N−1)/N reduction the partitioning exists for.
+* **collective inventory** — `analysis.fingerprint.parse_collectives`
+  over both lowered step programs: the replicated step must be psum
+  all_reduces only, the ZeRO step must add reduce_scatter (gradient
+  exchange) and all_gather (param reassembly) and nothing else. The
+  structural contract also lives in hlolint HX003; repeating it here
+  keeps this harness self-contained for off-CI runs.
+* **throughput** — images/sec through both compiled steps; the ZeRO
+  number is checked against the committed record for the same
+  (config, platform, n_dev) under ``benchmarks/records/`` exactly like
+  benchmarks/step_profile.py checks the single-step profile:
+
+      python benchmarks/scaling_profile.py            # check
+      python benchmarks/scaling_profile.py --update   # re-bank
+
+The memory and collective gates are structural and run on EVERY
+invocation (bank or no bank); only the throughput comparison needs a
+banked record. Cross-platform comparisons are skipped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+RECORDS_DIR = os.path.join(_REPO, "benchmarks", "records")
+SCHEMA = "scaling_profile/v1"
+DEFAULT_TOL = 0.15
+
+# per-device ZeRO opt-state bytes may exceed the ideal replicated/N by
+# this relative slack (leaves with no dimension divisible by N stay
+# replicated — scalars, odd-shaped biases) before the memory gate fails
+OPT_BYTES_SLACK = 0.5
+
+GATE_KEY = "images_per_sec_zero"
+
+
+# ---------------------------------------------------------------------------
+# pure record logic (no jax): unit-testable without placing anything
+
+
+def record_key(config_token: str, platform: str, n_dev: int) -> str:
+    """Identity of a banked record. The backend is always spmd (ZeRO-1
+    only exists there); the device count is part of the identity because
+    the sharding factor IS the measurement."""
+    return f"{config_token}_{platform}_n{n_dev}"
+
+
+def record_path(key: str, records_dir: str = RECORDS_DIR) -> str:
+    return os.path.join(records_dir, f"scaling_profile_{key}.json")
+
+
+def check_structural(record, slack: float = OPT_BYTES_SLACK):
+    """The bank-free gates: memory reduction and collective inventory.
+
+    Returns a list of human-readable failures (empty = pass)."""
+    failures = []
+    n = int(record.get("n_dev", 1))
+    repl = float(record.get("opt_bytes_per_device_replicated", 0))
+    zero = float(record.get("opt_bytes_per_device_zero", 0))
+    if repl <= 0 or zero <= 0:
+        failures.append("opt-state byte measurement missing or zero")
+        return failures
+    frac = zero / repl
+    ceiling = (1.0 / n) * (1.0 + slack)
+    if frac > ceiling:
+        failures.append(
+            f"per-device opt-state not sharded: ZeRO holds {frac:.1%} of "
+            f"the replicated bytes (ceiling {ceiling:.1%} = 1/{n} "
+            f"+ {slack:.0%} slack) — the (N-1)/N reduction is gone"
+        )
+    coll_zero = record.get("collectives_zero") or {}
+    coll_repl = record.get("collectives_replicated") or {}
+    required = {"all_reduce", "reduce_scatter", "all_gather"}
+    missing = sorted(required - set(coll_zero))
+    if missing:
+        failures.append(
+            f"ZeRO step is missing collective kinds {missing} — the "
+            "reduce-scatter/all-gather pattern of parallel/spmd.py is gone"
+        )
+    extra = sorted(set(coll_zero) - required)
+    if extra:
+        failures.append(f"ZeRO step emits unexpected collective kinds {extra}")
+    repl_extra = sorted(set(coll_repl) - {"all_reduce"})
+    if repl_extra:
+        failures.append(
+            f"replicated step emits unexpected collective kinds {repl_extra}"
+        )
+    return failures
+
+
+def check_regression(current, banked, tol: float = DEFAULT_TOL):
+    """Throughput comparison against the banked record.
+
+    Returns (failures, warnings)."""
+    failures, warnings = [], []
+    if banked.get("schema") != SCHEMA:
+        warnings.append(
+            f"banked record has schema {banked.get('schema')!r}, "
+            f"expected {SCHEMA!r}; skipping comparison"
+        )
+        return failures, warnings
+    for key in (GATE_KEY, "images_per_sec_replicated"):
+        old = banked.get(key)
+        new = current.get(key)
+        if not old or not new:
+            continue
+        drop = 1.0 - new / old
+        if drop > tol:
+            failures.append(
+                f"{key} regressed {drop:+.1%}: {new:.3f} vs banked "
+                f"{old:.3f} (tolerance {tol:.0%})"
+            )
+        elif drop > tol / 2:
+            warnings.append(
+                f"{key} within tolerance but slipping {drop:+.1%}: "
+                f"{new:.3f} vs banked {old:.3f}"
+            )
+    old_frac = banked.get("opt_bytes_frac")
+    new_frac = current.get("opt_bytes_frac")
+    if old_frac and new_frac and new_frac > old_frac * (1.0 + tol):
+        failures.append(
+            f"opt_bytes_frac grew: {new_frac:.4f} vs banked {old_frac:.4f} "
+            "— the ZeRO placement is holding more than it used to"
+        )
+    return failures, warnings
+
+
+def load_record(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_record(record, path: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# measurement
+
+
+def _per_device_bytes(tree) -> int:
+    """Bytes the FIRST local device holds for a placed pytree — summed
+    over leaves from ``addressable_shards`` (committed layout, including
+    any replicated leaves the sharder left whole)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = [s for s in leaf.addressable_shards if s.index is not None]
+        first = min(shards, key=lambda s: s.device.id)
+        total += first.data.nbytes
+    return total
+
+
+def profile(cfg, config_token: str, n_steps: int = 5):
+    """Measure one config's scale-out profile; returns the record dict.
+
+    ``cfg`` must be an spmd-backend config; the ZeRO variant is derived
+    by flipping ``train.shard_opt_state`` so both placements price the
+    same model/optimizer."""
+    import copy
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from replication_faster_rcnn_tpu import parallel
+    from replication_faster_rcnn_tpu.analysis.fingerprint import (
+        parse_collectives,
+    )
+    from replication_faster_rcnn_tpu.data import SyntheticDataset
+    from replication_faster_rcnn_tpu.data.loader import collate
+    from replication_faster_rcnn_tpu.parallel import zero as pzero
+    from replication_faster_rcnn_tpu.parallel.spmd import (
+        make_shard_map_train_step,
+    )
+    from replication_faster_rcnn_tpu.train.train_step import (
+        create_train_state,
+        make_optimizer,
+    )
+
+    cfg_zero = cfg.replace(
+        train=dataclasses.replace(
+            cfg.train, backend="spmd", shard_opt_state=True
+        )
+    )
+    cfg_repl = cfg_zero.replace(
+        train=dataclasses.replace(cfg_zero.train, shard_opt_state=False)
+    )
+
+    mesh = parallel.make_mesh(cfg.mesh)
+    n_shards = mesh.shape["data"]
+    tx, _ = make_optimizer(cfg_zero, steps_per_epoch=100)
+    model, state = create_train_state(cfg_zero, jax.random.PRNGKey(0), tx)
+    host_state = jax.device_get(state)
+
+    shardings = pzero.train_state_shardings(state, mesh, cfg.mesh, True)
+    # independent host copies: both placements get private buffers, so the
+    # donating steps can't invalidate each other's state mid-measurement
+    state_repl = parallel.replicate_tree(copy.deepcopy(host_state), mesh)
+    state_zero = pzero.place_train_state(copy.deepcopy(host_state), shardings)
+
+    opt_repl = _per_device_bytes(state_repl.opt_state)
+    opt_zero = _per_device_bytes(state_zero.opt_state)
+
+    step_repl, _ = make_shard_map_train_step(cfg_repl, tx, mesh)
+    step_zero, _ = make_shard_map_train_step(
+        cfg_zero, tx, mesh, state_template=state
+    )
+
+    batch_size = cfg.train.batch_size
+    ds = SyntheticDataset(cfg.data, length=batch_size)
+    batch = collate([ds[i] for i in range(batch_size)])
+
+    def staged():
+        return parallel.shard_batch(
+            {k: np.array(v) for k, v in batch.items()}, mesh, cfg.mesh
+        )
+
+    coll = {}
+    for name, step, st in (
+        ("replicated", step_repl, state_repl),
+        ("zero", step_zero, state_zero),
+    ):
+        text = step.lower(st, staged()).as_text()
+        coll[name] = parse_collectives(text)
+
+    def timed(step, st):
+        # donation consumes the placed state every dispatch; threading the
+        # returned state through mirrors the trainer's loop
+        st, metrics = step(st, staged())  # compile + stabilize
+        jax.device_get(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            st, metrics = step(st, staged())
+        jax.device_get(metrics["loss"])
+        wall = time.perf_counter() - t0
+        return st, batch_size * n_steps / wall, wall / n_steps * 1e3
+
+    state_repl, ips_repl, ms_repl = timed(step_repl, state_repl)
+    state_zero, ips_zero, ms_zero = timed(step_zero, state_zero)
+
+    dev = jax.devices()[0]
+    return {
+        "schema": SCHEMA,
+        "config": config_token,
+        "backend": "spmd",
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", None),
+        "n_dev": jax.device_count(),
+        "n_shards": int(n_shards),
+        "batch_size": batch_size,
+        "image_size": list(cfg.data.image_size),
+        "n_steps_timed": n_steps,
+        "opt_bytes_per_device_replicated": int(opt_repl),
+        "opt_bytes_per_device_zero": int(opt_zero),
+        "opt_bytes_frac": round(opt_zero / opt_repl, 6) if opt_repl else None,
+        "opt_bytes_ideal_frac": round(1.0 / n_shards, 6),
+        "collectives_replicated": coll["replicated"],
+        "collectives_zero": coll["zero"],
+        "step_ms_replicated": round(ms_repl, 3),
+        "step_ms_zero": round(ms_zero, 3),
+        "images_per_sec_replicated": round(ips_repl, 3),
+        "images_per_sec_zero": round(ips_zero, 3),
+        "measured": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument(
+        "--devices",
+        type=int,
+        default=8,
+        help="host-platform device count to force when jax is not yet "
+        "imported and no accelerator is attached (CPU CI)",
+    )
+    p.add_argument("--steps", type=int, default=5, help="timed dispatches")
+    p.add_argument(
+        "--update", action="store_true", help="write/overwrite the banked record"
+    )
+    p.add_argument(
+        "--no-check", action="store_true", help="measure + print only"
+    )
+    p.add_argument("--tol", type=float, default=DEFAULT_TOL)
+    p.add_argument("--slack", type=float, default=OPT_BYTES_SLACK)
+    p.add_argument("--records-dir", default=RECORDS_DIR)
+    args = p.parse_args(argv)
+
+    if args.devices > 1 and "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={args.devices}"
+            ).strip()
+
+    from benchmarks.step_profile import tiny_config
+
+    cfg = tiny_config(
+        batch_size=args.batch_size, image_size=args.image_size, backend="spmd"
+    )
+    import dataclasses
+
+    from replication_faster_rcnn_tpu.config import MeshConfig
+
+    cfg = cfg.replace(mesh=MeshConfig(num_data=args.devices))
+    cfg = cfg.replace(
+        train=dataclasses.replace(cfg.train, grad_allreduce_dtype="bfloat16")
+    )
+    token = f"tiny{args.image_size}b{args.batch_size}"
+
+    record = profile(cfg, token, n_steps=args.steps)
+    key = record_key(token, record["platform"], record["n_dev"])
+    path = record_path(key, args.records_dir)
+    print(json.dumps(record, indent=1, sort_keys=True))
+
+    structural = check_structural(record, slack=args.slack)
+    for f in structural:
+        print(f"scaling_profile: FAIL {f}", file=sys.stderr)
+    if structural:
+        return 1
+
+    if args.update:
+        save_record(record, path)
+        print(f"scaling_profile: banked {path}", file=sys.stderr)
+        return 0
+    if args.no_check:
+        return 0
+    if not os.path.exists(path):
+        print(
+            f"scaling_profile: no banked record at {path} — run with "
+            "--update to create one (not checking)",
+            file=sys.stderr,
+        )
+        return 0
+    failures, warnings = check_regression(record, load_record(path), tol=args.tol)
+    for w in warnings:
+        print(f"scaling_profile: WARN {w}", file=sys.stderr)
+    for f in failures:
+        print(f"scaling_profile: FAIL {f}", file=sys.stderr)
+    if failures:
+        print(
+            f"scaling_profile: REGRESSION vs {path} — if intentional, "
+            "re-bank with --update",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"scaling_profile: OK vs {path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,10 +46,22 @@ COLLECTIVE_KINDS = (
 
 # `"stablehlo.all_reduce"(%x) <{...}> ({ region }) : (tensor<10x20xbf16>)
 # -> ...` — the result element type follows the region close; DOTALL
-# because the reduction region spans lines.
+# because the reduction region spans lines. reduce_scatter carries the
+# same reduction-region syntax; all_gather is region-free, so its operand
+# type follows the attribute dict directly.
 _ALL_REDUCE_RE = re.compile(
     r'"stablehlo\.all_reduce"\(.*?\}\) : \(tensor<([^>]*)>', re.S
 )
+_ELEMENT_TYPE_RES = {
+    "all_reduce": _ALL_REDUCE_RE,
+    "reduce_scatter": re.compile(
+        r'"stablehlo\.reduce_scatter"\(.*?\}\) : \(tensor<([^>]*)>', re.S
+    ),
+    "all_gather": re.compile(
+        r'"stablehlo\.all_gather"\([^)]*\)\s*<\{.*?\}>\s*:\s*\(tensor<([^>]*)>',
+        re.S,
+    ),
+}
 # compiled-module header: `input_output_alias={ {0}: (0, {}, may-alias),
 # {1,2}: (3, {}, must-alias), ... }`
 _ALIAS_ENTRY_RE = re.compile(
@@ -95,12 +107,14 @@ def parse_collectives(stablehlo_text: str) -> Dict[str, Any]:
         n = len(re.findall(rf'"?stablehlo\.{kind}"?\(', stablehlo_text))
         if n:
             inv[kind] = {"count": n}
-    if "all_reduce" in inv:
+    for kind, pattern in _ELEMENT_TYPE_RES.items():
+        if kind not in inv:
+            continue
         types: Dict[str, int] = {}
-        for tensor in _ALL_REDUCE_RE.findall(stablehlo_text):
+        for tensor in pattern.findall(stablehlo_text):
             elem = tensor.split("x")[-1]
             types[elem] = types.get(elem, 0) + 1
-        inv["all_reduce"]["element_types"] = dict(sorted(types.items()))
+        inv[kind]["element_types"] = dict(sorted(types.items()))
     return inv
 
 

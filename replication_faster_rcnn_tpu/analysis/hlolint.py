@@ -49,9 +49,10 @@ HLO_RULES: Dict[str, str] = {
 }
 
 # the audited program matrix: every feed the Trainer can run, single-step
-# and fused, plus eval (7 programs) and the serving engine's bucket
-# matrix (audit_config's 2 resolutions × 2 batch sizes = 4 more)
-AUDIT_FEEDS = ("loader", "cached", "spmd")
+# and fused — including the ZeRO-1 variant of the shard_map backend —
+# plus eval (9 programs) and the serving engine's bucket matrix
+# (audit_config's 2 resolutions × 2 batch sizes = 4 more)
+AUDIT_FEEDS = ("loader", "cached", "spmd", "zero")
 AUDIT_KS = (1, 2)
 AUDIT_BANK_NAME = "ci"
 AUDIT_CACHE_N = 4
@@ -252,8 +253,16 @@ def check_contracts(
             )
         collectives = fp.get("collectives", {})
         ar = collectives.get("all_reduce")
-        if fp.get("feed") == "spmd":
-            types = (ar or {}).get("element_types", {})
+        if fp.get("feed") in ("spmd", "zero"):
+            # the gradient exchange: plain psum all_reduces on the
+            # replicated backend, psum_scatter reduce_scatters under
+            # ZeRO-1 — either way one bf16 collective per float grad leaf
+            types: Dict[str, int] = {}
+            for kind in ("all_reduce", "reduce_scatter"):
+                for elem, n in (
+                    collectives.get(kind, {}).get("element_types", {}).items()
+                ):
+                    types[elem] = types.get(elem, 0) + n
             n_bf16 = types.get("bf16", 0)
             n_grad = int(fp.get("meta", {}).get("n_float_grad_leaves", 1))
             if want_dt == "bfloat16" and n_bf16 < n_grad:
@@ -261,9 +270,10 @@ def check_contracts(
                     Violation(
                         "HX002",
                         name,
-                        "grad all-reduce element type: expected >= "
-                        f"{n_grad} bf16 all_reduces (one per float grad "
-                        f"leaf) under grad_allreduce_dtype=bfloat16, found "
+                        "grad-exchange element type: expected >= "
+                        f"{n_grad} bf16 all_reduce/reduce_scatter ops (one "
+                        f"per float grad leaf) under "
+                        f"grad_allreduce_dtype=bfloat16, found "
                         f"{n_bf16} (types: {types or 'none'})",
                     )
                 )
@@ -272,7 +282,7 @@ def check_contracts(
                     Violation(
                         "HX002",
                         name,
-                        f"{n_bf16} bf16 all_reduces under "
+                        f"{n_bf16} bf16 grad-exchange collectives under "
                         "grad_allreduce_dtype=float32 — the gradient "
                         "exchange silently lost precision",
                     )
@@ -296,7 +306,34 @@ def check_contracts(
                         "HX003",
                         name,
                         f"unexpected collective kinds {other} — the "
-                        "shard_map backend emits psum all_reduces only",
+                        "replicated shard_map backend emits psum "
+                        "all_reduces only",
+                    )
+                )
+        elif fp.get("feed") == "zero":
+            required = {"all_reduce", "reduce_scatter", "all_gather"}
+            missing = sorted(required - set(collectives))
+            if missing:
+                out.append(
+                    Violation(
+                        "HX003",
+                        name,
+                        f"missing collective kinds {missing} — ZeRO-1 "
+                        "needs reduce_scatter (grad exchange), all_gather "
+                        "(param reassembly) and all_reduce (metrics/health "
+                        "psums); the hand-placed collectives of "
+                        "parallel/spmd.py are gone",
+                    )
+                )
+            other = sorted(set(collectives) - required)
+            if other:
+                out.append(
+                    Violation(
+                        "HX003",
+                        name,
+                        f"unexpected collective kinds {other} — the ZeRO-1 "
+                        "shard_map backend emits all_reduce, "
+                        "reduce_scatter and all_gather only",
                     )
                 )
         elif collectives:

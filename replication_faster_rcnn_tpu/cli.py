@@ -30,6 +30,22 @@ def _apply_device(device: str) -> None:
         jax.config.update("jax_platforms", device)
 
 
+def _apply_distributed(args) -> None:
+    """--num-processes/--coordinator/--process-id: bring up the multi-host
+    runtime BEFORE anything queries the device topology (jax.distributed
+    must initialize before the backend does). No-op single-process."""
+    n = getattr(args, "num_processes", None)
+    if not n or n <= 1:
+        return
+    from replication_faster_rcnn_tpu.parallel import initialize_distributed
+
+    initialize_distributed(
+        coordinator_address=getattr(args, "coordinator", None),
+        num_processes=n,
+        process_id=getattr(args, "process_id", None),
+    )
+
+
 def _build_config(args):
     from replication_faster_rcnn_tpu.config import get_config
 
@@ -94,6 +110,14 @@ def _build_config(args):
         train_kw["max_consecutive_skips"] = args.max_consecutive_skips
     if getattr(args, "async_checkpoint", False):
         train_kw["async_checkpoint"] = True
+    if getattr(args, "lr_scaling", None):
+        train_kw["lr_scaling"] = args.lr_scaling
+    if getattr(args, "base_batch_size", None) is not None:
+        train_kw["base_batch_size"] = args.base_batch_size
+    if getattr(args, "warmup_epochs", None) is not None:
+        train_kw["warmup_epochs"] = args.warmup_epochs
+    if getattr(args, "lars", False):
+        train_kw["lars"] = True
     if train_kw:
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **train_kw))
     if getattr(args, "compile_cache", None):
@@ -181,7 +205,38 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "shard_map collectives (parallel/spmd.py)")
     p.add_argument("--shard-opt", action="store_true",
                    help="ZeRO-1 weight-update sharding: Adam moments shard "
-                        "over the data axis (arXiv:2004.13336)")
+                        "over the data axis (arXiv:2004.13336). Works on "
+                        "both backends: jit lets GSPMD place the "
+                        "collectives, spmd hand-places reduce-scatter + "
+                        "all-gather around a sharded update")
+    p.add_argument("--num-processes", type=int, default=None, metavar="N",
+                   help="multi-host data parallelism: total process count "
+                        "of this run (each process sees only its local "
+                        "devices; batch-size stays GLOBAL and must divide "
+                        "by N). Pair with --coordinator/--process-id")
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="coordinator address for --num-processes > 1 "
+                        "(jax.distributed.initialize)")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this process's rank in [0, --num-processes) "
+                        "(rank 0 is the coordinator: it owns checkpoints, "
+                        "manifests and the canonical telemetry files)")
+    p.add_argument("--lr-scaling", default=None, choices=[None, "none", "linear"],
+                   help="large-batch LR recipe: 'linear' scales the peak "
+                        "LR by batch_size / base-batch-size "
+                        "(arXiv:1706.02677 via arXiv:1711.04325)")
+    p.add_argument("--base-batch-size", type=int, default=None,
+                   help="reference batch size the preset LR was tuned at "
+                        "(denominator of --lr-scaling linear; default 8)")
+    p.add_argument("--warmup-epochs", type=float, default=None,
+                   help="linear LR warmup from ~0 to the (scaled) peak "
+                        "over this many epochs before the cosine decay "
+                        "(large-batch stability; fractions allowed)")
+    p.add_argument("--lars", action="store_true",
+                   help="layer-wise trust-ratio scaling (LARS, "
+                        "arXiv:1708.03888) between Adam and the LR — the "
+                        "large-batch optimizer recipe. Incompatible with "
+                        "--shard-opt on the spmd backend (per-leaf norms)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each trunk block (recompute "
                         "activations in backward; saves HBM)")
@@ -267,7 +322,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "serialize + CRC-manifest on a background writer "
                         "(training blocks only if the previous save is "
                         "still in flight); emergency/final/crash saves "
-                        "stay synchronous. Single-process runtimes only")
+                        "stay synchronous. Multi-process runs keep the "
+                        "snapshot on device and every rank's writer "
+                        "thread joins the collective save")
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="persistent XLA compilation cache: compiled "
                         "programs are written here and restarts "
@@ -316,6 +373,7 @@ def cmd_train(args) -> int:
 
 def _cmd_train_impl(args, san=None) -> int:
     _apply_device(args.device)
+    _apply_distributed(args)
     if args.debug_nans:
         from replication_faster_rcnn_tpu.utils.debug import enable_nan_checks
 

@@ -314,9 +314,27 @@ class TrainConfig:
     backend: str = "auto"
     # ZeRO-1 / cross-replica weight-update sharding (arXiv:2004.13336,
     # `parallel/zero.py`): shard Adam moments over the data axis; each chip
-    # updates 1/N of the weights (reduce-scatter + all-gather via GSPMD).
-    # Auto-partitioning backend only.
+    # updates 1/N of the weights (reduce-scatter + all-gather — inserted by
+    # GSPMD on the auto-partitioning backend, hand-placed in
+    # `parallel/spmd.py` on the explicit shard_map backend; both share the
+    # per-leaf layout so checkpoints move freely between them).
     shard_opt_state: bool = False
+    # large-batch LR recipe ("Extremely Large Minibatch SGD",
+    # arXiv:1711.04325). "linear" scales the schedule's peak lr by
+    # batch_size / base_batch_size, so scaling out the data axis keeps
+    # the per-example update magnitude — set base_batch_size to the batch
+    # the configured lr was tuned at. "none" = lr used as-is (default).
+    lr_scaling: str = "none"  # none | linear
+    base_batch_size: int = 8
+    # linear LR warmup over the first warmup_epochs (fractional ok): ramps
+    # from ~0 to the (scaled) peak before the cosine schedule takes over —
+    # the large-batch stabilizer from arXiv:1711.04325. 0 = off (default).
+    warmup_epochs: float = 0.0
+    # layer-wise trust-ratio scaling (LARS-style, applied after Adam as in
+    # LAMB): each leaf's update is rescaled by |param| / |update|, bounding
+    # the per-layer relative step at very large batch. Adds an (empty)
+    # optax state entry, so flipping it invalidates optimizer checkpoints.
+    lars: bool = False
     # run the mAP evaluator on the val split every N epochs (0 = off)
     eval_every_epochs: int = 0
     # dtype for Adam's first moment (mu). bfloat16 halves the moment
@@ -390,6 +408,18 @@ class TrainConfig:
             raise ValueError(
                 "max_consecutive_skips must be >= 1, got "
                 f"{self.max_consecutive_skips}"
+            )
+        if self.lr_scaling not in ("none", "linear"):
+            raise ValueError(
+                f"lr_scaling must be 'none' or 'linear', got {self.lr_scaling!r}"
+            )
+        if self.base_batch_size < 1:
+            raise ValueError(
+                f"base_batch_size must be >= 1, got {self.base_batch_size}"
+            )
+        if self.warmup_epochs < 0:
+            raise ValueError(
+                f"warmup_epochs must be >= 0, got {self.warmup_epochs}"
             )
 
 

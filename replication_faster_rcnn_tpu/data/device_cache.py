@@ -153,6 +153,8 @@ class CachedSampler:
         scale_range=None,
         shuffle: bool = True,
         drop_last: bool = True,
+        process_index: int = 0,
+        process_count: int = 1,
     ):
         if scale_range is not None:
             lo, hi = float(scale_range[0]), float(scale_range[1])
@@ -170,6 +172,24 @@ class CachedSampler:
         self.scale_range = scale_range
         self.shuffle = bool(shuffle)
         self.drop_last = bool(drop_last)
+        # Multi-process: each process draws the SAME global epoch order (same
+        # seed) and keeps only its contiguous row block — matching the
+        # process-contiguous device order of `mesh.make_mesh` so
+        # `make_array_from_process_local_data` assembles the intended global
+        # batch. draw_decisions is keyed on the GLOBAL sample index, so
+        # augmentation is identical across topologies.
+        if not 0 <= int(process_index) < int(process_count):
+            raise ValueError(
+                f"process_index {process_index} out of range for "
+                f"process_count {process_count}"
+            )
+        if self.batch_size % int(process_count) != 0:
+            raise ValueError(
+                f"batch_size {batch_size} must divide evenly across "
+                f"{process_count} processes"
+            )
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
         self.epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
@@ -212,9 +232,11 @@ class CachedSampler:
         else:
             order = np.arange(self.n)
         bs = self.batch_size
+        local = bs // self.process_count
+        lo = self.process_index * local
         end = len(order) - (len(order) % bs if self.drop_last else 0)
         for i in range(0, end, bs):
-            yield self.selection(order[i : i + bs])
+            yield self.selection(order[i + lo : i + lo + local])
 
 
 def stack_selections(sels) -> Dict[str, np.ndarray]:

@@ -158,9 +158,31 @@ class DataLoader:
         stall_timeout: float = 120.0,
         cache_ram: bool = False,
         sample_skip_budget: int = 8,
+        process_index: int = 0,
+        process_count: int = 1,
     ) -> None:
         if worker_mode not in ("thread", "process"):
             raise ValueError(f"worker_mode must be thread|process, got {worker_mode!r}")
+        if process_count < 1 or not 0 <= process_index < process_count:
+            raise ValueError(
+                f"process_index={process_index} out of range for "
+                f"process_count={process_count}"
+            )
+        if batch_size % process_count:
+            raise ValueError(
+                f"global batch_size={batch_size} must divide evenly over "
+                f"{process_count} processes"
+            )
+        # multi-process data sharding: every process draws the SAME
+        # deterministic global epoch order (seed + epoch), then each keeps
+        # only its contiguous rows of every global batch — matching the
+        # mesh's process-contiguous device order, so
+        # `parallel.shard_batch` can assemble the global array from local
+        # rows with zero cross-host traffic. Augment draws key on the
+        # GLOBAL sample index, so the global batch content is independent
+        # of the process count (topology-change-tolerant resume).
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
         self.stall_timeout = float(stall_timeout)
         self.augment_hflip = augment_hflip
         self.augment_scale = augment_scale
@@ -248,8 +270,12 @@ class DataLoader:
         order = self._order()
         bs = self.batch_size
         end = len(order) - (len(order) % bs if self.drop_last else 0)
+        local = bs // self.process_count
+        lo = self.process_index * local
         for i in range(0, end, bs):
-            yield order[i : i + bs]
+            # this process's contiguous block of the global batch (the
+            # whole batch in single-process runs: lo=0, local=bs)
+            yield order[i + lo : i + lo + local]
 
     def _epoch_dataset(self):
         """The dataset view for the current epoch: identity, or the

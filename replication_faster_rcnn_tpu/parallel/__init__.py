@@ -4,6 +4,7 @@ from replication_faster_rcnn_tpu.parallel.mesh import (  # noqa: F401
     gather_replicated,
     image_sharding,
     initialize_distributed,
+    is_coordinator,
     make_mesh,
     replicate_tree,
     replicated,

@@ -14,7 +14,11 @@ workload itself is data-parallel.
 
 Multi-host: `initialize_distributed()` wraps `jax.distributed.initialize`,
 after which `jax.devices()` spans all hosts and the same mesh/sharding code
-scales out over DCN unchanged.
+scales out over DCN unchanged. Each process feeds only its own rows of the
+global batch (the loaders shard deterministically by ``process_index``) and
+`shard_batch`/`shard_stacked_batch` assemble them into one global array via
+`jax.make_array_from_process_local_data`; job-wide writes (checkpoints,
+manifests, telemetry) are gated on `is_coordinator()`.
 """
 
 from __future__ import annotations
@@ -40,11 +44,27 @@ def initialize_distributed(
     if num_processes is None:
         num_processes = int(os.environ.get("NUM_PROCESSES", "1"))
     if num_processes > 1:
+        # the CPU backend ships no cross-process collectives by default
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend"); gloo is the supported implementation and a no-op on
+        # accelerator platforms, where collectives ride ICI/DCN
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # older/newer jaxlib without the option: keep defaults
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
+
+
+def is_coordinator() -> bool:
+    """True on the process that owns job-wide side effects: checkpoint
+    manifests, metric/telemetry files, progress logs. THE guard for
+    multi-process writes — route every ``process_index() == 0`` check
+    through here so the coordinator policy has one definition."""
+    return jax.process_index() == 0
 
 
 def fit_data_parallelism(batch_size: int, n_devices: int) -> int:
@@ -110,12 +130,29 @@ def validate_parallel(config, n_devices: Optional[int] = None) -> None:
     mesh-vs-device-count fit. ``n_devices`` defaults to every visible
     device; pass the size of an explicit device subset if using one."""
     validate_spatial(config)
-    if config.train.shard_opt_state and config.train.backend == "spmd":
+    if (
+        config.train.shard_opt_state
+        and config.train.backend == "spmd"
+        and config.train.lars
+    ):
         raise ValueError(
-            "shard_opt_state (ZeRO-1 weight-update sharding) requires "
-            "the jit auto-partitioning backend; the shard_map backend "
-            "replicates state by construction"
+            "lars trust ratios need full-leaf norms, but the shard_map "
+            "ZeRO-1 backend updates 1/N parameter slices (partial norms); "
+            "use the jit auto-partitioning backend (backend='auto') for "
+            "lars + shard_opt_state"
         )
+    if jax.process_count() > 1:
+        if config.mesh.spatial:
+            raise ValueError(
+                "spatial partitioning is single-process only: the "
+                "per-process feed ships batch rows, not image-row shards"
+            )
+        if config.train.batch_size % jax.process_count():
+            raise ValueError(
+                f"global batch_size={config.train.batch_size} must divide "
+                f"evenly over {jax.process_count()} processes (each feeds "
+                "its own contiguous rows of the global batch)"
+            )
     n = n_devices if n_devices is not None else len(jax.devices())
     n_model = max(1, config.mesh.num_model)
     if config.mesh.num_data > 0:
@@ -181,6 +218,23 @@ def stacked_batch_sharding(mesh: Mesh, cfg: MeshConfig) -> NamedSharding:
     return NamedSharding(mesh, P(None, cfg.data_axis))
 
 
+def _put_sharded(x: np.ndarray, sharding: NamedSharding, batch_dim: int) -> jax.Array:
+    """Stage one host array onto a batch-sharded layout.
+
+    Single-process: a plain ``device_put``. Multi-process: ``x`` holds only
+    THIS process's contiguous rows of the global batch (the loaders shard
+    by ``process_index``), and `jax.make_array_from_process_local_data`
+    assembles the global array — each process's rows land on its own
+    addressable devices, matching the mesh's process-contiguous device
+    order, with no cross-host data movement."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    x = np.ascontiguousarray(x)
+    shape = list(np.shape(x))
+    shape[batch_dim] *= jax.process_count()
+    return jax.make_array_from_process_local_data(sharding, x, tuple(shape))
+
+
 def shard_stacked_batch(
     batch: Dict[str, np.ndarray], mesh: Mesh, cfg: MeshConfig
 ) -> Dict[str, jax.Array]:
@@ -198,7 +252,7 @@ def shard_stacked_batch(
         img_sharding = sharding
 
     def put(k: str, x: np.ndarray) -> jax.Array:
-        return jax.device_put(x, img_sharding if k == "image" else sharding)
+        return _put_sharded(x, img_sharding if k == "image" else sharding, 1)
 
     return {k: put(k, v) for k, v in batch.items()}
 
@@ -209,12 +263,13 @@ def shard_batch(
     """Host batch -> device arrays with the batch dim laid out over the data
     axis (each chip receives only its shard; XLA's equivalent of DDP's
     per-rank loader). Image tensors additionally shard rows over the model
-    axis when spatial partitioning is on (`image_sharding`)."""
+    axis when spatial partitioning is on (`image_sharding`). Multi-process,
+    each process passes its local rows only (`_put_sharded`)."""
     sharding = batch_sharding(mesh, cfg)
     img_sharding = image_sharding(mesh, cfg)
 
     def put(k: str, x: np.ndarray) -> jax.Array:
-        return jax.device_put(x, img_sharding if k == "image" else sharding)
+        return _put_sharded(x, img_sharding if k == "image" else sharding, 0)
 
     return {k: put(k, v) for k, v in batch.items()}
 
@@ -243,10 +298,35 @@ def stage_to_devices(
     return out
 
 
+def put_host_tree(tree: Any, shardings: Any) -> Any:
+    """Place host values onto (possibly cross-process) shardings.
+
+    Single-process: one batched ``device_put``. Multi-process: a plain
+    ``device_put`` onto shardings that span other processes issues
+    untagged gloo collectives whose per-leaf order differs between ranks
+    (observed as `op.preamble.length <= op.nbytes` aborts in the
+    2-process ZeRO preemption test); `jax.make_array_from_callback`
+    instead builds every leaf from THIS process's slice of the host copy
+    — purely local, no wire traffic, identical on every topology.
+    ``shardings`` is a matching pytree of shardings or one sharding for
+    the whole tree."""
+    if jax.process_count() == 1:
+        return jax.device_put(tree, shardings)
+    if isinstance(shardings, jax.sharding.Sharding):
+        shardings = jax.tree_util.tree_map(lambda _: shardings, tree)
+
+    def put(leaf, sharding):
+        arr = np.asarray(leaf)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx, a=arr: a[idx]
+        )
+
+    return jax.tree_util.tree_map(put, tree, shardings)
+
+
 def replicate_tree(tree: Any, mesh: Mesh) -> Any:
     """Place a pytree fully-replicated on the mesh (params, opt state)."""
-    sharding = replicated(mesh)
-    return jax.device_put(tree, sharding)
+    return put_host_tree(tree, replicated(mesh))
 
 
 @functools.lru_cache(maxsize=None)

@@ -18,7 +18,15 @@ loop yourself, in XLA collectives:
     (``lax.axis_index`` offset), so target subsampling draws the same
     randomness as the auto-partitioned step;
   * gradients are `lax.psum`'d, then every shard applies the identical
-    optimizer update to its replicated state.
+    optimizer update to its replicated state — or, under
+    ``train.shard_opt_state`` (ZeRO-1, arXiv:2004.13336), each shard
+    `lax.psum_scatter`s the gradients straight into its 1/N slice, updates
+    only that slice of the parameters against its local slice of the Adam
+    moments, and `lax.all_gather`s the updated slices back to full
+    parameters. Same bytes on the wire as the allreduce it replaces, 1/N
+    of the update FLOPs and moment memory per shard; the per-leaf slice
+    layout is `parallel/zero.py`'s ``shard_dim`` rule, shared with the jit
+    auto-partitioning backend so checkpoints move freely between the two.
 
 Because of the four properties above, this step computes the same update
 as the jit auto-partitioned step up to floating-point reduction order —
@@ -39,11 +47,13 @@ from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from replication_faster_rcnn_tpu.config import FasterRCNNConfig
 from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
+from replication_faster_rcnn_tpu.parallel import zero
 from replication_faster_rcnn_tpu.train import fault
 from replication_faster_rcnn_tpu.train.train_step import TrainState, compute_losses
 
@@ -67,10 +77,21 @@ def make_shard_map_train_step(
     tx: optax.GradientTransformation,
     mesh: Mesh,
     steps_per_dispatch: int = 1,
+    state_template: TrainState = None,
 ):
     """Build the explicitly-collectivized (state, batch) -> (state, metrics)
     step. State must be replicated on ``mesh``; batch arrays sharded on
     their leading dim over the data axis (`parallel.shard_batch`).
+
+    Under ``config.train.shard_opt_state`` (ZeRO-1) the state is instead
+    placed with `parallel.zero.train_state_shardings(shard_opt=True)` —
+    optimizer-state leaves arrive as this shard's 1/N slice — and
+    ``state_template`` (the TrainState, concrete or abstract: only leaf
+    shapes are read, at trace time) is required to derive the per-leaf
+    slice layout. The step then reduce-scatters gradients, updates slices,
+    and all-gathers the updated parameters; in/out state shardings match
+    the jit backend's, so the two ZeRO implementations are checkpoint- and
+    placement-compatible.
 
     ``steps_per_dispatch`` > 1 fuses K steps into the one shard_map call:
     the per-shard body `lax.scan`s over batches stacked on a NEW leading
@@ -152,6 +173,168 @@ def make_shard_map_train_step(
         metrics.update(health)
         return new_state, metrics
 
+    n_shards = mesh.shape[axis]
+    shard_opt = bool(config.train.shard_opt_state) and n_shards > 1
+    if shard_opt and state_template is None:
+        raise ValueError(
+            "shard_opt_state on the shard_map backend needs a "
+            "state_template (the TrainState, concrete or abstract) to "
+            "derive the per-leaf ZeRO-1 slice layout"
+        )
+    if shard_opt:
+        # ZeRO-1 by hand. Per-leaf slice dims come from the FULL shapes of
+        # the template (inside the body every sharded leaf is local, so
+        # the layout must be closed over, never recomputed from local
+        # shapes). -1 marks a leaf the layout rule keeps replicated.
+        param_dims = jax.tree_util.tree_map(
+            lambda leaf: zero.shard_dim(np.shape(leaf), n_shards),
+            state_template.params,
+        )
+        state_specs = jax.tree_util.tree_map(lambda _: P(), state_template)
+        state_specs = state_specs.replace(
+            opt_state=jax.tree_util.tree_map(
+                lambda leaf: zero.shard_spec(np.shape(leaf), n_shards, axis),
+                state_template.opt_state,
+            )
+        )
+
+        def _reduce_grad(g, d):
+            # the restructured allreduce: shardable leaves reduce-scatter
+            # straight into this shard's slice (same wire bytes, 1/N the
+            # output); unshardable ones keep the plain psum
+            if d >= 0:
+                return jax.lax.psum_scatter(
+                    g, axis, scatter_dimension=d, tiled=True
+                )
+            return jax.lax.psum(g, axis)
+
+        def _slice(leaf, d):
+            if d < 0:
+                return leaf
+            size = leaf.shape[d] // n_shards
+            start = jax.lax.axis_index(axis) * size
+            return jax.lax.dynamic_slice_in_dim(leaf, start, size, d)
+
+        def _gather(leaf, d):
+            if d < 0:
+                return leaf
+            return jax.lax.all_gather(leaf, axis, axis=d, tiled=True)
+
+        def _sharded_sumsq(tree, dims, local_fn):
+            # sum(local_fn over sliced leaves) psums to the global value;
+            # replicated leaves contribute theirs directly on every shard
+            xs = jax.tree_util.tree_leaves(tree)
+            ds = jax.tree_util.tree_leaves(dims)
+            zero_ = jnp.zeros((), jnp.float32)
+            local = sum(
+                (local_fn(x) for x, d in zip(xs, ds) if d >= 0), zero_
+            )
+            repl = sum(
+                (local_fn(x) for x, d in zip(xs, ds) if d < 0), zero_
+            )
+            return jax.lax.psum(local, axis) + repl
+
+        def _sumsq(x):
+            return jnp.sum(jnp.square(x.astype(jnp.float32)))
+
+        def _nonfin(x):
+            if not jnp.issubdtype(x.dtype, jnp.inexact):
+                return jnp.zeros((), jnp.float32)
+            return jnp.sum(~jnp.isfinite(x)).astype(jnp.float32)
+
+        def per_shard_zero(
+            state: TrainState, batch: Dict[str, Array]
+        ) -> Tuple[TrainState, Dict[str, Array]]:
+            # identical forward/backward to per_shard; params arrive full
+            # (replicated), opt-state leaves arrive as this shard's slice
+            step_rng = jax.random.fold_in(state.rng, state.step)
+            n_local = batch["image"].shape[0]
+            positions = jax.lax.axis_index(axis) * n_local + jnp.arange(
+                n_local, dtype=jnp.int32
+            )
+
+            def loss_fn(params):
+                return compute_losses(
+                    model, cfg, params, state.batch_stats, batch, step_rng,
+                    True, axis_name=axis, positions=positions,
+                )
+
+            (_, (metrics, new_stats)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
+            metrics = jax.lax.psum(metrics, axis)
+
+            if allreduce_dt != jnp.float32:
+                dtypes = jax.tree_util.tree_map(lambda g: g.dtype, grads)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(allreduce_dt)
+                    if jnp.issubdtype(g.dtype, jnp.floating)
+                    else g,
+                    grads,
+                )
+                grads = jax.tree_util.tree_map(_reduce_grad, grads, param_dims)
+                grads = jax.tree_util.tree_map(
+                    lambda g, dt: g.astype(dt), grads, dtypes
+                )
+            else:
+                grads = jax.tree_util.tree_map(_reduce_grad, grads, param_dims)
+
+            # this shard's parameter slices; the optimizer chain is
+            # elementwise (add_decayed_weights / scale_by_adam / lr), so
+            # updating slices against the local moment slices computes
+            # exactly the slice of the full update
+            param_sl = jax.tree_util.tree_map(_slice, state.params, param_dims)
+            updates, new_opt = tx.update(grads, state.opt_state, param_sl)
+            new_param_sl = optax.apply_updates(param_sl, updates)
+
+            # health on sharded trees: psum'd sums-of-squares reproduce the
+            # replicated backend's global norms (same numbers, modulo
+            # reduction order) and the nonfinite gate stays GLOBAL — every
+            # shard takes the same branch below
+            grad_norm = jnp.sqrt(_sharded_sumsq(grads, param_dims, _sumsq))
+            update_norm = jnp.sqrt(_sharded_sumsq(updates, param_dims, _sumsq))
+            param_norm = optax.global_norm(state.params)
+            nonfinite = _sharded_sumsq(grads, param_dims, _nonfin)
+            health = {
+                "grad_norm": grad_norm,
+                "param_norm": param_norm,
+                "update_norm": update_norm,
+                "update_ratio": update_norm / (param_norm + 1e-12),
+                "nonfinite_count": nonfinite,
+            }
+            if config.train.nonfinite_policy == "apply":
+                health["skipped"] = jnp.zeros((), jnp.float32)
+                sel_p, sel_opt, sel_stats = new_param_sl, new_opt, new_stats
+            else:
+                ok = nonfinite == 0
+
+                def keep(new, old):
+                    # select BEFORE the gather: on a skipped step every
+                    # shard contributes its OLD slice, so the gathered
+                    # params are bit-identical to the pre-step tree
+                    return jnp.where(ok, new, old)
+
+                sel_p = jax.tree_util.tree_map(keep, new_param_sl, param_sl)
+                sel_opt = jax.tree_util.tree_map(keep, new_opt, state.opt_state)
+                sel_stats = jax.tree_util.tree_map(
+                    keep, new_stats, state.batch_stats
+                )
+                health["skipped"] = 1.0 - ok.astype(jnp.float32)
+            metrics.update(health)
+
+            new_params = jax.tree_util.tree_map(_gather, sel_p, param_dims)
+            new_state = state.replace(
+                step=state.step + 1,
+                params=new_params,
+                batch_stats=sel_stats,
+                opt_state=sel_opt,
+            )
+            return new_state, metrics
+
+        step_body, state_spec = per_shard_zero, state_specs
+    else:
+        step_body, state_spec = per_shard, P()
+
     if steps_per_dispatch > 1:
         # fused K-step body: scan INSIDE the shard_map so the psums run
         # once per fused step while the carry state stays in-program. The
@@ -162,20 +345,22 @@ def make_shard_map_train_step(
                 fused_scan_unroll,
             )
 
+            # the carry keeps the step body's state layout (sliced opt
+            # leaves under ZeRO), so K-step fusion composes unchanged
             return jax.lax.scan(
-                per_shard, state, batches, length=steps_per_dispatch,
+                step_body, state, batches, length=steps_per_dispatch,
                 unroll=fused_scan_unroll(steps_per_dispatch),
             )
 
         body, batch_spec = per_shard_multi, P(None, axis)
     else:
-        body, batch_spec = per_shard, P(axis)
+        body, batch_spec = step_body, P(axis)
 
     sharded = _shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(), batch_spec),
-        out_specs=(P(), P()),
+        in_specs=(state_spec, batch_spec),
+        out_specs=(state_spec, P()),
         **_NO_CHECK,
     )
     return jax.jit(sharded, donate_argnums=(0,)), model

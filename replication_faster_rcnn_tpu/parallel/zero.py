@@ -10,21 +10,29 @@ parameters, and GSPMD turns the gradient allreduce into
 reduce-scatter + all-gather around the update (same bytes on the wire as a
 plain allreduce, 1/N of the update FLOPs and moment memory per chip).
 
-Here this is expressed purely through sharding annotations (the GSPMD
-recipe, no manual collectives): optimizer-state leaves get a
-``NamedSharding`` that splits their largest evenly-divisible dimension over
-the data axis; parameters stay replicated in the step's out_shardings, so
-the forward pass is unchanged. ``jax.jit`` then places the
-reduce-scatter/all-gather automatically.
+Two implementations share the leaf layout below (`shard_dim`):
 
-Enabled by ``train.shard_opt_state`` / CLI ``--shard-opt`` (jit
-auto-partitioning backend only — the explicit shard_map backend replicates
-state by construction).
+* **jit auto-partitioning backend** — expressed purely through sharding
+  annotations (the GSPMD recipe, no manual collectives): optimizer-state
+  leaves get a ``NamedSharding`` that splits their largest
+  evenly-divisible dimension over the data axis; parameters stay
+  replicated in the step's out_shardings, so the forward pass is
+  unchanged. ``jax.jit`` then places the reduce-scatter/all-gather
+  automatically.
+* **explicit shard_map backend** — `parallel/spmd.py` places the same
+  collectives BY HAND (`lax.psum_scatter` of the gradients into per-shard
+  slices, sliced Adam update, `lax.all_gather` of the updated parameter
+  slices), against per-leaf shard_map in/out_specs built from the same
+  `shard_dim` rule, so a checkpoint moves between backends without
+  re-sharding.
+
+Enabled by ``train.shard_opt_state`` / CLI ``--shard-opt`` on either
+backend.
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Sequence
 
 import jax
 import numpy as np
@@ -33,20 +41,35 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from replication_faster_rcnn_tpu.config import MeshConfig
 
 
+def shard_dim(shape: Sequence[int], n: int) -> int:
+    """The dimension ZeRO-1 splits over an ``n``-way data axis: the
+    largest dim divisible by ``n``, or -1 when the leaf must stay
+    replicated (scalars, indivisible shapes, n <= 1). Single source of
+    the layout rule — both the GSPMD annotations here and the shard_map
+    backend's hand-placed collectives (`parallel/spmd.py`) key off it."""
+    if n <= 1 or not shape:
+        return -1
+    divisible = [d for d, s in enumerate(shape) if s % n == 0 and s >= n]
+    if not divisible:
+        return -1
+    return max(divisible, key=lambda d: shape[d])
+
+
+def shard_spec(shape: Sequence[int], n: int, axis_name: str) -> P:
+    """`shard_dim` as a PartitionSpec (replicated P() when unshardable)."""
+    d = shard_dim(shape, n)
+    if d < 0:
+        return P()
+    spec = [None] * len(shape)
+    spec[d] = axis_name
+    return P(*spec)
+
+
 def _leaf_sharding(leaf: Any, mesh: Mesh, cfg: MeshConfig) -> NamedSharding:
     """Shard the largest dim divisible by the data-axis size; scalars and
     indivisible shapes stay replicated."""
     n = mesh.shape[cfg.data_axis]
-    shape = np.shape(leaf)
-    if n <= 1 or not shape:
-        return NamedSharding(mesh, P())
-    divisible = [d for d, s in enumerate(shape) if s % n == 0 and s >= n]
-    if not divisible:
-        return NamedSharding(mesh, P())
-    best = max(divisible, key=lambda d: shape[d])
-    spec = [None] * len(shape)
-    spec[best] = cfg.data_axis
-    return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, shard_spec(np.shape(leaf), n, cfg.data_axis))
 
 
 def opt_state_shardings(opt_state: Any, mesh: Mesh, cfg: MeshConfig) -> Any:
@@ -72,5 +95,8 @@ def train_state_shardings(
 
 def place_train_state(state: Any, shardings: Any) -> Any:
     """Place the whole state pytree onto its target shardings (one batched
-    device_put, as in `mesh.replicate_tree`)."""
-    return jax.device_put(state, shardings)
+    device_put single-process; a local per-shard build on multi-process
+    runs — see `mesh.put_host_tree`)."""
+    from replication_faster_rcnn_tpu.parallel.mesh import put_host_tree
+
+    return put_host_tree(state, shardings)

@@ -11,14 +11,38 @@ without importing jax.
 
 from __future__ import annotations
 
+import glob
 import json
 import os
-from typing import Any, Dict, List, Optional
+import re
+from typing import Any, Dict, List, Optional, Tuple
 
 TRACE_FILE = "trace.json"
 METRICS_FILE = "metrics.jsonl"
 WATCHDOG_FILE = "watchdog.jsonl"
 PROGRESS_FILE = "progress.json"
+
+# Multi-process runs write the coordinator's artifacts under the plain
+# names above and every other rank's under ``<stem>.rank<N>.<ext>``
+# (trainer.py::_rank_file). The report merges all of them.
+_RANK_RE = re.compile(r"\.rank(\d+)\.[^.]+$")
+
+
+def rank_variants(run_dir: str, name: str) -> List[Tuple[int, str]]:
+    """(rank, path) for every per-rank variant of ``name`` present in
+    ``run_dir``: the plain file is rank 0 (the coordinator), plus any
+    ``stem.rankN.ext`` siblings, sorted by rank."""
+    out: List[Tuple[int, str]] = []
+    base = os.path.join(run_dir, name)
+    if os.path.exists(base):
+        out.append((0, base))
+    stem, ext = os.path.splitext(name)
+    for path in glob.glob(os.path.join(run_dir, f"{stem}.rank*{ext}")):
+        m = _RANK_RE.search(path)
+        if m:
+            out.append((int(m.group(1)), path))
+    out.sort()
+    return out
 
 # Health/throughput keys worth surfacing from the JSONL, in display order.
 _HEALTH_KEYS = (
@@ -105,11 +129,14 @@ def overlap_summary(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     any other thread are the device stager's producer doing that work
     overlapped (``data.prefetch_device``). Returns None when the trace
     has no dispatch spans (nothing to be blocked against)."""
+    # key threads by (pid, tid): merged per-rank traces can reuse tid
+    # values across processes, and a rank's producer thread must not be
+    # mistaken for another rank's dispatch thread
     dispatch_tids = set()
     dispatch_ms = 0.0
     for ev in events:
         if ev.get("ph") == "X" and ev.get("name") == "step/dispatch":
-            dispatch_tids.add(ev.get("tid"))
+            dispatch_tids.add((ev.get("pid"), ev.get("tid")))
             dispatch_ms += float(ev.get("dur", 0.0)) / 1e3
     if not dispatch_tids:
         return None
@@ -119,7 +146,7 @@ def overlap_summary(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
         if ev.get("ph") != "X" or not str(ev.get("name", "")).startswith("data/"):
             continue
         dur_ms = float(ev.get("dur", 0.0)) / 1e3
-        if ev.get("tid") in dispatch_tids:
+        if (ev.get("pid"), ev.get("tid")) in dispatch_tids:
             blocked_ms += dur_ms
         else:
             overlapped_ms += dur_ms
@@ -156,22 +183,45 @@ def health_summary(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
 
 def summarize_run(run_dir: str) -> Dict[str, Any]:
     summary: Dict[str, Any] = {"run_dir": run_dir, "artifacts": []}
-    trace_path = os.path.join(run_dir, TRACE_FILE)
-    if os.path.exists(trace_path):
-        summary["artifacts"].append(TRACE_FILE)
-        events = load_trace_events(trace_path)
+    ranks_seen: set = set()
+
+    traces = rank_variants(run_dir, TRACE_FILE)
+    if traces:
+        events: List[Dict[str, Any]] = []
+        for rank, path in traces:
+            summary["artifacts"].append(os.path.basename(path))
+            ranks_seen.add(rank)
+            events.extend(load_trace_events(path))
         summary["phases"] = phase_table(events)
         overlap = overlap_summary(events)
         if overlap is not None:
             summary["overlap"] = overlap
-    metrics_path = os.path.join(run_dir, METRICS_FILE)
-    if os.path.exists(metrics_path):
-        summary["artifacts"].append(METRICS_FILE)
-        summary["health"] = health_summary(load_jsonl(metrics_path))
-    wd_path = os.path.join(run_dir, WATCHDOG_FILE)
-    if os.path.exists(wd_path):
-        summary["artifacts"].append(WATCHDOG_FILE)
-        incidents = load_jsonl(wd_path)
+
+    metric_files = rank_variants(run_dir, METRICS_FILE)
+    if metric_files:
+        rows: List[Dict[str, Any]] = []
+        per_rank: Dict[int, Dict[str, Any]] = {}
+        for rank, path in metric_files:
+            summary["artifacts"].append(os.path.basename(path))
+            ranks_seen.add(rank)
+            rank_rows = load_jsonl(path)
+            rows.extend(rank_rows)
+            step_rows = [r for r in rank_rows if "step" in r]
+            per_rank[rank] = {
+                "rows": len(step_rows),
+                "last_step": step_rows[-1].get("step") if step_rows else None,
+            }
+        summary["health"] = health_summary(rows)
+        if len(metric_files) > 1:
+            summary["health"]["per_rank"] = per_rank
+
+    wd_files = rank_variants(run_dir, WATCHDOG_FILE)
+    if wd_files:
+        incidents = []
+        for rank, path in wd_files:
+            summary["artifacts"].append(os.path.basename(path))
+            ranks_seen.add(rank)
+            incidents.extend(load_jsonl(path))
         summary["incidents"] = {
             "stalls": sum(1 for i in incidents if i.get("kind") == "stall"),
             "recoveries": sum(1 for i in incidents if i.get("kind") == "recovered"),
@@ -182,11 +232,22 @@ def summarize_run(run_dir: str) -> Dict[str, Any]:
             },
             "events": incidents,
         }
-    progress_path = os.path.join(run_dir, PROGRESS_FILE)
-    if os.path.exists(progress_path):
-        summary["artifacts"].append(PROGRESS_FILE)
-        with open(progress_path) as f:
-            summary["progress"] = json.load(f)
+
+    progress_files = rank_variants(run_dir, PROGRESS_FILE)
+    if progress_files:
+        by_rank: Dict[int, Dict[str, Any]] = {}
+        for rank, path in progress_files:
+            summary["artifacts"].append(os.path.basename(path))
+            ranks_seen.add(rank)
+            with open(path) as f:
+                by_rank[rank] = json.load(f)
+        # the coordinator's heartbeat keeps the historical key; other
+        # ranks' heartbeats ride alongside
+        summary["progress"] = by_rank.get(0) or by_rank[min(by_rank)]
+        if len(by_rank) > 1:
+            summary["progress_by_rank"] = by_rank
+    if len(ranks_seen) > 1:
+        summary["ranks"] = sorted(ranks_seen)
     try:  # a --profile device capture next to the host spans?
         from replication_faster_rcnn_tpu.utils.xplane import has_device_trace
 
@@ -199,6 +260,12 @@ def summarize_run(run_dir: str) -> Dict[str, Any]:
 def format_report(summary: Dict[str, Any]) -> str:
     """Human-readable rendering of :func:`summarize_run`."""
     lines = [f"telemetry report: {summary['run_dir']}"]
+    ranks = summary.get("ranks")
+    if ranks:
+        lines.append(
+            f"  multi-process run: {len(ranks)} ranks "
+            f"({', '.join(str(r) for r in ranks)}) — artifacts merged"
+        )
     if not summary["artifacts"]:
         lines.append("  no telemetry artifacts found "
                      f"({TRACE_FILE}/{METRICS_FILE}/{WATCHDOG_FILE})")
@@ -245,6 +312,11 @@ def format_report(summary: Dict[str, Any]) -> str:
                 f"  {key:<18} last {vals['last']:<12.5g} "
                 f"min {vals['min']:<12.5g} max {vals['max']:<12.5g}"
             )
+        for rank, info in sorted(health.get("per_rank", {}).items()):
+            lines.append(
+                f"  rank {rank}: {info['rows']} step rows, "
+                f"last step {info['last_step']}"
+            )
 
     incidents = summary.get("incidents")
     if incidents is not None:
@@ -268,10 +340,18 @@ def format_report(summary: Dict[str, Any]) -> str:
     progress = summary.get("progress")
     if progress is not None:
         lines.append("")
-        lines.append(
-            f"last heartbeat: step={progress.get('step')} "
-            f"phase={progress.get('phase')} at {progress.get('utc')}"
-        )
+        by_rank = summary.get("progress_by_rank")
+        if by_rank:
+            for rank, p in sorted(by_rank.items()):
+                lines.append(
+                    f"last heartbeat (rank {rank}): step={p.get('step')} "
+                    f"phase={p.get('phase')} at {p.get('utc')}"
+                )
+        else:
+            lines.append(
+                f"last heartbeat: step={progress.get('step')} "
+                f"phase={progress.get('phase')} at {progress.get('utc')}"
+            )
     if summary.get("device_trace"):
         lines.append("")
         lines.append(

@@ -82,9 +82,17 @@ class SpanTracer:
 
     enabled = True
 
-    def __init__(self, path: Optional[str] = None, max_events: int = 200_000):
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        max_events: int = 200_000,
+        rank: Optional[int] = None,
+    ):
         self.path = path
         self.max_events = max_events
+        # process_index of a multi-process run: stamped on every event (so
+        # merged per-rank traces stay attributable) and into otherData
+        self.rank = rank
         self._events: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
@@ -99,6 +107,8 @@ class SpanTracer:
         return (time.perf_counter() - self._t0) * 1e6
 
     def _emit(self, event: Dict[str, Any]) -> None:
+        if self.rank is not None:
+            event.setdefault("args", {})["process_index"] = self.rank
         with self._lock:
             if len(self._events) < self.max_events:
                 self._events.append(event)
@@ -164,13 +174,16 @@ class SpanTracer:
         with self._lock:
             events = list(self._events)
             dropped = self._dropped
+        other: Dict[str, Any] = {
+            "start_unix_time": self._wall0,
+            "dropped_events": dropped,
+        }
+        if self.rank is not None:
+            other["process_index"] = self.rank
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",
-            "otherData": {
-                "start_unix_time": self._wall0,
-                "dropped_events": dropped,
-            },
+            "otherData": other,
         }
 
     def flush(self, path: Optional[str] = None) -> None:

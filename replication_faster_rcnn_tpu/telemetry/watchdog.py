@@ -44,6 +44,9 @@ class StallWatchdog:
             — a snapshot of a sick process must not die on a sick gauge).
         on_stall: optional callback invoked with the snapshot dict.
         poll_s: check interval; defaults to ``timeout_s / 4`` capped to 5s.
+        rank: process_index of a multi-process run — stamped on every
+            incident and progress payload so merged per-rank incident
+            streams stay attributable (None on single-process runs).
     """
 
     def __init__(
@@ -56,8 +59,10 @@ class StallWatchdog:
         on_stall: Optional[Callable[[Dict[str, Any]], None]] = None,
         poll_s: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
+        rank: Optional[int] = None,
     ):
         self.timeout_s = timeout_s
+        self.rank = rank
         self.snapshot_path = snapshot_path
         self.progress_path = progress_path
         self.tracer = tracer
@@ -107,6 +112,8 @@ class StallWatchdog:
             "beats": self._beats,
             "pid": os.getpid(),
         }
+        if self.rank is not None:
+            payload["process_index"] = self.rank
         tmp = f"{self.progress_path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "w") as f:
@@ -188,6 +195,8 @@ class StallWatchdog:
                 "beats": self._beats,
                 "pid": os.getpid(),
             }
+            if self.rank is not None:
+                snap["process_index"] = self.rank
         try:
             snap["last_span"] = self.tracer.last_span
         except Exception as e:  # pragma: no cover - defensive

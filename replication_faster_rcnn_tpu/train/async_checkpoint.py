@@ -28,6 +28,13 @@ stderr warning, training continues, the next interval retries). The
 failed step's manifest was never renamed into place, so a torn orbax
 directory is exactly what `verified_restore`'s fallback walk already
 handles.
+
+Multi-process runs use the same writer: every rank owns one (the
+trainer submits a closure over FRESH device buffers rather than a host
+snapshot — see `Trainer._save_async`), the writer threads execute the
+collective orbax save in lockstep, and orbax's replica election keeps
+process 0 the only byte writer. The drain points are identical on all
+ranks, so no rank can start save N+1 while another is still in save N.
 """
 
 from __future__ import annotations

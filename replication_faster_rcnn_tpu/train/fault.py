@@ -360,6 +360,28 @@ def manifest_path(workdir: str, step: int) -> str:
     )
 
 
+def run_topology(config=None, mesh=None) -> Dict[str, Any]:
+    """The runtime topology a checkpoint was saved under: process count,
+    global device count, mesh shape, and whether the optimizer state was
+    ZeRO-sharded at save time. Provenance, not a restore constraint —
+    checkpoints are saved fully replicated (host-gathered), so
+    `verified_restore` re-places them onto whatever mesh the restoring
+    run built (a preempted 2-proc×4-dev run resumes on 1-proc×8-dev and
+    vice versa); the CRCs are computed on the gathered host tree and are
+    therefore topology-invariant."""
+    topo: Dict[str, Any] = {
+        "process_count": jax.process_count(),
+        "device_count": jax.device_count(),
+    }
+    if mesh is not None:
+        topo["mesh_shape"] = {
+            str(name): int(size) for name, size in mesh.shape.items()
+        }
+    if config is not None:
+        topo["shard_opt_state"] = bool(config.train.shard_opt_state)
+    return topo
+
+
 def write_manifest(
     workdir: str,
     step: int,
@@ -367,13 +389,15 @@ def write_manifest(
     config=None,
     kind: str = "scheduled",
     writer: str = "sync",
+    topology: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Sidecar manifest for the checkpoint at ``step``: leaf count +
-    per-leaf CRC32/shape/dtype of the saved tree, the config hash, and
-    the save ``kind`` (scheduled | emergency | crash | final). Written
-    atomically next to — not inside — the orbax step directory, so orbax
-    never sees a foreign file and a manifest for a garbage-collected
-    step is merely stale, not corrupting.
+    per-leaf CRC32/shape/dtype of the saved tree, the config hash, the
+    save ``kind`` (scheduled | emergency | crash | final), and the saving
+    run's topology (:func:`run_topology` unless passed explicitly).
+    Written atomically next to — not inside — the orbax step directory,
+    so orbax never sees a foreign file and a manifest for a
+    garbage-collected step is merely stale, not corrupting.
 
     ``writer`` records whether the save ran on the trainer thread
     ("sync") or the background checkpoint writer ("async",
@@ -387,6 +411,7 @@ def write_manifest(
         "writer": writer,
         "saved_utc": datetime.now(timezone.utc).isoformat(),
         "config_hash": config_hash(config) if config is not None else None,
+        "topology": topology if topology is not None else run_topology(config),
         "leaf_count": len(leaves),
         "leaves": leaves,
     }
@@ -533,6 +558,21 @@ def verified_restore(
             log(
                 f"fault: fell back to verified step {s} after discarding "
                 f"{[d[0] for d in discarded]}"
+            )
+        saved_topo = manifest.get("topology") or {}
+        current = run_topology()
+        drift = {
+            k: (saved_topo[k], current[k])
+            for k in ("process_count", "device_count")
+            if k in saved_topo and saved_topo[k] != current[k]
+        }
+        if drift:
+            # informational: state is saved fully replicated, so the
+            # caller re-places it onto the current mesh bit-identically
+            log(
+                f"fault: checkpoint step {s} was saved on a different "
+                f"topology ({', '.join(f'{k} {a}->{b}' for k, (a, b) in drift.items())}); "
+                "re-placing the replicated state onto the current mesh"
             )
         return RestoreResult(s, restored, manifest, discarded)
     return RestoreResult(None, None, None, discarded)

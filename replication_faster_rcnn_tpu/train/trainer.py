@@ -32,6 +32,7 @@ from replication_faster_rcnn_tpu.data.prefetch_device import (
 )
 from replication_faster_rcnn_tpu.parallel import (
     fit_data_parallelism,
+    is_coordinator,
     make_mesh,
     gather_replicated,
     replicate_tree,
@@ -127,6 +128,12 @@ class Trainer:
             )
             self.config = config
         self.mesh = make_mesh(config.mesh, devices)
+        # multi-process identity: the coordinator (process 0) owns the
+        # checkpoint store, manifests and the canonical telemetry files;
+        # every other rank writes rank-suffixed telemetry files so
+        # `frcnn telemetry` can merge and group per-rank traces
+        self._rank = jax.process_index()
+        self._process_count = jax.process_count()
 
         # --- telemetry: span tracer + JSONL metrics + stall watchdog.
         # With no telemetry_dir everything collapses to no-ops (NULL
@@ -135,20 +142,30 @@ class Trainer:
         self.watchdog: Optional[StallWatchdog] = None
         if telemetry_dir:
             os.makedirs(telemetry_dir, exist_ok=True)
+            rank = self._rank if self._process_count > 1 else None
+
+            def _rank_file(name: str) -> str:
+                # trace.json -> trace.rank1.json on non-coordinator ranks
+                if not rank:
+                    return os.path.join(telemetry_dir, name)
+                stem, ext = os.path.splitext(name)
+                return os.path.join(telemetry_dir, f"{stem}.rank{rank}{ext}")
+
             self.tracer = tspans.SpanTracer(
-                os.path.join(telemetry_dir, "trace.json")
+                _rank_file("trace.json"), rank=rank
             )
             # install process-wide so the loader/evaluator/device-cache
             # span call sites (which take no tracer parameter) attach here
             tspans.set_tracer(self.tracer)
             self.logger = MetricLogger(
-                jsonl_path=os.path.join(telemetry_dir, "metrics.jsonl")
+                jsonl_path=_rank_file("metrics.jsonl"), rank=rank
             )
             self.watchdog = StallWatchdog(
                 timeout_s=stall_timeout_s,
-                snapshot_path=os.path.join(telemetry_dir, "watchdog.jsonl"),
-                progress_path=os.path.join(telemetry_dir, "progress.json"),
+                snapshot_path=_rank_file("watchdog.jsonl"),
+                progress_path=_rank_file("progress.json"),
                 tracer=self.tracer,
+                rank=rank,
                 on_stall=lambda snap: self.logger.event(
                     "stall",
                     elapsed_s=snap.get("elapsed_since_progress_s"),
@@ -211,10 +228,15 @@ class Trainer:
                 seed=config.train.seed,
                 hflip=config.data.augment_hflip,
                 scale_range=config.data.augment_scale,
+                process_index=self._rank,
+                process_count=self._process_count,
             )
             self.loader = None
             steps_per_epoch = max(len(self.sampler), 1)
         else:
+            # each process loads only its contiguous block of every global
+            # batch (loader.py); batch_size stays GLOBAL so schedules and
+            # step counts are topology-invariant
             self.loader = DataLoader(
                 self.dataset,
                 batch_size=config.train.batch_size,
@@ -227,6 +249,8 @@ class Trainer:
                 augment_scale=config.data.augment_scale,
                 augment_scale_device=config.data.augment_scale_device,
                 cache_ram=config.data.loader_cache_ram,
+                process_index=self._rank,
+                process_count=self._process_count,
             )
             steps_per_epoch = max(len(self.loader), 1)
         self.tx, self.schedule = make_optimizer(config, steps_per_epoch)
@@ -252,9 +276,11 @@ class Trainer:
             from replication_faster_rcnn_tpu.parallel import make_shard_map_train_step
 
             # explicit-collective step (psum allreduce + sync-BN); the
-            # parameter tree is identical, so eval/checkpoints are unchanged
+            # parameter tree is identical, so eval/checkpoints are unchanged.
+            # state_template carries full leaf shapes so the ZeRO variant
+            # (train.shard_opt_state) can derive shard dims outside the body
             self.jitted_step, _ = make_shard_map_train_step(
-                config, self.tx, self.mesh
+                config, self.tx, self.mesh, state_template=self.state
             )
         elif config.data.cache_device:
             from replication_faster_rcnn_tpu.train.train_step import (
@@ -292,7 +318,8 @@ class Trainer:
                 )
 
                 self.jitted_multi_step, _ = make_shard_map_train_step(
-                    config, self.tx, self.mesh, steps_per_dispatch=k
+                    config, self.tx, self.mesh, steps_per_dispatch=k,
+                    state_template=self.state,
                 )
             elif config.data.cache_device:
                 self.jitted_multi_step = jax.jit(
@@ -318,19 +345,21 @@ class Trainer:
                 warmup_dispatches=config.debug.strict_warmup
             )
         self._ckpt_mgr = None
-        # background scheduled-checkpoint writer (train.async_checkpoint):
-        # single-process only — multi-process orbax saves need the live
-        # replicated jax.Arrays for their replica/writer election, which
-        # the async path's host snapshot deliberately discards
+        # topology provenance stamped into every checkpoint manifest:
+        # restore on a DIFFERENT topology is supported (checkpoints are
+        # saved fully replicated; fault.verified_restore re-places), the
+        # stamp just makes a cross-topology resume visible in the logs
+        self._topology = fault.run_topology(config, self.mesh)
+        # background scheduled-checkpoint writer (train.async_checkpoint).
+        # Single-process: the writer serializes a host numpy snapshot.
+        # Multi-process: EVERY rank runs a writer thread and the snapshot
+        # stays on device (fresh replicated buffers via gather_replicated,
+        # so donation can't delete them mid-write); the writer threads run
+        # the collective orbax save in lockstep, preserving orbax's
+        # replica/writer election, and only the coordinator writes the
+        # manifest.
         self._async_writer: Optional[AsyncCheckpointWriter] = None
         if config.train.async_checkpoint:
-            if jax.process_count() > 1:
-                raise ValueError(
-                    "async_checkpoint requires a single-process runtime: "
-                    "the background writer serializes a host snapshot, "
-                    "which cannot drive orbax's multi-process replica "
-                    "coordination. Drop --async-checkpoint on multi-host."
-                )
             self._async_writer = AsyncCheckpointWriter()
 
     # ---------------------------------------------------------- checkpoints
@@ -407,12 +436,22 @@ class Trainer:
 
     def _save_async(self, step: int) -> bool:
         """Scheduled save via the background writer: the trainer thread
-        pays only the host snapshot (device_get) — serialize + manifest +
-        prune run on the writer thread (train/async_checkpoint.py). Blocks
-        only while the PREVIOUS save is still in flight."""
+        pays only the snapshot — serialize + manifest + prune run on the
+        writer thread (train/async_checkpoint.py). Blocks only while the
+        PREVIOUS save is still in flight.
+
+        The snapshot is a host device_get in a single-process run (byte-
+        identical to the pre-multi-host path). In a multi-process run the
+        snapshot instead stays ON DEVICE as fresh replicated buffers
+        (`gather_replicated` — a jitted identity always materializes new
+        output buffers, so the training loop's donation cannot delete them
+        mid-write): orbax's multi-process replica election needs live
+        jax.Arrays, and every rank's writer thread runs the collective
+        save in lockstep while only the coordinator writes the manifest."""
         import orbax.checkpoint as ocp
 
         writer = self._async_writer
+        multiproc = self._process_count > 1
         # bound in-flight depth at one; a prior failure surfaces here with
         # scheduled-save containment semantics
         self._handle_async_error(writer.wait())
@@ -425,7 +464,11 @@ class Trainer:
             with self.tracer.span(
                 "checkpoint/snapshot", cat="checkpoint", step=step
             ):
-                host_state = jax.device_get(self._replicated_state())
+                if multiproc:
+                    # fresh replicated device buffers, donation-safe
+                    snapshot = gather_replicated(self.state, self.mesh)
+                else:
+                    snapshot = jax.device_get(self._replicated_state())
         except Exception as e:
             print(
                 f"warning: scheduled checkpoint at step {step} failed "
@@ -443,15 +486,24 @@ class Trainer:
 
         mgr = self.checkpoint_manager
         workdir, config = self.workdir, self.config
+        topology = self._topology
+        tracer = self.tracer
 
         def _write() -> None:
-            mgr.save(step, args=ocp.args.StandardSave(host_state))
+            mgr.save(step, args=ocp.args.StandardSave(snapshot))
             mgr.wait_until_finished()
+            if not is_coordinator():
+                return
             # same manifest writer as the sync path: restore-side
             # verification and the fallback walk stay bit-for-bit
+            if multiproc:
+                with tracer.span("checkpoint/manifest", cat="checkpoint"):
+                    host_state = jax.device_get(snapshot)
+            else:
+                host_state = snapshot
             fault.write_manifest(
                 workdir, step, host_state, config,
-                kind="scheduled", writer="async",
+                kind="scheduled", writer="async", topology=topology,
             )
             fault.prune_manifests(workdir, mgr.all_steps())
 
@@ -506,11 +558,12 @@ class Trainer:
                 step, args=ocp.args.StandardSave(rep_state)
             )
             self.checkpoint_manager.wait_until_finished()
-            if jax.process_index() == 0:
+            if is_coordinator():
                 with self.tracer.span("checkpoint/manifest", cat="checkpoint"):
                     host_state = jax.device_get(rep_state)
                 fault.write_manifest(
                     self.workdir, step, host_state, self.config, kind=kind,
+                    topology=self._topology,
                 )
                 fault.prune_manifests(
                     self.workdir, self.checkpoint_manager.all_steps()
@@ -1012,6 +1065,8 @@ class Trainer:
                         )
                     self.skip_monitor.drain()
                     dt = time.time() - t_epoch
+                    # n_images counted LOCAL rows; report global throughput
+                    n_images *= self._process_count
                     self.logger.log_epoch(epoch, n_images / dt if dt > 0 else 0.0)
                     if cfg.eval_every_epochs and (
                         epoch + 1

@@ -103,14 +103,18 @@ class ProgramSpec:
     """
 
     name: str
-    feed: str  # "loader" | "cached" | "spmd" | "eval"
+    feed: str  # "loader" | "cached" | "spmd" | "zero" | "eval"
     k: int  # fused steps per dispatch (1 = single step; 0 for eval)
     arg_roles: Tuple[str, ...]
     build: Callable[[], Tuple[Any, Tuple[Any, ...]]]
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
-TRAIN_FEEDS: Tuple[str, ...] = ("loader", "cached", "spmd")
+# "zero" is the shard_map backend with ZeRO-1 weight-update sharding
+# forced on (train.shard_opt_state): same step math as "spmd" but the
+# optimizer state is sharded over the data axis and the update is
+# reduce-scatter / sharded-Adam / all-gather (parallel/spmd.py)
+TRAIN_FEEDS: Tuple[str, ...] = ("loader", "cached", "spmd", "zero")
 
 
 def program_name(feed: str, k: int) -> str:
@@ -326,10 +330,32 @@ def build_program_specs(
             make_shard_map_train_step,
         )
 
-        jitted, _ = make_shard_map_train_step(config, tx, mesh, steps_per_dispatch=k)
+        scfg = config.replace(
+            train=dataclasses.replace(config.train, shard_opt_state=False)
+        )
+        jitted, _ = make_shard_map_train_step(scfg, tx, mesh, steps_per_dispatch=k)
         if k == 1:
             return jitted, (state_rep, batch_abs)
         return jitted, (state_rep, _chunk_abs(k))
+
+    def _zero(k: int):
+        from replication_faster_rcnn_tpu.parallel.spmd import (
+            make_shard_map_train_step,
+        )
+
+        zcfg = config.replace(
+            train=dataclasses.replace(config.train, shard_opt_state=True)
+        )
+        # ZeRO state placement: params/BN replicated, opt state sharded
+        # over the data axis — exactly what the Trainer device_puts
+        zero_shardings = train_state_shardings(state_raw, mesh, mesh_cfg, True)
+        state_zero = _attach(state_raw, zero_shardings)
+        jitted, _ = make_shard_map_train_step(
+            zcfg, tx, mesh, steps_per_dispatch=k, state_template=state_raw
+        )
+        if k == 1:
+            return jitted, (state_zero, batch_abs)
+        return jitted, (state_zero, _chunk_abs(k))
 
     def _eval():
         from replication_faster_rcnn_tpu.eval import Evaluator
@@ -356,11 +382,14 @@ def build_program_specs(
         images_abs = _abs(batch_raw["image"], e_img_s)
         return ev._jit_infer, (variables_abs, images_abs)
 
-    builders = {"loader": _loader, "cached": _cached, "spmd": _spmd}
+    builders = {
+        "loader": _loader, "cached": _cached, "spmd": _spmd, "zero": _zero,
+    }
     roles = {
         "loader": ("state", "batch"),
         "cached": ("state", "cache", "sel"),
         "spmd": ("state", "batch"),
+        "zero": ("state", "batch"),
     }
     specs: Dict[str, ProgramSpec] = {}
     for feed in feeds:
@@ -409,7 +438,7 @@ def warmup_compile(
     warmed instead (same step math, different feed plumbing)."""
     tracer = tspans.current_tracer()
     if config.train.backend == "spmd":
-        feed = "spmd"
+        feed = "zero" if config.train.shard_opt_state else "spmd"
     elif config.data.cache_device and cache_n is not None:
         feed = "cached"
     else:

@@ -31,12 +31,19 @@ def _jsonable(v: Any) -> Any:
 
 class MetricLogger:
     def __init__(
-        self, stream: Optional[TextIO] = None, jsonl_path: Optional[str] = None
+        self,
+        stream: Optional[TextIO] = None,
+        jsonl_path: Optional[str] = None,
+        rank: Optional[int] = None,
     ):
         # None = resolve sys.stdout at write time: a default bound at import
         # time pins whatever stdout was then (stale under redirection)
         self._stream = stream
         self.jsonl_path = jsonl_path
+        # process_index of a multi-process run: stamped on every JSONL row
+        # so merged per-rank logs stay attributable (single-process runs
+        # pass None and the rows are byte-identical to before)
+        self.rank = rank
         self._t0 = time.time()
 
     @property
@@ -45,6 +52,8 @@ class MetricLogger:
 
     def _write_jsonl(self, record: Dict) -> None:
         if self.jsonl_path:
+            if self.rank is not None:
+                record = {"process_index": self.rank, **record}
             with open(self.jsonl_path, "a") as f:
                 f.write(json.dumps(record, default=_jsonable) + "\n")
 

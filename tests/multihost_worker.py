@@ -62,6 +62,8 @@ def main() -> int:
     assert result == expect, (result, expect)
     print(f"proc {process_id}: global devices={n_global} allreduce={result} OK")
 
+    if len(sys.argv) > 4 and sys.argv[4] == "preempt":
+        return _preempt_zero_spmd(process_id, sys.argv[5])
     if len(sys.argv) > 4 and sys.argv[4] == "trainstep":
         _train_step_across_processes(process_id, n_global)
         # default workdir is scoped to the coordinator address AND cleaned
@@ -80,6 +82,88 @@ def main() -> int:
                 shutil.rmtree(workdir)
         _zero_checkpoint_across_processes(process_id, workdir)
     return 0
+
+
+def _preempt_zero_spmd(process_id: int, workdir: str) -> int:
+    """The scale-out acceptance leg: a REAL 2-process ZeRO-1 run on the
+    shard_map backend, SIGTERM-preempted mid-epoch.
+
+    Both ranks run the full Trainer loop (loader feed, per-process batch
+    shards, sharded Adam update with reduce_scatter/all_gather) for 5
+    global steps, then deliver a real SIGTERM to themselves at the SAME
+    dispatch boundary — step count is deterministic and identical on both
+    ranks, so the collective emergency save runs in lockstep. Exit code
+    is ``fault.EXIT_PREEMPTED``; the pytest side then resumes the
+    emergency checkpoint on a DIFFERENT topology (1 process x 8 devices)
+    and checks trajectory parity against an uninterrupted run.
+    """
+    import signal
+    import time
+
+    import jax
+
+    from replication_faster_rcnn_tpu.config import (
+        DataConfig,
+        FasterRCNNConfig,
+        MeshConfig,
+        ModelConfig,
+        ProposalConfig,
+        ROITargetConfig,
+        TrainConfig,
+    )
+    from replication_faster_rcnn_tpu.data import SyntheticDataset
+    from replication_faster_rcnn_tpu.train import fault
+    from replication_faster_rcnn_tpu.train.trainer import Trainer
+
+    def mark(msg: str) -> None:
+        print(f"proc {process_id}: preempt-leg {msg}", flush=True)
+
+    n_global = len(jax.devices())
+    cfg = FasterRCNNConfig(
+        model=ModelConfig(
+            backbone="resnet18", roi_op="align", compute_dtype="float32"
+        ),
+        data=DataConfig(dataset="synthetic", image_size=(64, 64), max_boxes=4),
+        train=TrainConfig(
+            batch_size=n_global,
+            n_epoch=2,
+            backend="spmd",
+            shard_opt_state=True,
+            grad_allreduce_dtype="bfloat16",
+        ),
+        mesh=MeshConfig(num_data=n_global),
+        proposals=ProposalConfig(pre_nms_train=128, post_nms_train=32),
+        roi_targets=ROITargetConfig(n_sample=8),
+    )
+    # 32 synthetic images / global batch 8 -> 4 steps per epoch; the
+    # preemption at step 5 lands mid-epoch-2, exercising the replay path
+    ds = SyntheticDataset(cfg.data, length=32)
+    trainer = Trainer(
+        cfg,
+        workdir=workdir,
+        dataset=ds,
+        telemetry_dir=os.path.join(workdir, "telemetry"),
+    )
+    mark("trainer built")
+
+    orig_check = trainer._check_preemption
+
+    def check(step: int) -> None:
+        sd = trainer._shutdown
+        if step >= 5 and sd is not None and not sd.requested:
+            os.kill(os.getpid(), signal.SIGTERM)  # real delivery, real handler
+            deadline = time.time() + 10.0
+            while not sd.requested and time.time() < deadline:
+                time.sleep(0.01)
+        orig_check(step)
+
+    trainer._check_preemption = check
+    try:
+        trainer.train(log_every=1)
+    except fault.Preempted as exc:
+        mark(f"preempted step={exc.step} emergency saved")
+        return fault.EXIT_PREEMPTED
+    raise AssertionError("run completed without being preempted")
 
 
 def _train_step_across_processes(process_id: int, n_global: int) -> None:

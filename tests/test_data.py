@@ -111,6 +111,40 @@ class TestLoader:
         with pytest.raises(RuntimeError, match="boom"):
             list(loader)
 
+    def test_process_shards_partition_the_global_batch(self):
+        """Multi-process feed: each rank's batches are its contiguous rows
+        of the SAME global order (same seed), so the union over ranks
+        reassembles the single-process epoch exactly — the property that
+        makes a resumed run topology-invariant."""
+        ds = SyntheticDataset(_cfg(), length=24)
+        global_loader = DataLoader(ds, batch_size=8, shuffle=True, seed=3,
+                                   prefetch=0)
+        rank_loaders = [
+            DataLoader(ds, batch_size=8, shuffle=True, seed=3, prefetch=0,
+                       process_index=r, process_count=2)
+            for r in range(2)
+        ]
+        for loader in [global_loader] + rank_loaders:
+            loader.set_epoch(2)
+        # global step count is unchanged (len stays GLOBAL)
+        assert len(rank_loaders[0]) == len(global_loader) == 3
+        global_batches = list(global_loader)
+        rank_batches = [list(ld) for ld in rank_loaders]
+        for step, gb in enumerate(global_batches):
+            for rank in range(2):
+                rb = rank_batches[rank][step]
+                assert rb["image"].shape[0] == 4  # local rows only
+                np.testing.assert_array_equal(
+                    rb["image"], gb["image"][rank * 4 : rank * 4 + 4]
+                )
+
+    def test_process_sharding_validation(self):
+        ds = SyntheticDataset(_cfg(), length=8)
+        with pytest.raises(ValueError, match="process"):
+            DataLoader(ds, batch_size=8, process_index=2, process_count=2)
+        with pytest.raises(ValueError, match="divide"):
+            DataLoader(ds, batch_size=6, process_index=0, process_count=4)
+
     def test_collate(self):
         ds = SyntheticDataset(_cfg(), length=3)
         b = collate([ds[0], ds[1]])

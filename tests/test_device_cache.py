@@ -149,6 +149,43 @@ class TestMaterializeEquivalence:
 
 
 class TestSampler:
+    def test_process_shards_union_to_global_selection(self):
+        """Multi-process sampler: per-rank selections are contiguous
+        blocks of the SAME global order, and flip decisions key on the
+        GLOBAL sample index — so the assembled global batch is identical
+        on any topology."""
+        n = 24
+        whole = CachedSampler(n, (64, 64), batch_size=8, seed=SEED,
+                              hflip=True, shuffle=True)
+        ranks = [
+            CachedSampler(n, (64, 64), batch_size=8, seed=SEED, hflip=True,
+                          shuffle=True, process_index=r, process_count=2)
+            for r in range(2)
+        ]
+        for s in [whole] + ranks:
+            s.set_epoch(EPOCH)
+        assert len(ranks[0]) == len(whole)  # __len__ stays GLOBAL
+        whole_sels = list(whole)
+        rank_sels = [list(s) for s in ranks]
+        for step, sel in enumerate(whole_sels):
+            for r in range(2):
+                rsel = rank_sels[r][step]
+                assert rsel["idx"].shape == (4,)
+                np.testing.assert_array_equal(
+                    rsel["idx"], sel["idx"][r * 4 : r * 4 + 4]
+                )
+                np.testing.assert_array_equal(
+                    rsel["flip"], sel["flip"][r * 4 : r * 4 + 4]
+                )
+
+    def test_process_sharding_validation(self):
+        with pytest.raises(ValueError, match="process_count"):
+            CachedSampler(8, (64, 64), batch_size=8, seed=SEED,
+                          process_index=3, process_count=2)
+        with pytest.raises(ValueError, match="divide"):
+            CachedSampler(8, (64, 64), batch_size=6, seed=SEED,
+                          process_index=0, process_count=4)
+
     def test_epoch_order_matches_dataloader(self):
         ds = _dataset()
         loader = DataLoader(ds, batch_size=BATCH, shuffle=True, seed=SEED,
@@ -185,7 +222,12 @@ def _tiny_cfg(**data_kw):
 
 
 class TestCachedStep:
-    @pytest.mark.parametrize("aug", [False, True])
+    # tier rebalance: one full-step-compile variant is enough for the
+    # 870s fast-tier budget on a single-core box; the no-augment variant
+    # still runs in the slow tier (tier_budget_audit.py).
+    @pytest.mark.parametrize(
+        "aug", [pytest.param(False, marks=pytest.mark.slow), True]
+    )
     def test_cached_step_matches_fed_step(self, aug):
         """One optimizer step through the cache == the same step fed the
         identical host batch (the whole point of the feature)."""
@@ -228,6 +270,7 @@ class TestCachedStep:
             rtol=1e-4,
         )
 
+    @pytest.mark.slow
     def test_trainer_cache_device_end_to_end(self, tmp_path):
         """Trainer(cache_device=True) trains, checkpoints, and its loss
         agrees with the loader-fed Trainer on the same (seed, epoch)."""
@@ -305,6 +348,7 @@ class TestCLISurfaces:
         assert "cache-device" in line["breakdown"]["note"]
 
 
+@pytest.mark.slow
 class TestCachedStepDP8:
     def test_dp8_matches_single_device(self):
         """The cached step under an 8-device data mesh computes the same

@@ -156,6 +156,12 @@ def auto_parity():
     }
 
 
+# tier rebalance: the two fused-K parity fixtures each compile a fused
+# program and a sequential one — ~220s on a single-core box, which blew
+# the 870s fast-tier budget (tier_budget_audit.py). The slow tier keeps
+# them, and test_cached_feed_fused_parity/TestTrainerChunking retain
+# fused-dispatch coverage there too.
+@pytest.mark.slow
 class TestAutoBackendParity:
     def test_metrics_are_stacked_per_step(self, auto_parity):
         m = auto_parity["fused_metrics"]
@@ -220,6 +226,7 @@ def spmd_parity():
     }
 
 
+@pytest.mark.slow
 class TestShardMapParity:
     def test_losses_match_sequential(self, spmd_parity):
         m = spmd_parity["fused_metrics"]

@@ -17,15 +17,16 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_allreduce(tmp_path):
+def _launch_workers(mode: str, workdir: str):
+    """Start two multihost_worker.py subprocesses against a fresh
+    coordinator and return (procs, outs) after both exit. The env strips
+    the TPU plugin's sitecustomize hook (axon_site on PYTHONPATH + its
+    trigger env var): it runs at subprocess interpreter start, before the
+    worker can force CPU, and tries to claim the TPU tunnel."""
     port = _free_port()
     coordinator = f"127.0.0.1:{port}"
     script = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(script)))
-    # Strip the TPU plugin's sitecustomize hook (axon_site on PYTHONPATH +
-    # its trigger env var): it runs at subprocess interpreter start, before
-    # the worker can force CPU, and tries to claim the TPU tunnel.
     env = {
         k: v
         for k, v in os.environ.items()
@@ -40,16 +41,15 @@ def test_two_process_allreduce(tmp_path):
         ]
     )
 
-    workdir = str(tmp_path / "zero_ckpt")
     procs = [
         subprocess.Popen(
             [sys.executable, "-u", script, coordinator, str(pid), "2",
-             "trainstep", workdir],
+             mode, workdir],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
             env=env,
-            cwd=os.path.dirname(os.path.dirname(os.path.abspath(script))),
+            cwd=repo_root,
         )
         for pid in range(2)
     ]
@@ -57,7 +57,6 @@ def test_two_process_allreduce(tmp_path):
     for p in procs:
         try:
             # generous: two jax processes compile concurrently on one core
-            # (trainstep + zero1 + trainer ckpt legs each compile once)
             out, _ = p.communicate(timeout=1500)
         except subprocess.TimeoutExpired:
             partial = []
@@ -72,6 +71,13 @@ def test_two_process_allreduce(tmp_path):
                 + "\n---\n".join(partial)
             )
         outs.append(out)
+    return procs, outs
+
+
+@pytest.mark.slow
+def test_two_process_allreduce(tmp_path):
+    workdir = str(tmp_path / "zero_ckpt")
+    procs, outs = _launch_workers("trainstep", workdir)
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}"
     assert "global devices=8" in outs[0]
@@ -82,3 +88,116 @@ def test_two_process_allreduce(tmp_path):
     # Trainer.save/restore of cross-process ZeRO-sharded moments (ADVICE #4)
     assert "zero1 ckpt roundtrip OK" in outs[0]
     assert "zero1 ckpt roundtrip OK" in outs[1]
+
+
+def _preempt_cfg():
+    """The EXACT config the worker's preempt leg trains (multihost_worker
+    ``_preempt_zero_spmd``): same global batch, mesh and trims, so the
+    in-process resume/baseline legs run the same schedule and data order
+    on a different topology (1 process x 8 devices)."""
+    from replication_faster_rcnn_tpu.config import (
+        DataConfig,
+        FasterRCNNConfig,
+        MeshConfig,
+        ModelConfig,
+        ProposalConfig,
+        ROITargetConfig,
+        TrainConfig,
+    )
+
+    return FasterRCNNConfig(
+        model=ModelConfig(
+            backbone="resnet18", roi_op="align", compute_dtype="float32"
+        ),
+        data=DataConfig(dataset="synthetic", image_size=(64, 64), max_boxes=4),
+        train=TrainConfig(
+            batch_size=8,
+            n_epoch=2,
+            backend="spmd",
+            shard_opt_state=True,
+            grad_allreduce_dtype="bfloat16",
+        ),
+        mesh=MeshConfig(num_data=8),
+        proposals=ProposalConfig(pre_nms_train=128, post_nms_train=32),
+        roi_targets=ROITargetConfig(n_sample=8),
+    )
+
+
+@pytest.mark.slow
+def test_two_process_zero_preempt_cross_topology_resume(tmp_path):
+    """The scale-out acceptance path end to end: a 2-process ZeRO-1 run on
+    the shard_map backend trains 5 global steps, both ranks are
+    SIGTERM-preempted at the same dispatch boundary, the collective
+    emergency save lands — then THIS process (1 process, 8 virtual
+    devices: a different topology) resumes the emergency checkpoint and
+    must finish with the same trajectory as an uninterrupted run."""
+    workdir = str(tmp_path / "preempt_ckpt")
+    procs, outs = _launch_workers("preempt", workdir)
+
+    from replication_faster_rcnn_tpu.train import fault
+
+    for p, out in zip(procs, outs):
+        assert p.returncode == fault.EXIT_PREEMPTED, (
+            f"expected preemption exit {fault.EXIT_PREEMPTED}, got "
+            f"{p.returncode}:\n{out}"
+        )
+        assert "preempted step=5 emergency saved" in out
+
+    # the emergency manifest records the 2-process topology it was saved on
+    manifest = fault.load_manifest(workdir, 5)
+    assert manifest is not None, "no manifest for the emergency step"
+    assert manifest["kind"] == "emergency"
+    topo = manifest.get("topology") or {}
+    assert topo.get("process_count") == 2
+    assert topo.get("device_count") == 8
+    assert topo.get("shard_opt_state") is True
+
+    # every rank wrote its own telemetry stream; the report merges them
+    tele = os.path.join(workdir, "telemetry")
+    assert os.path.exists(os.path.join(tele, "trace.json"))
+    assert os.path.exists(os.path.join(tele, "trace.rank1.json"))
+    from replication_faster_rcnn_tpu.telemetry.report import summarize_run
+
+    summary = summarize_run(tele)
+    assert summary.get("ranks") == [0, 1]
+
+    # resume on a DIFFERENT topology: 1 process x 8 virtual devices
+    from replication_faster_rcnn_tpu.data import SyntheticDataset
+    from replication_faster_rcnn_tpu.train.trainer import Trainer
+
+    cfg = _preempt_cfg()
+    ds = SyntheticDataset(cfg.data, length=32)
+    resumed = Trainer(cfg, workdir=workdir, dataset=ds)
+    resumed.train(resume=True)
+    import jax
+    import numpy as np
+
+    assert int(jax.device_get(resumed.state.step)) == 8
+
+    baseline = Trainer(cfg, workdir=str(tmp_path / "base_ckpt"), dataset=ds)
+    baseline.train()
+    assert int(jax.device_get(baseline.state.step)) == 8
+
+    got = jax.device_get(resumed._host_state().params)
+    want = jax.device_get(baseline._host_state().params)
+    flat_g, tree_g = jax.tree_util.tree_flatten(got)
+    flat_w, tree_w = jax.tree_util.tree_flatten(want)
+    assert tree_g == tree_w
+    # The first 5 steps ran on a different reduction topology (2-proc
+    # gloo vs 1-proc), and the bf16 gradient all-reduce makes the
+    # reassociation noise bf16-sized; where Adam's m_hat/sqrt(v_hat)
+    # sits near zero that can flip an update's sign, moving a weight by
+    # up to ~2*lr per step — the same elementwise bound the
+    # shard_map-vs-auto parity test uses, here over all 8 steps. A
+    # genuinely diverged trajectory (wrong resume step, missed replay)
+    # shifts the BULK of the elements by the ~1e-2 update scale, which
+    # the mean-abs-difference check below would catch even if every
+    # element squeaked under the per-element bound.
+    adam_bound = 2.5 * cfg.train.lr * 8
+    total_absdiff, total_n = 0.0, 0
+    for a, b in zip(flat_g, flat_w):
+        a, b = np.asarray(a), np.asarray(b)
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=adam_bound)
+        total_absdiff += float(np.abs(a - b).sum())
+        total_n += a.size
+    assert total_absdiff / total_n < 1e-4

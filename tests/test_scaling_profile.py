@@ -1,0 +1,147 @@
+"""Scale-out-profile harness machinery (`benchmarks/scaling_profile.py`):
+record identity, the structural ZeRO gates (per-device opt-state bytes,
+collective inventory), and the throughput regression gate — exercised on
+synthetic records, no compiles or timing. The banked CPU record under
+benchmarks/records/ is validated for shape and for actually passing its
+own structural gate (a PR acceptance criterion: opt-state bytes reduced
+~(N-1)/N with the reduce-scatter/all-gather pattern present).
+"""
+
+import glob
+import importlib.util
+import json
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "scaling_profile",
+        os.path.join(_REPO, "benchmarks", "scaling_profile.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+sp = _load()
+
+_ZERO_COLL = {
+    "all_reduce": {"count": 55, "element_types": {"bf16": 1, "f32": 52, "i32": 2}},
+    "reduce_scatter": {"count": 69, "element_types": {"bf16": 69}},
+    "all_gather": {"count": 69, "element_types": {"f32": 69}},
+}
+_REPL_COLL = {
+    "all_reduce": {"count": 124, "element_types": {"bf16": 70, "f32": 52, "i32": 2}},
+}
+
+
+def _rec(**over):
+    rec = {
+        "schema": sp.SCHEMA,
+        "n_dev": 8,
+        "opt_bytes_per_device_replicated": 8_000_000,
+        "opt_bytes_per_device_zero": 1_000_000,
+        "opt_bytes_frac": 0.125,
+        "collectives_zero": dict(_ZERO_COLL),
+        "collectives_replicated": dict(_REPL_COLL),
+        "images_per_sec_zero": 3.0,
+        "images_per_sec_replicated": 2.0,
+    }
+    rec.update(over)
+    return rec
+
+
+class TestRecordIdentity:
+    def test_key_and_path(self):
+        key = sp.record_key("tiny64b8", "cpu", 8)
+        assert key == "tiny64b8_cpu_n8"
+        path = sp.record_path(key, "/bank")
+        assert path == "/bank/scaling_profile_tiny64b8_cpu_n8.json"
+
+
+class TestStructuralGate:
+    def test_ideal_sharding_passes(self):
+        assert sp.check_structural(_rec()) == []
+
+    def test_slack_admits_replicated_leaves(self):
+        # 1/8 ideal + 50% slack => ceiling 18.75% of replicated bytes
+        rec = _rec(opt_bytes_per_device_zero=1_400_000)
+        assert sp.check_structural(rec) == []
+
+    def test_unsharded_opt_state_fails(self):
+        rec = _rec(opt_bytes_per_device_zero=8_000_000)
+        fails = sp.check_structural(rec)
+        assert len(fails) == 1 and "not sharded" in fails[0]
+
+    def test_missing_measurement_fails(self):
+        fails = sp.check_structural(_rec(opt_bytes_per_device_zero=0))
+        assert fails == ["opt-state byte measurement missing or zero"]
+
+    def test_missing_reduce_scatter_fails(self):
+        coll = {k: v for k, v in _ZERO_COLL.items() if k != "reduce_scatter"}
+        fails = sp.check_structural(_rec(collectives_zero=coll))
+        assert any("reduce_scatter" in f and "missing" in f for f in fails)
+
+    def test_unexpected_collective_kinds_fail(self):
+        zero = dict(_ZERO_COLL, all_to_all={"count": 1})
+        repl = dict(_REPL_COLL, collective_permute={"count": 1})
+        fails = sp.check_structural(
+            _rec(collectives_zero=zero, collectives_replicated=repl)
+        )
+        assert any("all_to_all" in f for f in fails)
+        assert any("collective_permute" in f for f in fails)
+
+
+class TestRegressionGate:
+    def test_within_tolerance_passes(self):
+        fails, warns = sp.check_regression(
+            _rec(images_per_sec_zero=2.9), _rec(), tol=0.15
+        )
+        assert fails == [] and warns == []
+
+    def test_slip_past_half_tolerance_warns(self):
+        fails, warns = sp.check_regression(
+            _rec(images_per_sec_zero=3.0 * (1 - 0.10)), _rec(), tol=0.15
+        )
+        assert fails == []
+        assert len(warns) == 1 and "slipping" in warns[0]
+
+    def test_throughput_drop_fails(self):
+        fails, _ = sp.check_regression(
+            _rec(images_per_sec_zero=2.0), _rec(), tol=0.15
+        )
+        assert len(fails) == 1 and sp.GATE_KEY in fails[0]
+
+    def test_opt_bytes_growth_fails(self):
+        fails, _ = sp.check_regression(
+            _rec(opt_bytes_frac=0.25), _rec(), tol=0.15
+        )
+        assert len(fails) == 1 and "opt_bytes_frac grew" in fails[0]
+
+    def test_schema_mismatch_skips(self):
+        banked = _rec(schema="scaling_profile/v0")
+        fails, warns = sp.check_regression(_rec(images_per_sec_zero=0.1), banked)
+        assert fails == [] and len(warns) == 1
+
+
+class TestBankedRecords:
+    def test_committed_records_pass_their_own_gates(self):
+        paths = glob.glob(
+            os.path.join(_REPO, "benchmarks", "records", "scaling_profile_*.json")
+        )
+        assert paths, "no banked scaling_profile record committed"
+        for path in paths:
+            with open(path) as f:
+                rec = json.load(f)
+            assert rec["schema"] == sp.SCHEMA
+            assert rec["backend"] == "spmd"
+            assert sp.check_structural(rec) == [], path
+            # the banked measurement itself shows the ~(N-1)/N reduction
+            assert rec["opt_bytes_frac"] <= (1.0 / rec["n_dev"]) * 1.5
+            # identity embedded in the filename matches the record
+            key = sp.record_key(rec["config"], rec["platform"], rec["n_dev"])
+            assert os.path.basename(path) == f"scaling_profile_{key}.json"
+            fails, _ = sp.check_regression(rec, rec)
+            assert fails == [], path

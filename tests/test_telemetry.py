@@ -364,6 +364,39 @@ class TestReport:
         assert "grad_norm" in text
         assert "1 stall(s)" in text
 
+    def test_rank_suffixed_artifacts_merge(self, tmp_path):
+        d = self._run_dir(tmp_path)
+        # rank-1 siblings, as a 2-process trainer writes them
+        tr = SpanTracer(os.path.join(d, "trace.rank1.json"))
+        with tr.span("step/dispatch", cat="step"):
+            pass
+        tr.flush()
+        with open(os.path.join(d, "metrics.rank1.jsonl"), "w") as f:
+            f.write(json.dumps({"step": 10, "loss": 0.2,
+                                "process_index": 1}) + "\n")
+        with open(os.path.join(d, "watchdog.rank1.jsonl"), "w") as f:
+            f.write(json.dumps({"kind": "stall",
+                                "elapsed_since_progress_s": 5.0,
+                                "last_step": 10, "last_phase": "train",
+                                "last_span": None}) + "\n")
+
+        summary = summarize_run(d)
+        assert summary["ranks"] == [0, 1]
+        assert "trace.rank1.json" in summary["artifacts"]
+        # spans merged: 3 coordinator dispatches + 1 from rank 1
+        dispatch = next(r for r in summary["phases"]
+                        if r["name"] == "step/dispatch")
+        assert dispatch["count"] == 4
+        # health rows merged and attributed per rank
+        assert summary["health"]["per_rank"][0]["rows"] == 2
+        assert summary["health"]["per_rank"][1] == {
+            "rows": 1, "last_step": 10
+        }
+        # incidents summed across ranks
+        assert summary["incidents"]["stalls"] == 2
+        text = format_report(summary)
+        assert "2 ranks" in text and "rank 1: 1 step rows" in text
+
     def test_cli_telemetry_subcommand(self, tmp_path, capsys):
         from replication_faster_rcnn_tpu import cli
 

@@ -86,6 +86,64 @@ class TestSchedule:
         # cosine reaches ~0 at n_epoch
         assert float(sched(10 * cfg.train.n_epoch)) == pytest.approx(0.0, abs=1e-8)
 
+    def test_linear_lr_scaling_and_warmup(self):
+        """The large-batch recipe: lr_scaling='linear' scales the cosine
+        peak by batch/base_batch, and warmup_epochs ramps linearly up to
+        that peak before the cosine takes over."""
+        cfg = _tiny_cfg(
+            8, lr_scaling="linear", base_batch_size=2, warmup_epochs=1.0
+        )
+        _, sched = make_optimizer(cfg, steps_per_epoch=10)
+        peak = cfg.train.lr * 8 / 2
+        # ramp: (step+1)/warmup_steps of the scaled peak
+        assert float(sched(0)) == pytest.approx(peak / 10)
+        assert float(sched(4)) == pytest.approx(peak / 2)
+        assert float(sched(9)) == pytest.approx(peak)
+        # after warmup the epoch-granular cosine runs at the scaled peak
+        assert float(sched(10)) == pytest.approx(
+            peak * 0.5 * (1 + np.cos(np.pi / cfg.train.n_epoch))
+        )
+
+    def test_host_schedule_matches_jnp_schedule(self):
+        """host_schedule is the pure-Python twin the log path evaluates;
+        any drift from the jnp schedule silently logs the wrong lr."""
+        from replication_faster_rcnn_tpu.train.train_step import host_schedule
+
+        for kw in (
+            {},
+            dict(lr_scaling="linear", base_batch_size=4, warmup_epochs=0.5),
+            dict(warmup_epochs=2.0),
+        ):
+            cfg = _tiny_cfg(8, **kw)
+            _, sched = make_optimizer(cfg, steps_per_epoch=6)
+            host = host_schedule(cfg, steps_per_epoch=6)
+            for step in range(6 * cfg.train.n_epoch + 2):
+                np.testing.assert_allclose(
+                    host(step), float(sched(step)), rtol=1e-6,
+                    err_msg=f"step {step} with {kw}",
+                )
+
+    def test_lars_trust_ratio_bounds_update(self):
+        """train.lars appends LAMB-style trust-ratio scaling after Adam:
+        the per-leaf update norm becomes lr * |param| regardless of the
+        raw gradient scale."""
+        cfg = _tiny_cfg(2, lars=True)
+        tx, _ = make_optimizer(cfg, steps_per_epoch=10)
+        params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((3,))}
+        opt_state = tx.init(params)
+        grads = {"w": jnp.full((4, 4), 100.0), "b": jnp.ones((3,))}
+        updates, _ = tx.update(grads, opt_state, params)
+        w_ratio = float(
+            jnp.linalg.norm(updates["w"]) / jnp.linalg.norm(params["w"])
+        )
+        assert w_ratio == pytest.approx(cfg.train.lr, rel=1e-4)
+        # a zero-norm leaf must not produce NaNs (optax safe-norm path)
+        assert np.all(np.isfinite(np.asarray(updates["b"])))
+
+    def test_invalid_lr_scaling_rejected(self):
+        with pytest.raises(ValueError, match="lr_scaling"):
+            _tiny_cfg(2, lr_scaling="sqrt")
+
 
 @pytest.fixture(scope="module")
 def step_setup():
