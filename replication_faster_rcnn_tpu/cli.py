@@ -134,6 +134,10 @@ def _build_config(args):
         cfg = cfg.replace(
             debug=dataclasses.replace(cfg.debug, threadsan=True)
         )
+    if getattr(args, "chaos_spec", None):
+        cfg = cfg.replace(
+            debug=dataclasses.replace(cfg.debug, chaos_spec=args.chaos_spec)
+        )
     if (args.backbone or args.roi_op or getattr(args, "remat", False)
             or getattr(args, "frozen_bn", False)
             or getattr(args, "norm", None)):
@@ -188,6 +192,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "and held-duration + queue-depth gauges feed the "
                         "telemetry watchdog; runtime half of the TL rules "
                         "in 'frcnn check'")
+    p.add_argument("--chaos-spec", default=None, metavar="SPEC",
+                   help="deterministic fault injection (faultlib): "
+                        "'site:kind:prob:seed[:arg[:max_fires]]' comma "
+                        "list, or a JSON schedule file (path or @path); "
+                        "sites/kinds in faultlib.failpoints.SITES/KINDS. "
+                        "Same spec + seed => identical fault sequence")
     p.add_argument("--dataset", default=None, choices=[None, "voc", "coco", "synthetic"])
     p.add_argument("--data-root", default=None)
     p.add_argument("--image-size", type=int, default=None)
@@ -381,6 +391,10 @@ def _cmd_train_impl(args, san=None) -> int:
     from replication_faster_rcnn_tpu.train import Trainer
 
     cfg = _build_config(args)
+    if cfg.debug.chaos_spec:
+        from replication_faster_rcnn_tpu.faultlib import failpoints
+
+        failpoints.configure(cfg.debug.chaos_spec)
     trainer = Trainer(
         cfg,
         workdir=args.workdir,
@@ -688,7 +702,13 @@ def _cmd_serve_impl(args) -> int:
         )
     if args.params_dtype:
         serving = _dc.replace(serving, params_dtype=args.params_dtype)
+    if args.request_timeout_s is not None:
+        serving = _dc.replace(serving, request_timeout_s=args.request_timeout_s)
     cfg = cfg.replace(serving=serving)
+    if cfg.debug.chaos_spec:
+        from replication_faster_rcnn_tpu.faultlib import failpoints
+
+        failpoints.configure(cfg.debug.chaos_spec)
     maybe_enable_compile_cache(cfg)
     model, variables = load_eval_variables(cfg, args.workdir, args.checkpoint_step)
     engine = InferenceEngine(cfg, model, variables, warmup=True)
@@ -722,14 +742,67 @@ def _cmd_serve_impl(args) -> int:
         "(POST /predict {\"paths\": [...]}, GET /healthz, GET /stats)",
         flush=True,
     )
+    # graceful drain on SIGTERM: stop ACCEPTING (server.shutdown must run
+    # off the serve_forever thread or it deadlocks), then the finally
+    # block below closes the listener and drains the engine — accepted
+    # requests still flush and respond before the process exits
+    import signal
+    import threading
+
+    def _drain(signum, frame):  # noqa: ARG001 - signal signature
+        print("SIGTERM: draining in-flight requests...", file=sys.stderr)
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    prev_term = signal.signal(signal.SIGTERM, _drain)
     with stack:
         try:
             server.serve_forever()
         except KeyboardInterrupt:
             pass
         finally:
+            signal.signal(signal.SIGTERM, prev_term)
             server.server_close()
             engine.close()
+    return 0
+
+
+def cmd_chaos(args) -> int:
+    """Chaos acceptance harness (faultlib/chaos.py): a tiny seeded fault
+    schedule exercised against the REAL loader / orbax checkpoint +
+    manifest / micro-batcher machinery, asserting the recovery invariants
+    (skip-and-substitute, verified-restore walk-back, worker survival)
+    and that two runs under the same seed log the identical fault
+    sequence. Exit 0 = all invariants held."""
+    if not args.smoke:
+        print("chaos: pass --smoke (the only implemented mode)", file=sys.stderr)
+        return 2
+    import json
+    import shutil
+    import tempfile
+
+    from replication_faster_rcnn_tpu.faultlib import chaos
+
+    workdir = args.workdir
+    cleanup = workdir is None
+    if workdir is None:
+        workdir = tempfile.mkdtemp(prefix="frcnn-chaos-")
+    try:
+        result = chaos.run_smoke(workdir, seed=args.seed)
+    except chaos.ChaosSmokeError as e:
+        print(f"chaos smoke FAILED: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(
+            f"chaos smoke ok: seed={result['seed']} "
+            f"injected_events={result['injected_events']} "
+            f"elapsed_s={result['elapsed_s']}"
+        )
+        for leg, detail in result["legs"].items():
+            print(f"  {leg}: {detail}")
+    if cleanup:
+        shutil.rmtree(workdir, ignore_errors=True)
     return 0
 
 
@@ -1071,7 +1144,35 @@ def main(argv: Optional[List[str]] = None) -> int:
                          choices=[None, "float32", "bfloat16"],
                          help="resident inference param dtype "
                               "(serving.params_dtype; bf16 halves HBM)")
+    p_serve.add_argument("--request-timeout-s", type=float, default=None,
+                         help="per-request deadline "
+                              "(serving.request_timeout_s): handler waits "
+                              "time out to 504 and queued entries past "
+                              "deadline are dropped at flush time, never "
+                              "dispatched (0 = no deadline)")
     p_serve.set_defaults(fn=cmd_serve)
+
+    p_chaos = sub.add_parser(
+        "chaos",
+        help="deterministic fault-injection acceptance harness "
+             "(faultlib): seeded failpoint schedule against the real "
+             "loader/checkpoint/micro-batcher machinery; asserts the "
+             "fault-tolerance invariants hold and that the same seed "
+             "reproduces the identical fault sequence",
+    )
+    p_chaos.add_argument("--smoke", action="store_true",
+                         help="tiny seeded schedule on synthetic data "
+                              "(finishes in seconds); currently the only "
+                              "mode, so required")
+    p_chaos.add_argument("--seed", type=int, default=0,
+                         help="schedule seed; the run is a pure function "
+                              "of it")
+    p_chaos.add_argument("--workdir", default=None, metavar="DIR",
+                         help="scratch dir for checkpoint legs (default: "
+                              "a fresh temp dir, removed on success)")
+    p_chaos.add_argument("--json", action="store_true",
+                         help="print the full result record as JSON")
+    p_chaos.set_defaults(fn=cmd_chaos)
 
     p_viz = sub.add_parser("viz", help="visual sanity artifacts "
                                        "(anchor centers / gt overlay)")

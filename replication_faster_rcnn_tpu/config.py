@@ -513,6 +513,11 @@ class DebugConfig:
     strict: bool = False
     strict_warmup: int = 1
     threadsan: bool = False
+    # seeded fault-injection schedule (faultlib/failpoints.py):
+    # "site:kind:prob:seed[:arg[:max_fires]],..." or a JSON schedule
+    # path. Empty = disarmed (the failpoints are zero-overhead no-ops).
+    # Armed by the CLI entry points from --chaos-spec.
+    chaos_spec: str = ""
 
     def __post_init__(self):
         if not isinstance(self.strict_warmup, int) or self.strict_warmup < 1:
@@ -581,6 +586,11 @@ class ServingConfig:
     # halves HBM residency and the flax modules cast per-layer anyway
     params_dtype: str = "bfloat16"  # float32 | bfloat16
     oversize: str = "downscale"  # downscale | reject
+    # per-request deadline, end to end: the HTTP handler's future wait
+    # times out to 504 after this many seconds, and an entry whose
+    # deadline passes while it waits in the queue is dropped at flush
+    # time (never dispatched). 0 disables deadlines (unbounded waits).
+    request_timeout_s: float = 0.0
 
     def __post_init__(self):
         object.__setattr__(
@@ -620,6 +630,11 @@ class ServingConfig:
             raise ValueError(
                 "serving.oversize must be 'downscale' or 'reject', got "
                 f"{self.oversize!r}"
+            )
+        if self.request_timeout_s < 0:
+            raise ValueError(
+                "serving.request_timeout_s must be >= 0 (0 = no deadline), "
+                f"got {self.request_timeout_s}"
             )
 
     def bucket_resolutions(

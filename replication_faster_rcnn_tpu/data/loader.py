@@ -32,6 +32,7 @@ from typing import Dict, Iterator, Optional, Sequence
 
 import numpy as np
 
+from replication_faster_rcnn_tpu.faultlib import failpoints
 from replication_faster_rcnn_tpu.telemetry import spans as tspans
 
 
@@ -51,12 +52,25 @@ def fetch_sample(ds, idx: int, on_skip=None):
     without a cap would silently train on a collapsing dataset. With no
     ``on_skip`` the substitution is unbudgeted. Raises the last error only
     if every index in the dataset fails.
+
+    The ``loader.fetch`` failpoint wraps every dataset access (the
+    original, the retry, and each substitution probe), so an injected
+    IOError rides exactly this containment and an injected ``nan`` fault
+    poisons the decoded sample the way a corrupt image would.
     """
+
+    def _get(i: int):
+        inj = failpoints.fire("loader.fetch", index=int(i))  # ioerror raises
+        sample = ds[int(i)]
+        if inj is not None and inj.kind == "nan":
+            sample = failpoints.poison_batch(sample)
+        return sample
+
     try:
-        return ds[int(idx)]
+        return _get(idx)
     except Exception:
         try:
-            return ds[int(idx)]  # the one retry
+            return _get(idx)  # the one retry
         except Exception as exc:
             if on_skip is not None:
                 on_skip(int(idx), exc)
@@ -64,7 +78,7 @@ def fetch_sample(ds, idx: int, on_skip=None):
             for delta in range(1, n):
                 j = (int(idx) + delta) % n
                 try:
-                    return ds[j]
+                    return _get(j)
                 except Exception:
                     continue
             raise

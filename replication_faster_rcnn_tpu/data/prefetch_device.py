@@ -46,6 +46,7 @@ import queue
 import threading
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Tuple
 
+from replication_faster_rcnn_tpu.faultlib import failpoints
 from replication_faster_rcnn_tpu.telemetry import spans as tspans
 
 # queue item kinds (first tuple element)
@@ -143,6 +144,11 @@ class DevicePrefetcher:
                 if len(pending) < self._chunk:
                     continue
                 n_images = sum(_batch_images(b) for b in pending)
+                # failpoint: ioerror raises here and relays to the consumer
+                # via the _ERROR item (error-transparency contract above)
+                inj = failpoints.fire("prefetch.stage", n_batches=len(pending))
+                if inj is not None and inj.kind == "nan":
+                    pending = [failpoints.poison_batch(b) for b in pending]
                 staged = self._stage(pending)
                 if not self._put((STAGED, staged, len(pending), n_images)):
                     return

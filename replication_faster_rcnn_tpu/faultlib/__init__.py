@@ -1,0 +1,14 @@
+"""Deterministic fault injection (failpoints) + chaos harnesses.
+
+`failpoints` is the seeded registry of named injection sites threaded
+through the data loader, checkpoint writers, device prefetcher,
+micro-batcher, HTTP handler and collective init; `chaos` is the seeded
+smoke harness behind `frcnn chaos --smoke`.
+"""
+
+from replication_faster_rcnn_tpu.faultlib import failpoints
+
+# `chaos` is imported lazily by its users (it pulls in data/checkpoint
+# machinery, which itself consults `failpoints` — an eager import here
+# would be circular).
+__all__ = ["failpoints"]

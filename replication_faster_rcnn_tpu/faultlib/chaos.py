@@ -1,0 +1,243 @@
+"""Seeded chaos smoke harness (`frcnn chaos --smoke`).
+
+A fast, CI-tier acceptance run for the failpoint subsystem: arm a tiny
+seeded schedule against REAL components — the loader's
+retry-then-substitute path, the checkpoint+manifest+verified-restore
+walk-back, the micro-batcher's per-flush error relay — and assert the
+recovery invariants hold, twice, with identical injected-event logs
+(the determinism pin). No jitted training and no model build, so the
+whole thing runs in seconds on CPU; the full-training chaos leg lives
+in the slow tier (tests/test_fault_train.py).
+
+Legs:
+
+1. **loader** — ``loader.fetch`` IOErrors at p=0.4: every fetch must
+   still return a sample (retry or nearest-following substitution),
+   skips stay within the recorded budget.
+2. **checkpoint** — two verified saves, then a ``checkpoint.write``
+   torn-write on the newest step: ``verified_restore`` must walk back
+   to the older verifiable step and report the torn one discarded.
+3. **batcher** — a guaranteed ``batcher.flush`` IOError on the first
+   flush: exactly that flush's futures fail, the worker survives, and
+   the next flush succeeds.
+4. **determinism** — legs 1–3 run twice under the same seed; the two
+   injected-event logs must match exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+from replication_faster_rcnn_tpu.faultlib import failpoints
+
+__all__ = ["ChaosSmokeError", "run_smoke", "smoke_rules"]
+
+
+class ChaosSmokeError(AssertionError):
+    """A recovery invariant did not hold under the injected schedule."""
+
+
+def smoke_rules(seed: int) -> List[failpoints.Rule]:
+    """The smoke schedule: loader IOErrors, one torn checkpoint write,
+    one flush IOError — all decided by ``seed``."""
+    return [
+        failpoints.Rule("loader.fetch", "ioerror", 0.4, seed),
+        # the FIRST save lands clean so the walk-back has somewhere to go;
+        # the second is torn mid-write (after=1, max_fires=1 → exactly hit 1)
+        failpoints.Rule(
+            "checkpoint.write", "torn_write", 1.0, seed + 1,
+            arg=4, max_fires=1, after=1,
+        ),
+        failpoints.Rule(
+            "batcher.flush", "ioerror", 1.0, seed + 2, max_fires=1
+        ),
+    ]
+
+
+def _check(ok: bool, msg: str) -> None:
+    if not ok:
+        raise ChaosSmokeError(msg)
+
+
+def _loader_leg(seed: int) -> Dict[str, Any]:
+    from replication_faster_rcnn_tpu.config import DataConfig
+    from replication_faster_rcnn_tpu.data import SyntheticDataset
+    from replication_faster_rcnn_tpu.data.loader import fetch_sample
+
+    cfg = DataConfig(dataset="synthetic", image_size=(16, 16), max_boxes=4)
+    ds = SyntheticDataset(cfg, length=8)
+    skips: List[int] = []
+    for i in range(len(ds)):
+        sample = fetch_sample(ds, i, on_skip=lambda idx, exc: skips.append(idx))
+        _check(
+            isinstance(sample, dict) and "image" in sample,
+            f"loader leg: fetch_sample({i}) returned no sample under faults",
+        )
+        _check(
+            np.isfinite(np.asarray(sample["image"])).all(),
+            f"loader leg: substituted sample {i} is not finite",
+        )
+    return {"fetches": len(ds), "skipped": len(skips)}
+
+
+def _checkpointed_save(mgr, workdir: str, step: int, state) -> None:
+    """One save through the same failpoint wiring the trainer uses:
+    consult ``checkpoint.write`` first (ioerror raises before any bytes
+    land), save + manifest, then apply a returned torn-write/CRC fault
+    to the step directory so restore-time verification must catch it."""
+    import orbax.checkpoint as ocp
+
+    from replication_faster_rcnn_tpu.train import fault
+
+    inj = failpoints.fire("checkpoint.write", step=int(step), writer="smoke")
+    mgr.save(step, args=ocp.args.StandardSave(state))
+    mgr.wait_until_finished()
+    fault.write_manifest(workdir, step, state, None, kind="scheduled")
+    if inj is not None and inj.kind in ("torn_write", "crc_corrupt"):
+        step_dir = failpoints.find_step_dir(
+            workdir, step, exclude=(fault.MANIFEST_DIRNAME,)
+        )
+        _check(step_dir is not None, f"checkpoint leg: no step dir for {step}")
+        touched = failpoints.apply_file_fault(inj, step_dir)
+        _check(bool(touched), f"checkpoint leg: fault touched no files at {step}")
+
+
+def _checkpoint_leg(workdir: str, seed: int) -> Dict[str, Any]:
+    import orbax.checkpoint as ocp
+
+    from replication_faster_rcnn_tpu.train import fault
+
+    rng = np.random.RandomState(seed)
+    state = {
+        "params": {"w": rng.rand(8, 8).astype(np.float32)},
+        "step": np.zeros((), np.int64),
+    }
+    mgr = ocp.CheckpointManager(
+        workdir, options=ocp.CheckpointManagerOptions(max_to_keep=4, create=True)
+    )
+    try:
+        # step 1 saves clean (the torn-write rule is max_fires=1 but its
+        # decision stream may pass early hits); keep saving until the
+        # single torn write lands, then verify the walk-back
+        torn_step = None
+        for step in (1, 2, 3):
+            state = dict(state, step=np.full((), step, np.int64))
+            before = len(failpoints.event_log())
+            _checkpointed_save(mgr, workdir, step, state)
+            fired = [
+                e
+                for e in failpoints.event_log()[before:]
+                if e["site"] == "checkpoint.write"
+            ]
+            if fired:
+                torn_step = step
+                break
+        _check(
+            torn_step is not None,
+            "checkpoint leg: torn-write rule (prob=1.0) never fired",
+        )
+        template = {
+            "params": {"w": np.zeros((8, 8), np.float32)},
+            "step": np.zeros((), np.int64),
+        }
+        logs: List[str] = []
+        result = fault.verified_restore(
+            mgr, template, workdir, log=logs.append
+        )
+        _check(
+            result.step < torn_step,
+            f"checkpoint leg: restored step {result.step} is not older than "
+            f"the torn step {torn_step}",
+        )
+        _check(
+            any(s == torn_step for s, _ in result.discarded),
+            f"checkpoint leg: torn step {torn_step} was not discarded "
+            f"(discarded={result.discarded})",
+        )
+        _check(
+            fault.verify_state(result.manifest, result.state) == [],
+            "checkpoint leg: fallback state failed manifest verification",
+        )
+        return {"torn_step": torn_step, "restored_step": result.step}
+    finally:
+        mgr.close()
+
+
+def _batcher_leg() -> Dict[str, Any]:
+    from replication_faster_rcnn_tpu.serving.batcher import MicroBatcher
+
+    # threadless mode (start=False + explicit _service_once): grouping is
+    # deterministic — both submits land in ONE flush of 2, so exactly one
+    # batcher.flush hit is consulted per pair regardless of scheduling
+    with MicroBatcher(
+        lambda key, items: [x * 2 for x in items],
+        max_batch=2,
+        max_delay_s=60.0,
+        depth=8,
+        name="chaos-smoke-batcher",
+        start=False,
+    ) as mb:
+        first = [mb.submit("k", i) for i in range(2)]
+        mb._service_once(block=False)  # queues entry 0 (group of 1)
+        mb._service_once(block=False)  # entry 1 completes the group: flush
+        errs = []
+        for f in first:
+            try:
+                f.result(timeout=0)
+            except failpoints.ChaosError as e:
+                errs.append(e)
+        _check(
+            len(errs) == 2,
+            f"batcher leg: injected flush IOError hit {len(errs)}/2 futures",
+        )
+        # the batcher must survive the failed flush (max_fires=1 spent)
+        second = [mb.submit("k", i) for i in range(2)]
+        mb._service_once(block=False)
+        mb._service_once(block=False)
+        got = [f.result(timeout=0) for f in second]
+        _check(got == [0, 2], f"batcher leg: post-fault flush returned {got}")
+    return {"failed_futures": len(errs), "recovered": True}
+
+
+def _one_pass(workdir: str, seed: int) -> Dict[str, Any]:
+    failpoints.configure(smoke_rules(seed))
+    try:
+        legs = {
+            "loader": _loader_leg(seed),
+            "checkpoint": _checkpoint_leg(workdir, seed),
+            "batcher": _batcher_leg(),
+        }
+        events = failpoints.event_log()
+    finally:
+        failpoints.disarm()
+    return {"legs": legs, "events": events}
+
+
+def run_smoke(workdir: str, seed: int = 0) -> Dict[str, Any]:
+    """Run the smoke schedule twice under ``seed`` and assert every
+    recovery invariant plus run-to-run event-log identity. Raises
+    :class:`ChaosSmokeError` on any violation; returns a summary."""
+    import os
+
+    t0 = time.monotonic()
+    first = _one_pass(os.path.join(workdir, "pass1"), seed)
+    second = _one_pass(os.path.join(workdir, "pass2"), seed)
+    _check(
+        first["events"] == second["events"],
+        "determinism leg: the same seed produced different injected-event "
+        f"logs\nfirst:  {json.dumps(first['events'])}\n"
+        f"second: {json.dumps(second['events'])}",
+    )
+    _check(bool(first["events"]), "determinism leg: schedule injected nothing")
+    return {
+        "ok": True,
+        "seed": seed,
+        "legs": first["legs"],
+        "injected_events": len(first["events"]),
+        "events": first["events"],
+        "elapsed_s": round(time.monotonic() - t0, 3),
+    }

@@ -32,6 +32,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from replication_faster_rcnn_tpu.config import MeshConfig
+from replication_faster_rcnn_tpu.faultlib import failpoints
 
 
 def initialize_distributed(
@@ -41,6 +42,13 @@ def initialize_distributed(
 ) -> None:
     """Multi-host setup (XLA collectives over DCN). Single-host runs skip
     this — jax.devices() already shows every local chip."""
+    # failpoint: a chaos schedule can fail or delay collective bring-up
+    # (the classic flaky-coordinator scenario) before any JAX state exists
+    failpoints.fire(
+        "collective.init",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
     if num_processes is None:
         num_processes = int(os.environ.get("NUM_PROCESSES", "1"))
     if num_processes > 1:

@@ -9,7 +9,10 @@ dispatch's fixed cost (Python dispatch, program launch, transfers)
 shared across every request in the flush.
 """
 
-from replication_faster_rcnn_tpu.serving.batcher import MicroBatcher
+from replication_faster_rcnn_tpu.serving.batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+)
 from replication_faster_rcnn_tpu.serving.engine import (
     InferenceEngine,
     OversizedImageError,
@@ -18,6 +21,7 @@ from replication_faster_rcnn_tpu.serving.engine import (
 )
 
 __all__ = [
+    "DeadlineExceeded",
     "InferenceEngine",
     "MicroBatcher",
     "OversizedImageError",

@@ -25,9 +25,17 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-__all__ = ["MicroBatcher"]
+from replication_faster_rcnn_tpu.faultlib import failpoints
+
+__all__ = ["DeadlineExceeded", "MicroBatcher"]
 
 _CLOSE = object()  # shutdown sentinel; queue order guarantees drain
+
+
+class DeadlineExceeded(TimeoutError):
+    """A request's deadline passed while it waited in the queue; it was
+    dropped at flush time instead of being dispatched (abandoned work is
+    never computed)."""
 
 
 class MicroBatcher:
@@ -48,13 +56,20 @@ class MicroBatcher:
         clock: Callable[[], float] = time.monotonic,
         start: bool = True,
         poll_hook: Optional[Callable[[], None]] = None,
+        on_expired: Optional[Callable[[int], None]] = None,
+        on_flush_result: Optional[Callable[[bool], None]] = None,
     ) -> None:
         """``clock``, ``start`` and ``poll_hook`` are test seams:
         ``clock`` replaces ``time.monotonic`` for deadline math (inject
         scheduler delay without sleeping), ``start=False`` skips the
         worker thread so tests drive :meth:`_service_once` directly, and
         ``poll_hook`` runs at the top of every worker iteration (an
-        Event-based rendezvous point — deterministic, no sleep races)."""
+        Event-based rendezvous point — deterministic, no sleep races).
+
+        ``on_expired(n)`` is called on the worker thread each time a
+        flush drops ``n`` deadline-expired entries; ``on_flush_result(ok)``
+        after every processed flush — the engine's hooks for its shed /
+        degraded-health accounting (both must be cheap and non-raising)."""
         if not callable(max_batch):
             if max_batch < 1:
                 raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -71,12 +86,16 @@ class MicroBatcher:
         self._poll_hook = poll_hook
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._closed = False
+        self._on_expired = on_expired
+        self._on_flush_result = on_flush_result
         # worker appends while flush_log snapshots from other threads
         self._log_lock = threading.Lock()
         self._flushes: List[Tuple[Any, int]] = []  # (key, size) history
+        self._expired_total = 0  # deadline-dropped entries, ever
         # worker-loop state; touched by the controlling thread only in
-        # the threadless (start=False) test mode
-        self._pending: Dict[Any, List[Tuple[Any, Future, float]]] = {}
+        # the threadless (start=False) test mode.
+        # entries: (item, future, submit_time, absolute_deadline|None)
+        self._pending: Dict[Any, List[Tuple[Any, Future, float, Optional[float]]]] = {}
         self._thread: Optional[threading.Thread] = None
         if start:
             self._thread = threading.Thread(
@@ -87,18 +106,28 @@ class MicroBatcher:
     # ------------------------------------------------------------- producer
 
     def submit(
-        self, key: Any, item: Any, timeout: Optional[float] = None
+        self,
+        key: Any,
+        item: Any,
+        timeout: Optional[float] = None,
+        deadline_s: Optional[float] = None,
     ) -> Future:
         """Enqueue one request; returns its Future.
 
         Blocks while the queue is at depth (bounded-queue backpressure);
         with ``timeout`` raises ``queue.Full`` instead of waiting
-        forever. Raises ``RuntimeError`` once closed.
+        forever (``timeout=0`` is pure admission control: accept or shed,
+        never wait). ``deadline_s`` is a time-to-live from now: if the
+        entry is still queued when its deadline passes, the flush drops
+        it with :class:`DeadlineExceeded` instead of computing it.
+        Raises ``RuntimeError`` once closed.
         """
         if self._closed:
             raise RuntimeError("MicroBatcher is closed")
         fut: Future = Future()
-        self._queue.put((key, item, fut, self._clock()), timeout=timeout)
+        now = self._clock()
+        deadline = None if deadline_s is None else now + deadline_s
+        self._queue.put((key, item, fut, now, deadline), timeout=timeout)
         return fut
 
     def close(self, join_timeout: float = 60.0) -> None:
@@ -156,6 +185,12 @@ class MicroBatcher:
     def queue_depth(self) -> int:
         return self._queue.qsize()
 
+    @property
+    def expired_total(self) -> int:
+        """Entries dropped at flush time because their deadline passed."""
+        with self._log_lock:
+            return self._expired_total
+
     # --------------------------------------------------------------- worker
 
     def _run(self) -> None:
@@ -195,9 +230,9 @@ class MicroBatcher:
                 self._flush(key, pending)
             return False
         if entry is not None:
-            key, item, fut, t0 = entry
+            key, item, fut, t0, deadline = entry
             group = pending.setdefault(key, [])
-            group.append((item, fut, t0))
+            group.append((item, fut, t0, deadline))
             if len(group) >= self._max_batch(key):
                 self._flush(key, pending)
         now = self._clock()
@@ -208,21 +243,53 @@ class MicroBatcher:
         return True
 
     def _flush(
-        self, key: Any, pending: Dict[Any, List[Tuple[Any, Future, float]]]
+        self,
+        key: Any,
+        pending: Dict[Any, List[Tuple[Any, Future, float, Optional[float]]]],
     ) -> None:
         group = pending.pop(key)
+        # deadline-expired entries are dropped HERE, before any compute:
+        # the waiter that owned the request has already timed out, so
+        # dispatching its slot would burn accelerator time on abandoned
+        # work (and delay the live requests batched behind it)
+        now = self._clock()
+        live = []
+        expired = 0
+        for item, fut, t0, deadline in group:
+            if deadline is not None and now > deadline:
+                expired += 1
+                fut.set_exception(
+                    DeadlineExceeded(
+                        f"request deadline expired after {now - t0:.3f}s in "
+                        f"queue (key={key!r}); dropped before dispatch"
+                    )
+                )
+            else:
+                live.append((item, fut, t0, deadline))
+        if expired:
+            with self._log_lock:
+                self._expired_total += expired
+            if self._on_expired is not None:
+                self._on_expired(expired)
+        if not live:
+            return
         with self._log_lock:
-            self._flushes.append((key, len(group)))
+            self._flushes.append((key, len(live)))
         try:
-            results = self._process(key, [item for item, _, _ in group])
-            if len(results) != len(group):
+            failpoints.fire("batcher.flush", key=str(key), n=len(live))
+            results = self._process(key, [item for item, _, _, _ in live])
+            if len(results) != len(live):
                 raise RuntimeError(
                     f"process returned {len(results)} results for "
-                    f"{len(group)} items (key={key!r})"
+                    f"{len(live)} items (key={key!r})"
                 )
         except BaseException as e:  # noqa: BLE001 - relayed through futures
-            for _, fut, _ in group:
+            for _, fut, _, _ in live:
                 fut.set_exception(e)
+            if self._on_flush_result is not None:
+                self._on_flush_result(False)
             return
-        for (_, fut, _), res in zip(group, results):
+        if self._on_flush_result is not None:
+            self._on_flush_result(True)
+        for (_, fut, _, _), res in zip(live, results):
             fut.set_result(res)

@@ -29,6 +29,7 @@ pre-compiled programs:
 from __future__ import annotations
 
 import contextlib
+import queue as queue_mod
 import threading
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -40,6 +41,10 @@ from replication_faster_rcnn_tpu.config import FasterRCNNConfig
 from replication_faster_rcnn_tpu.eval.evaluator import Evaluator
 from replication_faster_rcnn_tpu.serving.batcher import MicroBatcher
 from replication_faster_rcnn_tpu.telemetry import spans as tspans
+
+# consecutive flush failures before /healthz reports degraded; one
+# successful flush resets the streak (self-healing, not latched)
+DEGRADED_AFTER = 3
 
 __all__ = [
     "InferenceEngine",
@@ -145,7 +150,19 @@ class InferenceEngine:
         # Evaluator: when set, every flush dispatch runs under its
         # per-program warmup/recompile check
         self.strict = None
-        self.stats = {"requests": 0, "flushes": 0, "padded_slots": 0}
+        # written from handler threads (shed/timeouts), the flush worker
+        # (requests/flushes/...), and read by /stats — one lock covers all
+        self._stats_lock = threading.Lock()
+        self.stats = {
+            "requests": 0,
+            "flushes": 0,
+            "padded_slots": 0,
+            "shed": 0,  # admission-control rejections (queue full)
+            "deadline_expired": 0,  # dropped at flush time, never computed
+            "timeouts": 0,  # handler-side waits that hit 504
+            "flush_errors": 0,  # failed micro-batch dispatches
+        }
+        self._consecutive_flush_errors = 0
         if warmup:
             for h, w in self.buckets:
                 for n in self.batch_sizes:
@@ -156,7 +173,42 @@ class InferenceEngine:
             max_delay_s=config.serving.max_delay_ms / 1000.0,
             depth=config.serving.queue_depth,
             name="serving-micro-batcher",
+            on_expired=self._note_expired,
+            on_flush_result=self._note_flush,
         )
+
+    # ---------------------------------------------------- overload accounting
+
+    def _note_expired(self, n: int) -> None:
+        with self._stats_lock:
+            self.stats["deadline_expired"] += n
+
+    def _note_flush(self, ok: bool) -> None:
+        with self._stats_lock:
+            if ok:
+                self._consecutive_flush_errors = 0
+            else:
+                self.stats["flush_errors"] += 1
+                self._consecutive_flush_errors += 1
+
+    def incr_stat(self, key: str, n: int = 1) -> None:
+        """Bump a serving counter (handler threads record their
+        504/shed outcomes here; all writes share the stats lock)."""
+        with self._stats_lock:
+            self.stats[key] = self.stats.get(key, 0) + n
+
+    def queue_depth(self) -> int:
+        """Requests waiting in the micro-batch queue (public accessor —
+        /stats must not reach into the engine's internals)."""
+        return self._batcher.queue_depth()
+
+    @property
+    def degraded(self) -> bool:
+        """True after :data:`DEGRADED_AFTER` consecutive flush failures;
+        one successful flush resets it. Surfaced in ``/healthz`` so load
+        balancers can route around a sick replica without killing it."""
+        with self._stats_lock:
+            return self._consecutive_flush_errors >= DEGRADED_AFTER
 
     # ------------------------------------------------------------ programs
 
@@ -234,10 +286,10 @@ class InferenceEngine:
                     "bucket routing"
                 )
             orig_h, orig_w = orig_size if orig_size else bucket
-        return self._batcher.submit(
+        return self._submit(
             bucket,
             (np.asarray(image, np.float32), int(orig_h), int(orig_w)),
-            timeout=timeout,
+            timeout,
         )
 
     def submit_path(self, path: str, timeout: Optional[float] = None) -> Future:
@@ -256,9 +308,26 @@ class InferenceEngine:
         image, orig_h, orig_w = _load_image(
             path, bucket, self.config.data.pixel_mean, self.config.data.pixel_std
         )
-        return self._batcher.submit(
-            bucket, (image, int(orig_h), int(orig_w)), timeout=timeout
-        )
+        return self._submit(bucket, (image, int(orig_h), int(orig_w)), timeout)
+
+    def _submit(self, bucket, entry, timeout: Optional[float]) -> Future:
+        """Queue one request: ``serving.request_timeout_s`` becomes the
+        entry's time-to-live (expired entries are dropped at flush time,
+        and the HTTP handler bounds its wait by the same budget), and an
+        admission rejection (``queue.Full`` under ``timeout``) is counted
+        as shed before it propagates to the caller's 503."""
+        ttl = self.config.serving.request_timeout_s
+        try:
+            return self._batcher.submit(
+                bucket,
+                entry,
+                timeout=timeout,
+                deadline_s=ttl if ttl > 0 else None,
+            )
+        except queue_mod.Full:
+            with self._stats_lock:
+                self.stats["shed"] += 1
+            raise
 
     def predict_paths(self, paths: Sequence[str]) -> List[Dict[str, np.ndarray]]:
         """Submit many paths (they coalesce into micro-batches) and wait."""
@@ -285,9 +354,10 @@ class InferenceEngine:
             with self._strict_dispatch(name):
                 out = program(self._variables, jax.device_put(batch))
             out = jax.device_get(out)
-        self.stats["requests"] += n
-        self.stats["flushes"] += 1
-        self.stats["padded_slots"] += bn - n
+        with self._stats_lock:
+            self.stats["requests"] += n
+            self.stats["flushes"] += 1
+            self.stats["padded_slots"] += bn - n
         results = []
         for i, (_, orig_h, orig_w) in enumerate(items):
             back = np.asarray(

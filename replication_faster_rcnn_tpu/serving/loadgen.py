@@ -16,17 +16,36 @@ Latency is measured per request from submit to future resolution
 (``Future.add_done_callback`` stamps completion on the worker thread),
 so it includes queue wait + batching delay + dispatch + de-normalization
 — the full engine-side request path.
+
+Client-side hardening (so a wedged or overloaded server costs the
+benchmark a bounded wait, never a hang):
+
+* every result wait carries a deadline (``timeout_s``, default 120 s);
+  expired waits are counted and reported as ``timeout_fraction``;
+* with ``admission=True`` submits are non-blocking with seeded jittered
+  exponential backoff (serving/overload.py); requests still shed after
+  the retry budget are counted as ``shed`` instead of blocking forever.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from replication_faster_rcnn_tpu.serving.overload import (
+    DeadlineExceeded,
+    backoff_delays,
+)
+
 __all__ = ["percentile_ms", "run_closed_loop", "run_open_loop"]
+
+# generous per-request result deadline: far above any sane serving
+# latency, small enough that a wedged engine fails the run in minutes
+DEFAULT_TIMEOUT_S = 120.0
 
 
 def percentile_ms(latencies_s: Sequence[float], q: float) -> float:
@@ -52,35 +71,120 @@ def _summarize(
     }
 
 
-def _submit_timed(engine, image, latencies: List[float], lock: threading.Lock):
+class _Counters:
+    """Shed/retry/timeout/error tallies shared with done-callbacks."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.latencies: List[float] = []
+        self.shed = 0
+        self.retries = 0
+        self.timeouts = 0
+        self.errors = 0
+
+
+def _submit_timed(engine, image, counters: _Counters):
     t0 = time.monotonic()
 
-    def _done(_fut) -> None:
+    def _done(fut) -> None:
         dt = time.monotonic() - t0
-        with lock:
-            latencies.append(dt)
+        with counters.lock:
+            if fut.exception() is None:
+                counters.latencies.append(dt)
 
     fut = engine.submit(image)
     fut.add_done_callback(_done)
     return fut
 
 
+def _submit_admission(engine, image, counters: _Counters, seed: int):
+    """Non-blocking submit with jittered-backoff retries; returns the
+    Future or None once the retry budget sheds the request."""
+    import queue
+
+    attempt = 0
+    while True:
+        try:
+            t0 = time.monotonic()
+            fut = engine.submit(image, timeout=0)
+        except queue.Full:
+            delays = list(backoff_delays(seed=seed))
+            if attempt >= len(delays):
+                with counters.lock:
+                    counters.shed += 1
+                return None
+            with counters.lock:
+                counters.retries += 1
+            time.sleep(delays[attempt])
+            attempt += 1
+            continue
+
+        def _done(f, t0=t0) -> None:
+            dt = time.monotonic() - t0
+            with counters.lock:
+                if f.exception() is None:
+                    counters.latencies.append(dt)
+
+        fut.add_done_callback(_done)
+        return fut
+
+
+def _await_all(
+    futures: Sequence, timeout_s: Optional[float], counters: _Counters
+) -> None:
+    """Wait for every future, bounding each wait by ``timeout_s``;
+    timeouts and per-request errors are counted, not raised — the
+    summary is the report."""
+    for f in futures:
+        if f is None:
+            continue
+        try:
+            f.result(timeout=timeout_s)
+        except (FutureTimeoutError, TimeoutError, DeadlineExceeded):
+            with counters.lock:
+                counters.timeouts += 1
+        except Exception:  # noqa: BLE001 - tallied in the summary
+            with counters.lock:
+                counters.errors += 1
+
+
+def _extra(counters: _Counters, n: int) -> Dict[str, Any]:
+    with counters.lock:
+        return {
+            "timeouts": counters.timeouts,
+            "timeout_fraction": round(counters.timeouts / n, 4) if n else 0.0,
+            "shed": counters.shed,
+            "submit_retries": counters.retries,
+            "errors": counters.errors,
+        }
+
+
 def run_closed_loop(
-    engine, images: Sequence[np.ndarray], n_requests: int
+    engine,
+    images: Sequence[np.ndarray],
+    n_requests: int,
+    timeout_s: Optional[float] = DEFAULT_TIMEOUT_S,
+    admission: bool = False,
+    seed: int = 0,
 ) -> Dict[str, Any]:
     """Saturation: fire ``n_requests`` submits back-to-back (the bounded
-    queue throttles the producer) and wait for all results."""
-    latencies: List[float] = []
-    lock = threading.Lock()
+    queue throttles the producer — or sheds, with ``admission=True``)
+    and wait for all results under the per-request deadline."""
+    counters = _Counters()
     t0 = time.monotonic()
-    futures = [
-        _submit_timed(engine, images[i % len(images)], latencies, lock)
-        for i in range(n_requests)
-    ]
-    for f in futures:
-        f.result()
+    futures = []
+    for i in range(n_requests):
+        image = images[i % len(images)]
+        if admission:
+            futures.append(_submit_admission(engine, image, counters, seed + i))
+        else:
+            futures.append(_submit_timed(engine, image, counters))
+    _await_all(futures, timeout_s, counters)
     wall = time.monotonic() - t0
-    return _summarize(latencies, wall, n_requests, mode="closed")
+    return _summarize(
+        counters.latencies, wall, n_requests, mode="closed",
+        **_extra(counters, n_requests),
+    )
 
 
 def run_open_loop(
@@ -89,6 +193,9 @@ def run_open_loop(
     offered_rate: float,
     n_requests: Optional[int] = None,
     duration_s: Optional[float] = None,
+    timeout_s: Optional[float] = DEFAULT_TIMEOUT_S,
+    admission: bool = False,
+    seed: int = 0,
 ) -> Dict[str, Any]:
     """Fixed offered load: one submit every ``1/offered_rate`` seconds
     (absolute schedule, so a slow submit doesn't silently lower the
@@ -99,8 +206,7 @@ def run_open_loop(
         if duration_s is None:
             raise ValueError("need n_requests or duration_s")
         n_requests = max(1, int(offered_rate * duration_s))
-    latencies: List[float] = []
-    lock = threading.Lock()
+    counters = _Counters()
     interval = 1.0 / offered_rate
     t0 = time.monotonic()
     futures = []
@@ -109,12 +215,14 @@ def run_open_loop(
         delay = target - time.monotonic()
         if delay > 0:
             time.sleep(delay)
-        futures.append(
-            _submit_timed(engine, images[i % len(images)], latencies, lock)
-        )
-    for f in futures:
-        f.result()
+        image = images[i % len(images)]
+        if admission:
+            futures.append(_submit_admission(engine, image, counters, seed + i))
+        else:
+            futures.append(_submit_timed(engine, image, counters))
+    _await_all(futures, timeout_s, counters)
     wall = time.monotonic() - t0
     return _summarize(
-        latencies, wall, n_requests, mode="open", offered_rate=offered_rate
+        counters.latencies, wall, n_requests, mode="open",
+        offered_rate=offered_rate, **_extra(counters, n_requests),
     )

@@ -1,25 +1,46 @@
 """Minimal stdlib HTTP front-end over the inference engine.
 
-Each request-handler thread submits its images to the engine and blocks
+Each request-handler thread submits its images to the engine and waits
 on the futures — so concurrent clients' requests coalesce into shared
 micro-batches inside the engine (ThreadingHTTPServer gives one thread
 per connection; the engine's bounded queue is the backpressure).
 
+Overload contract (serving/overload.py):
+
+* submits are ADMISSION-CONTROLLED (``timeout=0`` against the bounded
+  queue): a full queue sheds the request with **503** + ``Retry-After``
+  instead of parking the handler thread;
+* ``serving.request_timeout_s`` bounds each request end-to-end: the
+  handler's wait times out to **504**, and entries whose deadline passed
+  while queued are dropped at flush time, never dispatched;
+* multi-path requests are isolated per path: one failing image costs
+  that one entry an ``"error"`` value, the rest still return detections.
+
 Endpoints:
   POST /predict  {"paths": ["a.jpg", ...]} or {"path": "a.jpg"}, optional
                  "score_thresh" — detections per image (boxes in original
-                 image coordinates, row-major [r1, c1, r2, c2])
-  GET  /healthz  liveness + bucket inventory
-  GET  /stats    request/flush/padding counters + queue depth
+                 image coordinates, row-major [r1, c1, r2, c2]); per-path
+                 failures come back under "errors"
+  GET  /healthz  liveness + bucket inventory + degraded flag
+  GET  /stats    request/flush/padding + shed/timeout/error counters
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
+import queue
+import socket
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from replication_faster_rcnn_tpu.config import VOC_CLASSES
+from replication_faster_rcnn_tpu.faultlib import failpoints
+from replication_faster_rcnn_tpu.serving.overload import (
+    DeadlineExceeded,
+    retry_after_s,
+)
 
 __all__ = ["make_server"]
 
@@ -50,11 +71,13 @@ def _detections_json(config, out, thresh: float):
 class _Handler(BaseHTTPRequestHandler):
     # the engine/config/default threshold hang off the server instance
 
-    def _reply(self, code: int, payload: dict) -> None:
+    def _reply(self, code: int, payload: dict, headers: Optional[dict] = None) -> None:
         body = json.dumps(payload, indent=2).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
@@ -68,6 +91,7 @@ class _Handler(BaseHTTPRequestHandler):
                 200,
                 {
                     "ok": True,
+                    "degraded": engine.degraded,
                     "buckets": [list(b) for b in engine.buckets],
                     "batch_sizes": list(engine.batch_sizes),
                 },
@@ -77,7 +101,7 @@ class _Handler(BaseHTTPRequestHandler):
                 200,
                 {
                     "stats": dict(engine.stats),
-                    "queue_depth": engine._batcher.queue_depth(),
+                    "queue_depth": engine.queue_depth(),
                     "compile_seconds": dict(engine.compile_seconds),
                 },
             )
@@ -87,6 +111,17 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802 - stdlib casing
         if self.path != "/predict":
             self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            inj = failpoints.fire("http.handler", path=self.path)
+        except failpoints.ChaosError as e:
+            self._reply(500, {"error": str(e)})
+            return
+        if inj is not None and inj.kind == "drop":
+            # simulate a dropped connection: shut the socket with no
+            # response bytes; the keep-alive loop then reads EOF and exits
+            with contextlib.suppress(OSError):
+                self.connection.shutdown(socket.SHUT_RDWR)
             return
         engine = self.server.engine
         try:
@@ -99,21 +134,65 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, json.JSONDecodeError) as e:
             self._reply(400, {"error": str(e)})
             return
-        try:
-            # submit everything first: same-bucket paths coalesce into
-            # shared flushes (also across concurrent handler threads)
-            futures = [engine.submit_path(p) for p in paths]
-            results = {
-                p: _detections_json(engine.config, f.result(), thresh)
-                for p, f in zip(paths, futures)
-            }
-        except FileNotFoundError as e:
-            self._reply(400, {"error": str(e)})
+
+        # submit everything first: same-bucket paths coalesce into shared
+        # flushes (also across concurrent handler threads). timeout=0 is
+        # the admission decision — accept or shed, never block the thread.
+        timeout_s = engine.config.serving.request_timeout_s
+        futures = []  # (path, future | None)
+        shed = timed_out = bad_input = 0
+        results, errors = {}, {}
+        for p in paths:
+            try:
+                futures.append((p, engine.submit_path(p, timeout=0)))
+            except queue.Full:
+                shed += 1
+                errors[p] = "shed: serving queue is full"
+                futures.append((p, None))
+            except (FileNotFoundError, OSError, ValueError) as e:
+                bad_input += 1
+                errors[p] = f"{type(e).__name__}: {e}"
+                futures.append((p, None))
+
+        # per-path isolation: one bad image costs one entry, not the wave
+        for p, fut in futures:
+            if fut is None:
+                continue
+            try:
+                out = fut.result(timeout=timeout_s if timeout_s > 0 else None)
+                results[p] = _detections_json(engine.config, out, thresh)
+            except (FutureTimeoutError, DeadlineExceeded):
+                timed_out += 1
+                engine.incr_stat("timeouts")
+                errors[p] = (
+                    f"deadline exceeded (request_timeout_s={timeout_s})"
+                )
+            except Exception as e:  # noqa: BLE001 - surfaced per path
+                errors[p] = f"{type(e).__name__}: {e}"
+
+        if results:
+            payload = {"detections": results}
+            if errors:
+                payload["errors"] = errors
+            self._reply(200, payload)
             return
-        except Exception as e:  # noqa: BLE001 - surfaced to the client
-            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
-            return
-        self._reply(200, {"detections": results})
+        # nothing succeeded: the status reflects the dominant failure
+        if shed:
+            self._reply(
+                503,
+                {"error": "serving queue is full", "errors": errors},
+                headers={
+                    "Retry-After": retry_after_s(
+                        engine.config.serving.max_delay_ms
+                    )
+                },
+            )
+        elif timed_out:
+            self._reply(504, {"error": "request deadline exceeded", "errors": errors})
+        elif bad_input == len(paths):
+            self._reply(400, {"error": "; ".join(errors.values())})
+        else:
+            self._reply(500, {"error": "all paths failed", "errors": errors})
 
 
 def make_server(

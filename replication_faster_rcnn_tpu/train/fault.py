@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from replication_faster_rcnn_tpu.faultlib import failpoints
 from replication_faster_rcnn_tpu.telemetry import spans as tspans
 from replication_faster_rcnn_tpu.telemetry.health import health_metrics
 
@@ -415,11 +416,17 @@ def write_manifest(
         "leaf_count": len(leaves),
         "leaves": leaves,
     }
+    # failpoint: ioerror raises before any bytes land; torn_write /
+    # crc_corrupt hit the tmp file so the published manifest is damaged
+    # (load_manifest treats unreadable JSON as missing → step discarded)
+    inj = failpoints.fire("checkpoint.manifest", step=int(step), kind=kind)
     path = manifest_path(workdir, step)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(manifest, f, indent=1)
+    if inj is not None and inj.kind in ("torn_write", "crc_corrupt"):
+        failpoints.apply_file_fault(inj, tmp)
     os.replace(tmp, path)
     return manifest
 

@@ -26,6 +26,7 @@ import numpy as np
 
 from replication_faster_rcnn_tpu.config import FasterRCNNConfig
 from replication_faster_rcnn_tpu.data import DataLoader, make_dataset
+from replication_faster_rcnn_tpu.faultlib import failpoints
 from replication_faster_rcnn_tpu.data.prefetch_device import (
     STAGED,
     DevicePrefetcher,
@@ -187,6 +188,12 @@ class Trainer:
         )
         self._host_step = 0  # host mirror of state.step: no sync to read
         self._shutdown: Optional[fault.GracefulShutdown] = None
+
+        # chaos runs: every injected fault lands in the metric stream and
+        # the watchdog incident log, so a post-mortem can line up observed
+        # failures against the schedule that caused them
+        if failpoints.armed():
+            failpoints.set_sink(self._chaos_sink)
 
         self.dataset = dataset if dataset is not None else make_dataset(
             config.data, "train"
@@ -397,6 +404,14 @@ class Trainer:
         with self.tracer.span("state/host_fetch", cat="sync"):
             return jax.device_get(self._replicated_state())
 
+    def _chaos_sink(self, event) -> None:
+        """Record one injected fault as a ``chaos_injected`` incident (the
+        event's own ``kind`` — the fault kind — is renamed so it can't
+        collide with the incident kind)."""
+        fields = dict(event)
+        fields["fault_kind"] = fields.pop("kind", None)
+        self._fault_incident("chaos_injected", **fields)
+
     def _fault_incident(self, kind: str, **fields) -> None:
         """Route a fault event to the JSONL metric stream AND the watchdog
         incident log, so `telemetry report` and post-mortems both see it."""
@@ -490,6 +505,11 @@ class Trainer:
         tracer = self.tracer
 
         def _write() -> None:
+            # failpoint: ioerror raises on the writer thread and surfaces
+            # at the next drain point via _handle_async_error; torn_write/
+            # crc_corrupt damage the finished step dir below so restore's
+            # manifest verification must walk back past it
+            inj = failpoints.fire("checkpoint.write", step=step, writer="async")
             mgr.save(step, args=ocp.args.StandardSave(snapshot))
             mgr.wait_until_finished()
             if not is_coordinator():
@@ -506,6 +526,13 @@ class Trainer:
                 kind="scheduled", writer="async", topology=topology,
             )
             fault.prune_manifests(workdir, mgr.all_steps())
+            if inj is not None and inj.kind in ("torn_write", "crc_corrupt"):
+                failpoints.apply_file_fault(
+                    inj,
+                    failpoints.find_step_dir(
+                        workdir, step, exclude=(fault.MANIFEST_DIRNAME,)
+                    ),
+                )
 
         self._handle_async_error(writer.submit(step, _write))
         return True
@@ -547,6 +574,10 @@ class Trainer:
         try:
             if self.checkpoint_manager.latest_step() == step:
                 return True  # already checkpointed (orbax raises on dupes)
+            # failpoint: ioerror raises here, riding the scheduled-save
+            # containment below (or the required-save raise); torn_write/
+            # crc_corrupt damage the finished step dir after the write
+            inj = failpoints.fire("checkpoint.write", step=step, writer="sync")
             # Hand orbax the REPLICATED jax arrays, not host numpy: with
             # jax.Array inputs orbax's replica logic makes process 0 the
             # only writer in a multi-process run; a device_get'd numpy tree
@@ -568,6 +599,16 @@ class Trainer:
                 fault.prune_manifests(
                     self.workdir, self.checkpoint_manager.all_steps()
                 )
+                if inj is not None and inj.kind in (
+                    "torn_write", "crc_corrupt",
+                ):
+                    failpoints.apply_file_fault(
+                        inj,
+                        failpoints.find_step_dir(
+                            self.workdir, step,
+                            exclude=(fault.MANIFEST_DIRNAME,),
+                        ),
+                    )
         except Exception as e:
             if required:
                 raise

@@ -1,0 +1,406 @@
+"""Failpoint registry + chaos harness (fast tier).
+
+Pins the faultlib contracts: spec/schedule parsing, decision determinism
+independent of thread interleaving, disarmed no-op cost, fault-kind
+behaviors (delay, max_fires, after, file faults, batch poisoning), the
+loader's skip-and-substitute containment of injected fetch errors, the
+trainer's scheduled-save containment of an injected checkpoint.write
+failure (incident + next-interval retry), and the `frcnn chaos --smoke`
+acceptance harness end-to-end (twice: CLI and library, same seed =>
+identical injected-event log).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from replication_faster_rcnn_tpu.faultlib import failpoints
+from replication_faster_rcnn_tpu.faultlib.failpoints import (
+    ChaosError,
+    Fault,
+    Rule,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test starts and ends disarmed — chaos must never leak."""
+    failpoints.disarm()
+    yield
+    failpoints.disarm()
+
+
+# ---------------------------------------------------------------- parsing
+
+
+class TestSpecParsing:
+    def test_inline_spec_round_trip(self):
+        rules = failpoints.parse_spec(
+            "loader.fetch:ioerror:0.25:7,batcher.flush:delay:1.0:3:25:2"
+        )
+        assert rules == [
+            Rule("loader.fetch", "ioerror", 0.25, 7),
+            Rule("batcher.flush", "delay", 1.0, 3, arg=25.0, max_fires=2),
+        ]
+
+    def test_unknown_site_rejected(self):
+        with pytest.raises(ValueError, match="unknown failpoint site"):
+            failpoints.parse_spec("no.such.site:ioerror:1.0:0")
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            failpoints.parse_spec("loader.fetch:explode:1.0:0")
+
+    def test_probability_range_enforced(self):
+        with pytest.raises(ValueError, match="prob"):
+            failpoints.parse_spec("loader.fetch:ioerror:1.5:0")
+
+    def test_malformed_field_count_rejected(self):
+        with pytest.raises(ValueError, match="bad failpoint spec"):
+            failpoints.parse_spec("loader.fetch:ioerror")
+
+    def test_json_schedule_file(self, tmp_path):
+        sched = {
+            "rules": [
+                {
+                    "site": "checkpoint.write",
+                    "kind": "torn_write",
+                    "prob": 1.0,
+                    "seed": 11,
+                    "arg": 4,
+                    "max_fires": 1,
+                    "after": 1,
+                },
+            ]
+        }
+        p = tmp_path / "sched.json"
+        p.write_text(json.dumps(sched))
+        for spec in (str(p), f"@{p}"):
+            rules = failpoints.parse_spec(spec)
+            assert rules == [
+                Rule(
+                    "checkpoint.write", "torn_write", 1.0, 11,
+                    arg=4.0, max_fires=1, after=1,
+                )
+            ]
+
+    def test_configure_empty_spec_disarms(self):
+        failpoints.configure("loader.fetch:ioerror:1.0:0")
+        assert failpoints.armed()
+        failpoints.configure("")
+        assert not failpoints.armed()
+
+
+# ----------------------------------------------------------- determinism
+
+
+def _hammer(site, n_threads=8, hits_per_thread=50):
+    """Fire one site from many threads at once; return the event log."""
+    start = threading.Barrier(n_threads)
+
+    def worker():
+        start.wait()
+        for _ in range(hits_per_thread):
+            try:
+                failpoints.fire(site)
+            except ChaosError:
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return failpoints.event_log()
+
+
+class TestDeterminism:
+    def test_same_seed_same_sequence_across_thread_interleavings(self):
+        logs = []
+        for _ in range(2):
+            failpoints.configure("loader.fetch:ioerror:0.3:123")
+            logs.append(_hammer("loader.fetch"))
+            failpoints.disarm()
+        assert logs[0], "schedule injected nothing"
+        # the k-th hit's decision is a pure function of (seed, site, kind,
+        # k): the fired hit-index set is identical run to run, no matter
+        # how the 8 threads interleaved
+        assert logs[0] == logs[1]
+        assert [e["seq"] for e in logs[0]] == sorted(
+            {e["seq"] for e in logs[0]}
+        )
+
+    def test_different_seeds_differ(self):
+        fired = []
+        for seed in (1, 2):
+            failpoints.configure(f"loader.fetch:ioerror:0.5:{seed}")
+            _hammer("loader.fetch", n_threads=2, hits_per_thread=100)
+            fired.append([e["seq"] for e in failpoints.event_log()])
+            failpoints.disarm()
+        assert fired[0] != fired[1]
+
+    def test_sites_have_independent_streams(self):
+        failpoints.configure(
+            "loader.fetch:ioerror:0.5:9,batcher.flush:ioerror:0.5:9"
+        )
+        for _ in range(50):
+            for site in ("loader.fetch", "batcher.flush"):
+                try:
+                    failpoints.fire(site)
+                except ChaosError:
+                    pass
+        hits = failpoints.site_hits()
+        assert hits["loader.fetch"] == hits["batcher.flush"] == 50
+
+
+# -------------------------------------------------------- disarmed no-op
+
+
+class TestDisarmedNoOp:
+    def test_fire_returns_none_and_logs_nothing(self):
+        assert failpoints.fire("loader.fetch", index=3) is None
+        assert failpoints.event_log() == []
+        assert failpoints.site_hits() == {}
+
+    def test_disarmed_fire_is_cheap(self):
+        # the disarmed path is one module-global boolean test; 200k calls
+        # in well under a second even on a loaded CI box. This is the
+        # regression tripwire for someone adding work before the guard.
+        t0 = time.perf_counter()
+        for _ in range(200_000):
+            failpoints.fire("batcher.flush")
+        assert time.perf_counter() - t0 < 1.0
+
+
+# ------------------------------------------------------- kind behaviors
+
+
+class TestKinds:
+    def test_max_fires_exhausts(self):
+        failpoints.configure("loader.fetch:ioerror:1.0:0:0:2")
+        errs = 0
+        for _ in range(5):
+            try:
+                failpoints.fire("loader.fetch")
+            except ChaosError:
+                errs += 1
+        assert errs == 2
+
+    def test_after_skips_early_hits(self):
+        failpoints.configure(
+            [Rule("loader.fetch", "ioerror", 1.0, 0, max_fires=1, after=3)]
+        )
+        outcomes = []
+        for _ in range(5):
+            try:
+                failpoints.fire("loader.fetch")
+                outcomes.append("ok")
+            except ChaosError:
+                outcomes.append("err")
+        assert outcomes == ["ok", "ok", "ok", "err", "ok"]
+
+    def test_delay_sleeps_at_site(self):
+        failpoints.configure("http.handler:delay:1.0:0:30:1")
+        t0 = time.perf_counter()
+        inj = failpoints.fire("http.handler")
+        assert time.perf_counter() - t0 >= 0.025
+        assert inj.kind == "delay"
+
+    def test_torn_write_truncates(self, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"0123456789")
+        fault = Fault("checkpoint.write", "torn_write", seq=0, arg=4.0)
+        touched = failpoints.apply_file_fault(fault, str(p))
+        assert touched == [str(p)]
+        assert p.read_bytes() == b"0123"
+
+    def test_crc_corrupt_flips_byte_same_length(self, tmp_path):
+        d = tmp_path / "step"
+        d.mkdir()
+        (d / "data.bin").write_bytes(b"abcdef")
+        fault = Fault("checkpoint.write", "crc_corrupt", seq=0, arg=0.0)
+        failpoints.apply_file_fault(fault, str(d))
+        got = (d / "data.bin").read_bytes()
+        assert len(got) == 6 and got != b"abcdef"
+
+    def test_poison_batch_nans_images_only(self):
+        batch = {
+            "image": np.ones((2, 4, 4, 3), np.float32),
+            "label": np.arange(2),
+        }
+        bad = failpoints.poison_batch(batch)
+        assert np.isnan(bad["image"]).all()
+        np.testing.assert_array_equal(bad["label"], batch["label"])
+        assert np.isfinite(batch["image"]).all()  # original untouched
+
+    def test_sink_sees_every_event(self):
+        seen = []
+        failpoints.configure(
+            "loader.fetch:ioerror:1.0:0:0:2", sink=seen.append
+        )
+        for _ in range(3):
+            try:
+                failpoints.fire("loader.fetch", index=7)
+            except ChaosError:
+                pass
+        assert len(seen) == 2
+        assert all(e["site"] == "loader.fetch" for e in seen)
+        assert all(e["index"] == 7 for e in seen)
+
+
+# --------------------------------------------- containment: data loader
+
+
+class TestLoaderContainment:
+    def test_fetch_substitutes_neighbors_under_injected_ioerror(self):
+        from replication_faster_rcnn_tpu.config import DataConfig
+        from replication_faster_rcnn_tpu.data import SyntheticDataset
+        from replication_faster_rcnn_tpu.data.loader import fetch_sample
+
+        ds = SyntheticDataset(
+            DataConfig(dataset="synthetic", image_size=(16, 16), max_boxes=4),
+            length=8,
+        )
+        failpoints.configure("loader.fetch:ioerror:0.4:5")
+        skipped = []
+        for i in range(len(ds)):
+            sample = fetch_sample(
+                ds, i, on_skip=lambda idx, exc: skipped.append(idx)
+            )
+            assert np.isfinite(sample["image"]).all()
+        assert skipped, "0.4-probability rule never fired over 8 fetches"
+
+    def test_nan_kind_poisons_fetched_sample(self):
+        from replication_faster_rcnn_tpu.config import DataConfig
+        from replication_faster_rcnn_tpu.data import SyntheticDataset
+        from replication_faster_rcnn_tpu.data.loader import fetch_sample
+
+        ds = SyntheticDataset(
+            DataConfig(dataset="synthetic", image_size=(16, 16), max_boxes=4),
+            length=4,
+        )
+        failpoints.configure("loader.fetch:nan:1.0:0:0:1")
+        sample = fetch_sample(ds, 0)
+        assert np.isnan(sample["image"]).all()
+
+
+# -------------------------------------- containment: checkpoint.write
+
+
+def _shim_trainer(tmp_path):
+    """A Trainer stripped to its save path: real orbax manager + manifest
+    machinery, no model/optimizer construction (that is what keeps this
+    in the fast tier). ``Trainer.save`` touches exactly these attrs."""
+    import orbax.checkpoint as ocp
+
+    from replication_faster_rcnn_tpu.telemetry import spans as tspans
+    from replication_faster_rcnn_tpu.train.trainer import Trainer
+
+    tr = Trainer.__new__(Trainer)
+    tr.workdir = str(tmp_path)
+    tr.config = None
+    tr._topology = {"process_count": 1, "device_count": 1}
+    tr._async_writer = None
+    tr.tracer = tspans.NULL_TRACER
+    tr.watchdog = None
+    incidents = []
+    tr._fault_incident = lambda kind, **f: incidents.append((kind, f))
+    state = {
+        "params": {"w": np.ones((4, 4), np.float32)},
+        "step": np.zeros((), np.int64),
+    }
+    tr._replicated_state = lambda: state
+    tr._ckpt_mgr = ocp.CheckpointManager(  # backs the lazy property
+        str(tmp_path),
+        options=ocp.CheckpointManagerOptions(max_to_keep=4, create=True),
+    )
+    return tr, incidents
+
+
+class TestCheckpointWriteContainment:
+    def test_injected_scheduled_save_failure_contained_and_retried(
+        self, tmp_path, capsys
+    ):
+        tr, incidents = _shim_trainer(tmp_path)
+        try:
+            failpoints.configure("checkpoint.write:ioerror:1.0:0:0:1")
+            # first save: injected IOError rides the scheduled containment
+            assert tr.save(step=1, kind="scheduled") is False
+            assert tr.checkpoint_manager.latest_step() is None
+            kinds = [k for k, _ in incidents]
+            assert "checkpoint_save_failed" in kinds
+            assert "injected IOError" in capsys.readouterr().err
+            # rule exhausted (max_fires=1): the retry lands
+            assert tr.save(step=1, kind="scheduled") is True
+            assert tr.checkpoint_manager.latest_step() == 1
+        finally:
+            tr.checkpoint_manager.close()
+
+    def test_injected_required_save_failure_raises(self, tmp_path):
+        tr, _ = _shim_trainer(tmp_path)
+        try:
+            failpoints.configure("checkpoint.write:ioerror:1.0:0")
+            with pytest.raises(ChaosError):
+                tr.save(step=1, kind="emergency")
+        finally:
+            tr.checkpoint_manager.close()
+
+    def test_torn_manifest_discards_step_on_restore(self, tmp_path):
+        """checkpoint.manifest torn_write garbles the sidecar; the
+        verified restore must refuse that step."""
+        from replication_faster_rcnn_tpu.train import fault
+
+        tr, _ = _shim_trainer(tmp_path)
+        try:
+            assert tr.save(step=1, kind="scheduled") is True
+            failpoints.configure(
+                "checkpoint.manifest:torn_write:1.0:0:3:1"
+            )
+            assert tr.save(step=2, kind="scheduled") is True
+            assert fault.load_manifest(str(tmp_path), 2) is None
+            assert fault.load_manifest(str(tmp_path), 1) is not None
+        finally:
+            tr.checkpoint_manager.close()
+
+
+# ----------------------------------------------------- acceptance smoke
+
+
+class TestChaosSmoke:
+    def test_run_smoke_invariants_and_reproducibility(self, tmp_path):
+        from replication_faster_rcnn_tpu.faultlib import chaos
+
+        result = chaos.run_smoke(str(tmp_path), seed=4)
+        assert result["ok"] is True
+        assert result["injected_events"] > 0
+        assert result["legs"]["loader"]["skipped"] >= 0
+        assert (
+            result["legs"]["checkpoint"]["restored_step"]
+            < result["legs"]["checkpoint"]["torn_step"]
+        )
+        assert result["legs"]["batcher"]["recovered"] is True
+        assert not failpoints.armed()  # run_smoke must clean up
+
+    def test_cli_chaos_smoke_subcommand(self, tmp_path, capsys):
+        from replication_faster_rcnn_tpu import cli
+
+        rc = cli.main(
+            ["chaos", "--smoke", "--seed", "2",
+             "--workdir", str(tmp_path), "--json"]
+        )
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["ok"] is True and out["seed"] == 2
+        # both passes left their stores behind under --workdir
+        assert os.path.isdir(tmp_path / "pass1")
+        assert os.path.isdir(tmp_path / "pass2")
+
+    def test_cli_chaos_without_smoke_flag_errors(self, capsys):
+        from replication_faster_rcnn_tpu import cli
+
+        assert cli.main(["chaos"]) == 2
+        assert "--smoke" in capsys.readouterr().err
