@@ -1,0 +1,14 @@
+"""``python -m replication_faster_rcnn_tpu`` — same entry as ``frcnn``.
+
+The elastic fleet supervisor (``frcnn train --elastic``) respawns its
+per-generation training child through this module path, so children
+work in environments where the console script is not on PATH (test
+venvs, bare checkouts).
+"""
+
+import sys
+
+from replication_faster_rcnn_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,10 +49,11 @@ HLO_RULES: Dict[str, str] = {
 }
 
 # the audited program matrix: every feed the Trainer can run, single-step
-# and fused — including the ZeRO-1 variant of the shard_map backend —
-# plus eval (9 programs) and the serving engine's bucket matrix
-# (audit_config's 2 resolutions × 2 batch sizes = 4 more)
-AUDIT_FEEDS = ("loader", "cached", "spmd", "zero")
+# and fused — including the ZeRO-1 variant of the shard_map backend and
+# its LAMB chain (sharded trust ratio) — plus eval (11 programs) and the
+# serving engine's bucket matrix (audit_config's 2 resolutions × 2 batch
+# sizes = 4 more)
+AUDIT_FEEDS = ("loader", "cached", "spmd", "zero", "zero_lamb")
 AUDIT_KS = (1, 2)
 AUDIT_BANK_NAME = "ci"
 AUDIT_CACHE_N = 4
@@ -253,7 +254,7 @@ def check_contracts(
             )
         collectives = fp.get("collectives", {})
         ar = collectives.get("all_reduce")
-        if fp.get("feed") in ("spmd", "zero"):
+        if fp.get("feed") in ("spmd", "zero", "zero_lamb"):
             # the gradient exchange: plain psum all_reduces on the
             # replicated backend, psum_scatter reduce_scatters under
             # ZeRO-1 — either way one bf16 collective per float grad leaf
@@ -310,7 +311,10 @@ def check_contracts(
                         "all_reduces only",
                     )
                 )
-        elif fp.get("feed") == "zero":
+        elif fp.get("feed") in ("zero", "zero_lamb"):
+            # zero_lamb shares the inventory: LAMB's sharded trust-ratio
+            # norm psums lower as additional all_reduce ops, a kind
+            # already required here (their count is pinned by HX005)
             required = {"all_reduce", "reduce_scatter", "all_gather"}
             missing = sorted(required - set(collectives))
             if missing:

@@ -118,6 +118,10 @@ def _build_config(args):
         train_kw["warmup_epochs"] = args.warmup_epochs
     if getattr(args, "lars", False):
         train_kw["lars"] = True
+    if getattr(args, "optimizer", None):
+        train_kw["optimizer"] = args.optimizer
+    if getattr(args, "checkpoint_every_steps", None) is not None:
+        train_kw["checkpoint_every_steps"] = args.checkpoint_every_steps
     if train_kw:
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **train_kw))
     if getattr(args, "compile_cache", None):
@@ -194,7 +198,7 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "in 'frcnn check'")
     p.add_argument("--chaos-spec", default=None, metavar="SPEC",
                    help="deterministic fault injection (faultlib): "
-                        "'site:kind:prob:seed[:arg[:max_fires]]' comma "
+                        "'site:kind:prob:seed[:arg[:max_fires[:after]]]' comma "
                         "list, or a JSON schedule file (path or @path); "
                         "sites/kinds in faultlib.failpoints.SITES/KINDS. "
                         "Same spec + seed => identical fault sequence")
@@ -247,6 +251,21 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "arXiv:1708.03888) between Adam and the LR — the "
                         "large-batch optimizer recipe. Incompatible with "
                         "--shard-opt on the spmd backend (per-leaf norms)")
+    p.add_argument("--optimizer", default=None, choices=[None, "adam", "lamb"],
+                   help="optimizer chain (train.optimizer): 'adam' "
+                        "(default) or 'lamb' — Adam plus a per-layer "
+                        "trust ratio (arXiv:1904.00962). LAMB composes "
+                        "with --shard-opt on BOTH backends: the spmd+ZeRO "
+                        "path computes each layer's norms from its local "
+                        "shard and completes them with a psum, so the "
+                        "trust ratio is exact at 1/N moment memory")
+    p.add_argument("--checkpoint-every-steps", type=int, default=None,
+                   metavar="N",
+                   help="scheduled checkpoint every N optimizer steps, in "
+                        "addition to the per-epoch cadence (0 = off). "
+                        "Bounds the rollback of an elastic re-formation, "
+                        "which resumes from the last verified step "
+                        "(train.checkpoint_every_steps)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each trunk block (recompute "
                         "activations in backward; saves HBM)")
@@ -377,8 +396,61 @@ def _threadsan_session(enabled: bool):
 
 
 def cmd_train(args) -> int:
+    if getattr(args, "elastic", False):
+        # fleet supervisor mode: this process never touches jax — it
+        # spawns the real training child per fleet generation and
+        # re-forms the fleet when the child dies of a lost rank
+        return _cmd_train_elastic(args)
     with _threadsan_session(getattr(args, "threadsan", False)) as san:
         return _cmd_train_impl(args, san)
+
+
+def _cmd_train_elastic(args) -> int:
+    """--elastic: per-host fleet supervisor (parallel/elastic.py).
+
+    Spawns the training child (this same CLI minus --elastic, plus the
+    generation's topology flags) and loops the re-formation protocol:
+    a child that exits EXIT_FLEET_SHRINK — its elastic agent detected a
+    peer's lease expiring — triggers claim/plan arbitration with the
+    other surviving supervisors through the shared fleet dir, and the
+    child respawns at the surviving world size with --resume, a bumped
+    coordinator port and FRCNN_FLEET_GENERATION exported. Exit 0 and
+    EXIT_PREEMPTED propagate; any other child exit means this host is
+    the casualty and its supervisor leaves the fleet."""
+    import os
+    import subprocess
+
+    from replication_faster_rcnn_tpu.config import get_config
+    from replication_faster_rcnn_tpu.parallel import elastic
+
+    world = args.num_processes or 1
+    rank = args.process_id or 0
+    coordinator = args.coordinator or "127.0.0.1:9911"
+    host, _, port = coordinator.rpartition(":")
+    fleet_dir = os.path.join(args.workdir, "fleet")
+    el_cfg = get_config(args.config).elastic
+    argv0 = list(getattr(args, "_argv", None) or sys.argv[1:])
+
+    def spawn(generation, rank, world, coordinator):
+        child = elastic.child_argv(
+            argv0, generation=generation, rank=rank, world=world,
+            coordinator=coordinator,
+        )
+        return subprocess.Popen(
+            [sys.executable, "-m", "replication_faster_rcnn_tpu", *child],
+            env=elastic.child_env(os.environ, fleet_dir, generation),
+        )
+
+    return elastic.run_supervisor(
+        spawn,
+        fleet_dir=fleet_dir,
+        rank=rank,
+        world=world,
+        host=host or "127.0.0.1",
+        base_port=int(port),
+        settle_s=el_cfg.settle_s,
+        max_generations=el_cfg.max_generations,
+    )
 
 
 def _cmd_train_impl(args, san=None) -> int:
@@ -408,7 +480,9 @@ def _cmd_train_impl(args, san=None) -> int:
     from replication_faster_rcnn_tpu.utils.profiling import trace
 
     from replication_faster_rcnn_tpu.train.fault import (
+        EXIT_FLEET_SHRINK,
         EXIT_PREEMPTED,
+        FleetShrink,
         GracefulShutdown,
         Preempted,
         check_step_metrics,
@@ -487,6 +561,20 @@ def _cmd_train_impl(args, san=None) -> int:
     except Preempted as p:
         print(f"{p} (exit {EXIT_PREEMPTED})", file=sys.stderr)
         return EXIT_PREEMPTED
+    except FleetShrink as fs:
+        # the elastic agent already wrote the durable shrink intent the
+        # supervisor re-forms from, and deliberately saved nothing (a
+        # checkpoint save is a cross-process collective — it would hang
+        # on the dead peer). Hard-exit: a normal interpreter exit would
+        # run jax.distributed's atexit shutdown, which can wedge on the
+        # dead peer, and the coordination service SIGABRTs us at ~10s
+        # regardless.
+        import os
+
+        print(f"{fs} (exit {EXIT_FLEET_SHRINK})", file=sys.stderr)
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os._exit(EXIT_FLEET_SHRINK)
     except BaseException as e:
         if args.on_crash_checkpoint:
             # best-effort: persist whatever state survived the crash; the
@@ -1056,6 +1144,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_train.add_argument("--debug-nans", action="store_true",
                          help="enable jax_debug_nans (every jit output "
                               "checked; errors pinpoint the emitting op)")
+    p_train.add_argument("--elastic", action="store_true",
+                         help="elastic fleet mode: this process becomes a "
+                              "per-host supervisor that spawns the real "
+                              "training child and survives rank loss — a "
+                              "lost rank's lease expiry re-forms the fleet "
+                              "at the surviving world size, resuming from "
+                              "the last verified checkpoint INSIDE the "
+                              "same epoch (parallel/elastic.py; pair with "
+                              "--checkpoint-every-steps to bound rollback)")
     p_train.set_defaults(fn=cmd_train)
 
     p_eval = sub.add_parser("eval", help="evaluate mAP")
@@ -1262,6 +1359,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_audit.set_defaults(fn=cmd_audit)
 
     args = parser.parse_args(argv)
+    # the elastic supervisor rewrites the EXACT argv this process was
+    # invoked with into each generation's child argv
+    args._argv = list(argv) if argv is not None else list(sys.argv[1:])
     return args.fn(args)
 
 

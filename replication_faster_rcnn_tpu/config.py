@@ -301,10 +301,25 @@ class TrainConfig:
     # The reference's __main__ uses lr=0.01 with Adam, which diverges in
     # practice; 1e-4 is the stable default. `--lr` restores any value.
     weight_decay: float = 5e-6
+    # optimizer family: "adam" (the reference's choice) or "lamb" —
+    # Adam preconditioning + per-layer trust-ratio rescaling
+    # (arXiv:1904.00962 via the You et al. large-batch line; see
+    # train/train_step.py::make_optimizer). Unlike the `lars` flag below,
+    # LAMB composes with ZeRO-1 sharded optimizer state on the shard_map
+    # backend: its per-layer norms are computed from shard-local partial
+    # sums psummed over the data axis (scale_by_sharded_trust_ratio).
+    optimizer: str = "adam"  # adam | lamb
     n_epoch: int = 50
     batch_size: int = 8  # per-step global batch (reference default 2)
     smooth_l1_sigma: float = 1.0
     checkpoint_every_epochs: int = 10
+    # additional dispatch-boundary scheduled saves every N global steps
+    # (0 = off, the default: epoch-granular saves only). Elastic fleets
+    # want this tight — a surviving rank resumes from the last verified
+    # step, so this knob bounds the re-trained window after a shrink.
+    # Step counts are deterministic across ranks, so multi-process saves
+    # stay lockstep collectives.
+    checkpoint_every_steps: int = 0
     seed: int = 0
     # loss weights: the reference sums the 4 losses unweighted (train.py:123)
     loss_weights: Tuple[float, float, float, float] = (1.0, 1.0, 1.0, 1.0)
@@ -386,6 +401,21 @@ class TrainConfig:
     def __post_init__(self):
         if self.backend not in ("auto", "spmd"):
             raise ValueError(f"backend must be 'auto' or 'spmd', got {self.backend!r}")
+        if self.optimizer not in ("adam", "lamb"):
+            raise ValueError(
+                f"optimizer must be 'adam' or 'lamb', got {self.optimizer!r}"
+            )
+        if self.optimizer == "lamb" and self.lars:
+            raise ValueError(
+                "optimizer='lamb' already applies the per-layer trust "
+                "ratio after Adam; combining it with lars=True would "
+                "rescale twice — drop one"
+            )
+        if self.checkpoint_every_steps < 0:
+            raise ValueError(
+                "checkpoint_every_steps must be >= 0 (0 = off), got "
+                f"{self.checkpoint_every_steps}"
+            )
         if self.adam_mu_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"adam_mu_dtype must be float32|bfloat16, got {self.adam_mu_dtype!r}"
@@ -514,7 +544,7 @@ class DebugConfig:
     strict_warmup: int = 1
     threadsan: bool = False
     # seeded fault-injection schedule (faultlib/failpoints.py):
-    # "site:kind:prob:seed[:arg[:max_fires]],..." or a JSON schedule
+    # "site:kind:prob:seed[:arg[:max_fires[:after]]],..." or a JSON schedule
     # path. Empty = disarmed (the failpoints are zero-overhead no-ops).
     # Armed by the CLI entry points from --chaos-spec.
     chaos_spec: str = ""
@@ -551,6 +581,58 @@ class AnalysisConfig:
             raise ValueError(
                 "analysis.fingerprint_dir must be a string path, got "
                 f"{self.fingerprint_dir!r}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """Elastic fleet training (parallel/elastic.py, `frcnn train --elastic`).
+
+    A per-host supervisor process spawns the training child once per fleet
+    *generation*; inside the child a heartbeat thread renews this rank's
+    lease file every ``heartbeat_interval_s`` and the trainer checks peer
+    leases at dispatch boundaries. A peer whose lease is older than
+    ``lease_timeout_s`` is declared lost: the survivor exits with
+    ``EXIT_FLEET_SHRINK`` (falling back to its last CRC-verified
+    checkpoint) and the supervisors re-form the fleet at the surviving
+    world size on a bumped coordinator port — resuming INSIDE the same
+    epoch via the offset-based feeds.
+
+    ``lease_timeout_s`` must stay well under ~10 s: the JAX coordination
+    service force-aborts (SIGABRT) a process whose peers stop heartbeating
+    after about that long, and the survivor must detect the loss, persist
+    its shrink intent, and exit cleanly BEFORE that abort lands — there is
+    no catchable error path once a gloo collective hangs on a dead peer.
+    """
+
+    heartbeat_interval_s: float = 0.5
+    lease_timeout_s: float = 5.0
+    # how long re-forming supervisors wait for survivor claims before the
+    # lowest surviving rank writes the generation plan
+    settle_s: float = 2.0
+    # supervisor gives up after this many re-formations (a fleet that
+    # shrinks every few steps has an environment problem, not a rank loss)
+    max_generations: int = 8
+
+    def __post_init__(self):
+        if self.heartbeat_interval_s <= 0:
+            raise ValueError(
+                "elastic.heartbeat_interval_s must be > 0, got "
+                f"{self.heartbeat_interval_s}"
+            )
+        if self.lease_timeout_s <= self.heartbeat_interval_s:
+            raise ValueError(
+                "elastic.lease_timeout_s must exceed heartbeat_interval_s "
+                f"({self.heartbeat_interval_s}), got {self.lease_timeout_s}"
+            )
+        if self.settle_s <= 0:
+            raise ValueError(
+                f"elastic.settle_s must be > 0, got {self.settle_s}"
+            )
+        if self.max_generations < 1:
+            raise ValueError(
+                "elastic.max_generations must be >= 1, got "
+                f"{self.max_generations}"
             )
 
 
@@ -664,6 +746,7 @@ class FasterRCNNConfig:
     debug: DebugConfig = dataclasses.field(default_factory=DebugConfig)
     analysis: AnalysisConfig = dataclasses.field(default_factory=AnalysisConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    elastic: ElasticConfig = dataclasses.field(default_factory=ElasticConfig)
 
     def feature_size(self, image_size: Optional[Tuple[int, int]] = None) -> Tuple[int, int]:
         """Spatial size of the stride-16 feature map for a given image size.

@@ -191,9 +191,18 @@ class CachedSampler:
         self.process_index = int(process_index)
         self.process_count = int(process_count)
         self.epoch = 0
+        self.start_batch = 0  # mid-epoch offset (set_epoch)
 
-    def set_epoch(self, epoch: int) -> None:
+    def set_epoch(self, epoch: int, start_batch: int = 0) -> None:
+        """Select the epoch, optionally resuming at a mid-epoch global
+        batch offset — same contract as ``DataLoader.set_epoch``: the
+        consumed prefix of the deterministic global order is skipped
+        without being drawn, and the suffix re-partitions disjointly if
+        ``process_count`` changed (elastic fleet shrink)."""
+        if start_batch < 0:
+            raise ValueError(f"start_batch must be >= 0, got {start_batch}")
         self.epoch = int(epoch)
+        self.start_batch = int(start_batch)
 
     def __len__(self) -> int:
         if self.drop_last:
@@ -235,7 +244,7 @@ class CachedSampler:
         local = bs // self.process_count
         lo = self.process_index * local
         end = len(order) - (len(order) % bs if self.drop_last else 0)
-        for i in range(0, end, bs):
+        for i in range(self.start_batch * bs, end, bs):
             yield self.selection(order[i + lo : i + lo + local])
 
 

@@ -222,6 +222,7 @@ class DataLoader:
         self.seed = seed
         self.worker_mode = worker_mode
         self.epoch = 0
+        self.start_batch = 0  # mid-epoch offset (set_epoch)
         self._q: Optional["queue.Queue"] = None  # live prefetch queue
         # sample fault containment (fetch_sample): failed samples are
         # retried once then substituted, up to this many per epoch — past
@@ -231,8 +232,22 @@ class DataLoader:
         self._epoch_skips = 0
         self._skip_lock = threading.Lock()
 
-    def set_epoch(self, epoch: int) -> None:
+    def set_epoch(self, epoch: int, start_batch: int = 0) -> None:
+        """Select the epoch — and optionally a mid-epoch offset.
+
+        ``start_batch`` resumes iteration at that global batch index of
+        the epoch's deterministic order: the consumed prefix is never
+        decoded or collated (unlike draw-and-discard replay), and the
+        remaining suffix is bitwise identical to an uninterrupted epoch —
+        the global order is a pure function of (seed, epoch), so slicing
+        it is exact. Elastic fleet shrink leans on the same property: a
+        re-formed feed at a NEW process_count and the same ``start_batch``
+        re-partitions the unconsumed suffix disjointly across the new
+        world size."""
+        if start_batch < 0:
+            raise ValueError(f"start_batch must be >= 0, got {start_batch}")
         self.epoch = epoch
+        self.start_batch = int(start_batch)
         with self._skip_lock:  # pool workers bump the counter concurrently
             self._epoch_skips = 0  # the skip budget is per-epoch
 
@@ -286,7 +301,7 @@ class DataLoader:
         end = len(order) - (len(order) % bs if self.drop_last else 0)
         local = bs // self.process_count
         lo = self.process_index * local
-        for i in range(0, end, bs):
+        for i in range(self.start_batch * bs, end, bs):
             # this process's contiguous block of the global batch (the
             # whole batch in single-process runs: lo=0, local=bs)
             yield order[i + lo : i + lo + local]

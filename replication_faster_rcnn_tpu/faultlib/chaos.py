@@ -20,7 +20,16 @@ Legs:
 3. **batcher** — a guaranteed ``batcher.flush`` IOError on the first
    flush: exactly that flush's futures fail, the worker survives, and
    the next flush succeeds.
-4. **determinism** — legs 1–3 run twice under the same seed; the two
+4. **fleet** — a simulated 2-rank elastic fleet driven single-threaded
+   (fake clock, manual beats): a seeded ``heartbeat.beat`` drop kills
+   rank 1 on exactly its 2nd lease renewal, the survivor's watchdog
+   declares it lost once the lease ages out, writes the shrink intent,
+   and the re-form protocol (claim → plan) lands on a 1-rank fleet;
+   then a seeded ``collective.init`` drop replays the bring-up-time
+   variant of the same loss.  Same machinery as the real
+   ``frcnn train --elastic`` path (parallel/elastic.py), minus the
+   process boundaries.
+5. **determinism** — legs 1–4 run twice under the same seed; the two
    injected-event logs must match exactly.
 """
 
@@ -54,6 +63,19 @@ def smoke_rules(seed: int) -> List[failpoints.Rule]:
         ),
         failpoints.Rule(
             "batcher.flush", "ioerror", 1.0, seed + 2, max_fires=1
+        ),
+        # the fleet leg beats ranks 0,1 strictly alternating through ONE
+        # registry, so per-site hit indices map onto ranks: after=3 lands
+        # the drop on hit 3 = rank 1's 2nd renewal (arg names the victim)
+        failpoints.Rule(
+            "heartbeat.beat", "drop", 1.0, seed + 3,
+            arg=1, max_fires=1, after=3,
+        ),
+        # bring-up variant: inits fire rank 0 then rank 1, after=1 lands
+        # the drop on rank 1's init
+        failpoints.Rule(
+            "collective.init", "drop", 1.0, seed + 4,
+            arg=1, max_fires=1, after=1,
         ),
     ]
 
@@ -203,6 +225,103 @@ def _batcher_leg() -> Dict[str, Any]:
     return {"failed_futures": len(errs), "recovered": True}
 
 
+def _fleet_leg(workdir: str, seed: int) -> Dict[str, Any]:
+    import os
+
+    from replication_faster_rcnn_tpu.parallel import elastic
+
+    fleet_dir = os.path.join(workdir, "fleet")
+    now = [0.0]
+    dead: List[int] = []
+    incidents: List[Dict[str, Any]] = []
+
+    def _agent(rank: int) -> elastic.ElasticAgent:
+        return elastic.ElasticAgent(
+            fleet_dir,
+            generation=0,
+            rank=rank,
+            world=2,
+            heartbeat_interval_s=0.5,
+            lease_timeout_s=1.0,
+            clock=lambda: now[0],
+            # sudden death, minus the os._exit: the rank just stops beating
+            on_drop=lambda r=rank: dead.append(r),
+            on_lost=lambda lost, survivors: incidents.append(
+                {"event": "fleet_rank_lost", "lost": lost, "survivors": survivors}
+            ),
+            exit_on_shrink=False,
+        )
+
+    agents = [_agent(0), _agent(1)]
+    # strict r0,r1 beat alternation through the shared registry — the
+    # smoke rule's after=3 deterministically lands the drop on rank 1's
+    # 2nd renewal (hit 3); a dead rank never beats again
+    for _ in range(2):
+        for a in agents:
+            if a.rank not in dead:
+                a.beat()
+        now[0] += 0.5
+    _check(dead == [1], f"fleet leg: seeded drop killed ranks {dead}, not [1]")
+
+    # the survivor keeps renewing; rank 1's lease (last written at t=0.0)
+    # ages past the 1.0s timeout while rank 0's stays fresh
+    lost: List[int] = []
+    for _ in range(3):
+        agents[0].beat()
+        lost = agents[0].lost_ranks()
+        if lost:
+            break
+        now[0] += 0.5
+    _check(lost == [1], f"fleet leg: watchdog saw lost={lost}, want [1]")
+    # the watchdog's loss path: observer -> durable intent -> check()
+    # (exit_on_shrink=False stands in for the os._exit(76) hand-off)
+    agents[0]._on_peer_lost(lost)
+    _check(
+        agents[0].check() == [1],
+        f"fleet leg: main-thread check() saw {agents[0].check()}, want [1]",
+    )
+    intent = elastic.read_intent(fleet_dir, 0)
+    _check(
+        intent is not None
+        and intent["lost"] == [1]
+        and intent["survivors"] == [0],
+        f"fleet leg: durable shrink intent is wrong: {intent}",
+    )
+    _check(
+        incidents == [{"event": "fleet_rank_lost", "lost": [1], "survivors": [0]}],
+        f"fleet leg: on_lost observer saw {incidents}",
+    )
+
+    # re-form: the survivor claims generation 1; as lowest claimant it
+    # arbitrates the plan — a 1-rank fleet
+    elastic.write_claim(fleet_dir, 1, 0)
+    claims = elastic.read_claims(fleet_dir, 1, 2)
+    _check(claims == [0], f"fleet leg: gen-1 claims {claims}, want [0]")
+    elastic.write_plan(fleet_dir, 1, claims)
+    plan = elastic.read_plan(fleet_dir, 1)
+    _check(
+        plan == {"generation": 1, "survivors": [0], "world": 1},
+        f"fleet leg: gen-1 plan is wrong: {plan}",
+    )
+
+    # bring-up variant: replay the same loss at collective-init time —
+    # rank 0 inits first, the seeded drop (after=1) names rank 1
+    init_deaths: List[int] = []
+    for r in (0, 1):
+        inj = failpoints.fire("collective.init", num_processes=2, process_id=r)
+        if inj is not None and inj.kind == "drop" and int(inj.arg) == r:
+            init_deaths.append(r)
+    _check(
+        init_deaths == [1],
+        f"fleet leg: init-time drop killed ranks {init_deaths}, not [1]",
+    )
+    return {
+        "dropped_rank": dead[0],
+        "reformed_world": plan["world"],
+        "init_dropped_rank": init_deaths[0],
+    }
+
+
 def _one_pass(workdir: str, seed: int) -> Dict[str, Any]:
     failpoints.configure(smoke_rules(seed))
     try:
@@ -210,6 +329,7 @@ def _one_pass(workdir: str, seed: int) -> Dict[str, Any]:
             "loader": _loader_leg(seed),
             "checkpoint": _checkpoint_leg(workdir, seed),
             "batcher": _batcher_leg(),
+            "fleet": _fleet_leg(workdir, seed),
         }
         events = failpoints.event_log()
     finally:

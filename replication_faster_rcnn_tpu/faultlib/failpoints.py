@@ -37,6 +37,11 @@ Sites (see the README failpoint table):
   batcher.flush        serving/batcher.py::MicroBatcher._flush
   collective.init      parallel/mesh.py::initialize_distributed
   http.handler         serving/server.py POST handler
+  heartbeat.beat       parallel/elastic.py::ElasticAgent.beat, per lease
+                       renewal; a ``drop`` whose ``arg`` equals this
+                       rank's index kills the rank (seeded rank loss —
+                       ``prob=1.0, after=k, max_fires=1`` lands it on
+                       exactly the k-th beat)
 
 Kinds:
   ioerror      raise ChaosError (an OSError) at the site
@@ -46,7 +51,7 @@ Kinds:
   delay        sleep ``arg`` milliseconds at the site
   drop         caller discards the unit of work (request/connection)
 
-Activation: ``configure("site:kind:prob:seed[:arg[:max_fires]],...")``
+Activation: ``configure("site:kind:prob:seed[:arg[:max_fires[:after]]],...")``
 or a JSON schedule file (``configure("/path/sched.json")`` — a list of
 rule objects, or ``{"rules": [...]}``). ``--chaos-spec`` on the CLI and
 ``debug.chaos_spec`` in the config route here.
@@ -89,6 +94,7 @@ SITES = (
     "batcher.flush",
     "collective.init",
     "http.handler",
+    "heartbeat.beat",
 )
 
 KINDS = ("ioerror", "torn_write", "crc_corrupt", "nan", "delay", "drop")
@@ -213,8 +219,9 @@ _sink: Optional[Callable[[Dict[str, Any]], None]] = None
 
 
 def parse_spec(spec: str) -> List[Rule]:
-    """Rules from a ``site:kind:prob:seed[:arg[:max_fires]],...`` string
-    or a JSON schedule file (a path ending ``.json`` or prefixed ``@``)."""
+    """Rules from a ``site:kind:prob:seed[:arg[:max_fires[:after]]],...``
+    string or a JSON schedule file (a path ending ``.json`` or prefixed
+    ``@``)."""
     spec = spec.strip()
     if not spec:
         return []
@@ -223,16 +230,18 @@ def parse_spec(spec: str) -> List[Rule]:
     rules = []
     for part in spec.split(","):
         fields = part.strip().split(":")
-        if len(fields) < 4 or len(fields) > 6:
+        if len(fields) < 4 or len(fields) > 7:
             raise ValueError(
                 f"bad failpoint spec {part!r}: want "
-                "site:kind:prob:seed[:arg[:max_fires]]"
+                "site:kind:prob:seed[:arg[:max_fires[:after]]]"
             )
         site, kind, prob, seed = fields[:4]
         arg = float(fields[4]) if len(fields) > 4 else 0.0
         max_fires = int(fields[5]) if len(fields) > 5 else 0
+        after = int(fields[6]) if len(fields) > 6 else 0
         rules.append(
-            Rule(site, kind, float(prob), int(seed), arg=arg, max_fires=max_fires)
+            Rule(site, kind, float(prob), int(seed), arg=arg,
+                 max_fires=max_fires, after=after)
         )
     return rules
 

@@ -1,0 +1,560 @@
+"""Elastic fleet training: survive rank loss mid-epoch (ROADMAP item).
+
+A fixed-world data-parallel fleet dies with its weakest member: when one
+rank is lost (spot reclaim, OOM kill, hardware fault), every surviving
+rank's next collective simply never completes.  On the gloo/CPU backend
+there is *no catchable error* — the survivor's psum blocks in C until the
+JAX coordination service declares the fleet unhealthy and force-aborts
+the process with SIGABRT roughly 10 seconds later.  Nothing downstream
+of the collective ever runs again, so recovery cannot live on the main
+thread and cannot assume a clean Python exit.
+
+This module is the whole recovery story, split across the two processes
+that survive a rank loss:
+
+In the **training child** (one per rank, spawned by the supervisor):
+
+  :class:`ElasticAgent` — a daemon thread that doubles as heartbeat
+  writer and collective watchdog.  Every ``heartbeat_interval_s`` it
+  (a) consults the ``heartbeat.beat`` failpoint — a seeded ``drop``
+  whose ``arg`` equals this rank's index kills the process mid-lease,
+  the deterministic stand-in for a real rank loss — then (b) renews
+  this rank's lease file and (c) checks every peer's lease age.  A peer
+  whose lease is older than ``lease_timeout_s`` is declared lost: the
+  agent records the incident, writes a durable *shrink intent* file,
+  and after a short grace (giving the main thread a chance to surface
+  :class:`~replication_faster_rcnn_tpu.train.fault.FleetShrink` at a
+  dispatch boundary) hard-exits with ``EXIT_FLEET_SHRINK`` — beating
+  the coordination service's ~10s abort, which is why
+  ``lease_timeout_s`` must stay well under 10 seconds.  The trainer
+  starts the agent lazily at the *first* dispatch boundary so the
+  multi-minute compile window cannot produce false lease expiries.
+
+In the **per-host supervisor** (:func:`run_supervisor`, entered via
+``frcnn train --elastic``):
+
+  A generation loop that spawns the training child and branches on how
+  it died.  Exit 0 / ``EXIT_PREEMPTED`` propagate; a child that exited
+  ``EXIT_FLEET_SHRINK`` (or left a shrink intent naming this rank a
+  survivor) triggers **re-formation**: each surviving supervisor writes
+  a claim file for the next generation, waits ``settle_s`` for the
+  claim set to quiesce, the lowest-ranked claimant arbitrates the plan
+  (survivor list, new world size), and every planned-in host respawns
+  the child at its new contiguous rank with ``--resume``, a bumped
+  coordinator port (``base_port + generation``) and the fleet
+  generation exported in ``FRCNN_FLEET_GENERATION``.  Any other exit
+  code means *this* host is the casualty: its supervisor leaves the
+  fleet without claiming, which is exactly how the injected-dead rank's
+  side of the protocol resolves.
+
+There is deliberately **no emergency checkpoint** on the shrink path:
+checkpoint saves are themselves cross-process collectives and would
+hang on the dead peer.  Survivors fall back to the last CRC-verified
+step (``train.checkpoint_every_steps`` bounds the rollback) and resume
+*inside the same epoch* — the loader's offset-based ``set_epoch``
+re-partitions the unconsumed suffix of the epoch's global sample order
+disjointly across the shrunken world, and ZeRO-1 optimizer shards are
+re-sliced for the new topology by the existing cross-topology restore.
+
+All fleet state is plain JSON files under one ``fleet_dir`` (atomic
+tmp + ``os.replace`` writes), which must be visible to every host of
+the fleet — the same shared-filesystem assumption the multi-host
+checkpoint layer already makes.  Same-seed runs reproduce the identical
+incident sequence: the heartbeat drop is decided by the failpoint
+registry's pure hash, and the ``fleet_reformed`` incident fields are
+step-free (generation, world size, survivors).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from replication_faster_rcnn_tpu.faultlib import failpoints
+
+# Environment contract between supervisor and training child. The child
+# reads these to find the fleet dir (enables the in-child ElasticAgent)
+# and to stamp the checkpoint manifest's topology with the generation.
+ENV_FLEET_DIR = "FRCNN_FLEET_DIR"
+ENV_GENERATION = "FRCNN_FLEET_GENERATION"
+
+
+def fleet_env(env=os.environ):
+    """(fleet_dir | None, generation) from the supervisor-exported env."""
+    fleet_dir = env.get(ENV_FLEET_DIR) or None
+    try:
+        generation = int(env.get(ENV_GENERATION, "0") or 0)
+    except ValueError:
+        generation = 0
+    return fleet_dir, generation
+
+
+def child_env(env, fleet_dir: str, generation: int) -> Dict[str, str]:
+    """The training child's environment: parent env + fleet exports."""
+    out = dict(env)
+    out[ENV_FLEET_DIR] = fleet_dir
+    out[ENV_GENERATION] = str(generation)
+    return out
+
+
+# ------------------------------------------------------------ fleet files
+#
+# One flat directory of small JSON files; every write is atomic
+# (tmp + os.replace) so a reader never sees a torn record. Names encode
+# generation + rank so successive generations never collide.
+
+
+def lease_path(fleet_dir: str, generation: int, rank: int) -> str:
+    return os.path.join(fleet_dir, f"hb_gen{generation}_rank{rank}.json")
+
+
+def intent_path(fleet_dir: str, generation: int) -> str:
+    return os.path.join(fleet_dir, f"shrink_gen{generation}.json")
+
+
+def claim_path(fleet_dir: str, generation: int, rank: int) -> str:
+    return os.path.join(fleet_dir, f"claim_gen{generation}_rank{rank}.json")
+
+
+def plan_path(fleet_dir: str, generation: int) -> str:
+    return os.path.join(fleet_dir, f"plan_gen{generation}.json")
+
+
+def _write_json_atomic(path: str, payload: Dict[str, Any]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def read_intent(fleet_dir: str, generation: int) -> Optional[Dict[str, Any]]:
+    """The shrink-intent record for ``generation``, if any survivor of
+    that generation declared one (None otherwise)."""
+    return _read_json(intent_path(fleet_dir, generation))
+
+
+def read_plan(fleet_dir: str, generation: int) -> Optional[Dict[str, Any]]:
+    return _read_json(plan_path(fleet_dir, generation))
+
+
+# --------------------------------------------------------- in-child agent
+
+
+class ElasticAgent:
+    """Heartbeat writer + peer-lease watchdog for one training rank.
+
+    One daemon thread per rank does three things every
+    ``heartbeat_interval_s``:
+
+      1. consults the ``heartbeat.beat`` failpoint (a ``drop`` whose
+         ``arg`` equals this rank kills the process via ``on_drop`` —
+         default ``os._exit(1)``, the sudden-death a real reclaim looks
+         like; drops naming other ranks are ignored here and land on
+         their target's own registry, which replays the same seeded
+         decision stream),
+      2. renews this rank's lease file, and
+      3. checks every peer lease's age.
+
+    A peer lease older than ``lease_timeout_s`` declares that rank lost:
+    ``on_lost`` fires once (the trainer logs the ``fleet_rank_lost``
+    incident there), the durable shrink intent is written, and the lost
+    set becomes visible to the main thread via :meth:`check`. If
+    ``exit_on_shrink`` is set (the production wiring), the thread then
+    waits ``exit_grace_s`` for the main thread to exit cleanly and
+    hard-exits with ``EXIT_FLEET_SHRINK`` — the main thread is usually
+    blocked inside the doomed collective and will never run again, and
+    the coordination service would SIGABRT us at ~10s, so the watchdog
+    cannot wait politely.
+
+    A peer with *no* lease file yet is considered alive: leases start
+    lazily at the first dispatch boundary, and compile skew between
+    ranks must not read as death.
+
+    ``clock`` and manual :meth:`beat` calls make the whole protocol
+    drivable single-threaded (``start_thread=False``) — the chaos
+    harness's fleet leg replays rank loss in-process with a fake clock
+    and asserts the same seed yields the identical event log.
+    """
+
+    def __init__(
+        self,
+        fleet_dir: str,
+        generation: int,
+        rank: int,
+        world: int,
+        *,
+        heartbeat_interval_s: float = 0.5,
+        lease_timeout_s: float = 5.0,
+        exit_grace_s: float = 2.0,
+        clock: Callable[[], float] = time.time,
+        on_drop: Optional[Callable[[], None]] = None,
+        on_lost: Optional[Callable[[List[int], List[int]], None]] = None,
+        exit_on_shrink: bool = True,
+    ) -> None:
+        self.fleet_dir = fleet_dir
+        self.generation = int(generation)
+        self.rank = int(rank)
+        self.world = int(world)
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.lease_timeout_s = float(lease_timeout_s)
+        self.exit_grace_s = float(exit_grace_s)
+        self.clock = clock
+        self.on_drop = on_drop
+        self.on_lost = on_lost
+        self.exit_on_shrink = exit_on_shrink
+        self._beats = 0
+        self._lost: List[int] = []
+        self._lost_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(fleet_dir, exist_ok=True)
+
+    # -- heartbeat side
+
+    def beat(self) -> None:
+        """One lease renewal: consult the failpoint, then write the lease."""
+        n = self._beats
+        self._beats = n + 1
+        inj = failpoints.fire(
+            "heartbeat.beat",
+            rank=self.rank,
+            generation=self.generation,
+            beat=n,
+        )
+        if inj is not None and inj.kind == "drop" and int(inj.arg) == self.rank:
+            if self.on_drop is not None:
+                self.on_drop()
+                return  # dead ranks do not renew their lease
+            # sudden death: no cleanup, no atexit — what a reclaimed
+            # host actually looks like from the peers' side
+            os._exit(1)
+        _write_json_atomic(
+            lease_path(self.fleet_dir, self.generation, self.rank),
+            {
+                "rank": self.rank,
+                "generation": self.generation,
+                "beat": n,
+                "t": self.clock(),
+            },
+        )
+
+    # -- watchdog side
+
+    def lost_ranks(self, now: Optional[float] = None) -> List[int]:
+        """Peers whose lease age exceeds the timeout (missing = alive)."""
+        if now is None:
+            now = self.clock()
+        lost = []
+        for r in range(self.world):
+            if r == self.rank:
+                continue
+            lease = _read_json(lease_path(self.fleet_dir, self.generation, r))
+            if lease is None:
+                continue
+            if now - float(lease.get("t", now)) > self.lease_timeout_s:
+                lost.append(r)
+        return lost
+
+    def survivors(self, lost: Sequence[int]) -> List[int]:
+        return [r for r in range(self.world) if r not in set(lost)]
+
+    def declare_shrink(self, lost: Sequence[int], step: int = -1) -> List[int]:
+        """Write the durable shrink intent (idempotent: last write wins,
+        every survivor writes the same survivor set). Returns survivors."""
+        survivors = self.survivors(lost)
+        _write_json_atomic(
+            intent_path(self.fleet_dir, self.generation),
+            {
+                "generation": self.generation,
+                "lost": sorted(int(r) for r in lost),
+                "survivors": survivors,
+                "step": int(step),
+                "detected_by": self.rank,
+            },
+        )
+        return survivors
+
+    def check(self) -> List[int]:
+        """Main-thread view of the watchdog: ranks declared lost so far
+        (empty while the fleet is healthy). Non-blocking."""
+        with self._lost_lock:
+            return list(self._lost)
+
+    # -- thread lifecycle
+
+    def start(self) -> None:
+        """Start the heartbeat/watchdog thread (idempotent)."""
+        if self._thread is not None or self._stop.is_set():
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="elastic-heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.beat()
+            lost = self.lost_ranks()
+            if lost:
+                self._on_peer_lost(lost)
+                return
+            self._stop.wait(self.heartbeat_interval_s)
+
+    def _on_peer_lost(self, lost: List[int]) -> None:
+        survivors = self.survivors(lost)
+        if self.on_lost is not None:
+            try:
+                self.on_lost(sorted(lost), survivors)
+            except Exception:  # noqa: BLE001 - observer must not block recovery
+                pass
+        self.declare_shrink(lost)
+        with self._lost_lock:
+            self._lost = sorted(lost)
+        if not self.exit_on_shrink:
+            return
+        # grace window: if the main thread is between dispatches it will
+        # see check() != [] and raise FleetShrink -> clean exit 76. If it
+        # is blocked inside the dead fleet's collective it never returns,
+        # and the coordination service aborts us at ~10s — exit first.
+        self._stop.wait(self.exit_grace_s)
+        if self._stop.is_set():
+            return  # stop() won the race (tests); let the caller decide
+        sys.stderr.write(
+            f"elastic: rank(s) {sorted(lost)} lost lease "
+            f"(gen {self.generation}); exiting for re-formation\n"
+        )
+        sys.stderr.flush()
+        from replication_faster_rcnn_tpu.train.fault import EXIT_FLEET_SHRINK
+
+        os._exit(EXIT_FLEET_SHRINK)
+
+
+# ------------------------------------------------------ re-form protocol
+
+
+def write_claim(fleet_dir: str, generation: int, rank: int) -> None:
+    """Claim membership in ``generation`` (rank = the claimant's rank in
+    the PREVIOUS generation; the plan maps these to new contiguous ranks)."""
+    _write_json_atomic(
+        claim_path(fleet_dir, generation, rank),
+        {"rank": int(rank), "pid": os.getpid()},
+    )
+
+
+def read_claims(fleet_dir: str, generation: int, world: int) -> List[int]:
+    """Sorted previous-generation ranks that claimed ``generation``."""
+    return sorted(
+        r for r in range(world)
+        if os.path.exists(claim_path(fleet_dir, generation, r))
+    )
+
+
+def write_plan(fleet_dir: str, generation: int, survivors: Sequence[int]) -> None:
+    survivors = sorted(int(r) for r in survivors)
+    _write_json_atomic(
+        plan_path(fleet_dir, generation),
+        {
+            "generation": int(generation),
+            "survivors": survivors,
+            "world": len(survivors),
+        },
+    )
+
+
+def wait_plan(
+    fleet_dir: str,
+    generation: int,
+    timeout_s: float,
+    poll_s: float = 0.05,
+) -> Optional[Dict[str, Any]]:
+    """Poll for the generation's plan file (None on timeout)."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        plan = read_plan(fleet_dir, generation)
+        if plan is not None:
+            return plan
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(poll_s)
+
+
+# ------------------------------------------------------------- supervisor
+
+
+def child_argv(
+    argv: Sequence[str],
+    *,
+    generation: int,
+    rank: int,
+    world: int,
+    coordinator: Optional[str],
+) -> List[str]:
+    """Rewrite the supervisor's own ``train ... --elastic`` argv into the
+    per-generation child argv: ``--elastic`` is stripped (the child runs
+    the plain trainer), the distributed flags are replaced with this
+    generation's topology (omitted entirely at world 1, so a fully
+    shrunken fleet runs single-process with no gloo at all), and
+    re-formed generations force ``--resume`` (a user-passed ``--resume``
+    is preserved for generation 0)."""
+    drop_with_value = {"--num-processes", "--process-id", "--coordinator"}
+    out: List[str] = []
+    skip = False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        key = a.split("=", 1)[0]
+        if key in drop_with_value:
+            skip = "=" not in a
+            continue
+        if key == "--elastic":
+            continue
+        out.append(a)
+    if world > 1:
+        if not coordinator:
+            raise ValueError("world > 1 needs a coordinator address")
+        out += [
+            "--num-processes", str(world),
+            "--process-id", str(rank),
+            "--coordinator", coordinator,
+        ]
+    if generation > 0 and "--resume" not in out:
+        out.append("--resume")
+    return out
+
+
+def clear_fleet_dir(fleet_dir: str) -> None:
+    """Drop stale lease/claim/plan/intent files from a previous run (the
+    coordinator-rank supervisor calls this before generation 0 so a
+    reused workdir cannot replay an old fleet's shrink protocol).
+
+    Safe against concurrent supervisors without locking: the clear runs
+    before rank 0 spawns its generation-0 child, no peer child can exit
+    before that child joins the collective bring-up (or bring-up itself
+    fails, which is a fleet-leaving exit, not a shrink), and supervisors
+    only write fleet files while re-forming after a child exit — so no
+    live fleet file can be mid-write while this runs."""
+    if not os.path.isdir(fleet_dir):
+        return
+    for name in os.listdir(fleet_dir):
+        if name.startswith(("hb_gen", "shrink_gen", "claim_gen", "plan_gen")):
+            try:
+                os.remove(os.path.join(fleet_dir, name))
+            except OSError:
+                pass
+
+
+def run_supervisor(
+    spawn: Callable[..., Any],
+    *,
+    fleet_dir: str,
+    rank: int,
+    world: int,
+    host: str,
+    base_port: int,
+    settle_s: float = 2.0,
+    max_generations: int = 8,
+    plan_timeout_s: Optional[float] = None,
+    log: Callable[[str], None] = lambda m: print(m, file=sys.stderr),
+) -> int:
+    """Per-host generation loop: spawn the training child, branch on how
+    it exits, re-form the fleet at the surviving world size.
+
+    ``spawn(generation=, rank=, world=, coordinator=)`` must start the
+    training child and return an object with ``wait() -> int`` (a
+    ``subprocess.Popen`` in production; tests substitute their own).
+    ``rank``/``world`` are this host's generation-0 identity; across
+    re-formations the supervisor tracks its current rank (survivors are
+    renumbered contiguously by the plan). Returns the process exit code
+    the CLI should propagate: 0 done (or planned out of the fleet),
+    ``EXIT_PREEMPTED`` passthrough, the child's own code on a non-shrink
+    failure or when ``max_generations`` is exhausted, 1 when the re-form
+    protocol itself times out.
+    """
+    from replication_faster_rcnn_tpu.train.fault import (
+        EXIT_FLEET_SHRINK,
+        EXIT_PREEMPTED,
+    )
+
+    if plan_timeout_s is None:
+        plan_timeout_s = 5.0 * settle_s + 10.0
+    os.makedirs(fleet_dir, exist_ok=True)
+    if rank == 0:
+        clear_fleet_dir(fleet_dir)
+    generation = 0
+    cur_rank, cur_world = int(rank), int(world)
+    while True:
+        coordinator = (
+            f"{host}:{base_port + generation}" if cur_world > 1 else None
+        )
+        log(
+            f"elastic: gen {generation} starting child "
+            f"rank {cur_rank}/{cur_world}"
+            + (f" coordinator {coordinator}" if coordinator else "")
+        )
+        proc = spawn(
+            generation=generation,
+            rank=cur_rank,
+            world=cur_world,
+            coordinator=coordinator,
+        )
+        rc = proc.wait()
+        if rc == 0:
+            return 0
+        if rc == EXIT_PREEMPTED:
+            return EXIT_PREEMPTED
+        intent = read_intent(fleet_dir, generation)
+        shrink = rc == EXIT_FLEET_SHRINK or (
+            intent is not None and cur_rank in intent.get("survivors", ())
+        )
+        if not shrink:
+            # this host is the casualty (or a real crash): leave the
+            # fleet without claiming — the survivors re-form without us
+            log(f"elastic: gen {generation} child exited {rc}; leaving fleet")
+            return rc
+        if generation + 1 >= max_generations:
+            log(
+                f"elastic: max_generations={max_generations} exhausted "
+                f"at gen {generation}"
+            )
+            return rc or 1
+        generation += 1
+        write_claim(fleet_dir, generation, cur_rank)
+        time.sleep(settle_s)
+        claims = read_claims(fleet_dir, generation, cur_world)
+        if claims and claims[0] == cur_rank:
+            write_plan(fleet_dir, generation, claims)
+        plan = wait_plan(fleet_dir, generation, timeout_s=plan_timeout_s)
+        if plan is None:
+            log(f"elastic: gen {generation} plan never appeared; giving up")
+            return 1
+        survivors = [int(r) for r in plan.get("survivors", ())]
+        if cur_rank not in survivors:
+            log(f"elastic: gen {generation} plan excludes rank {cur_rank}")
+            return 0
+        new_rank = survivors.index(cur_rank)
+        log(
+            f"elastic: re-forming gen {generation}: survivors {survivors} "
+            f"-> rank {new_rank}/{len(survivors)}"
+        )
+        cur_rank, cur_world = new_rank, int(plan["world"])

@@ -43,12 +43,21 @@ def initialize_distributed(
     """Multi-host setup (XLA collectives over DCN). Single-host runs skip
     this — jax.devices() already shows every local chip."""
     # failpoint: a chaos schedule can fail or delay collective bring-up
-    # (the classic flaky-coordinator scenario) before any JAX state exists
-    failpoints.fire(
+    # (the classic flaky-coordinator scenario) before any JAX state
+    # exists; a ``drop`` whose arg names this rank kills it at bring-up
+    # (seeded rank loss — the elastic supervisor's casualty path)
+    inj = failpoints.fire(
         "collective.init",
         num_processes=num_processes,
         process_id=process_id,
     )
+    if (
+        inj is not None
+        and inj.kind == "drop"
+        and process_id is not None
+        and int(inj.arg) == int(process_id)
+    ):
+        os._exit(1)
     if num_processes is None:
         num_processes = int(os.environ.get("NUM_PROCESSES", "1"))
     if num_processes > 1:

@@ -60,6 +60,13 @@ from replication_faster_rcnn_tpu.telemetry.health import health_metrics
 # branching on 75 can requeue instead of paging.
 EXIT_PREEMPTED = 75
 
+# Exit code for "a peer rank was lost; re-form the fleet at the surviving
+# world size and resume me" — the elastic supervisor (parallel/elastic.py)
+# branches on it (or on the durable shrink-intent file, for the watchdog
+# path that must os._exit before the JAX coordination service's ~10s
+# SIGABRT) to respawn the child at the next generation.
+EXIT_FLEET_SHRINK = 76
+
 NONFINITE_POLICIES = ("apply", "skip", "halt")
 
 MANIFEST_DIRNAME = "manifests"
@@ -82,6 +89,26 @@ class Preempted(RuntimeError):
 class NonFiniteEscalation(FloatingPointError):
     """Raised when nonfinite-gradient skips exceed the configured budget
     (or immediately under ``nonfinite_policy='halt'``)."""
+
+
+class FleetShrink(RuntimeError):
+    """Raised at a dispatch boundary when a peer rank's heartbeat lease
+    has expired: this rank must exit (EXIT_FLEET_SHRINK) so the elastic
+    supervisor can re-form the fleet at the surviving world size. No
+    emergency checkpoint is attempted — every save is a cross-process
+    collective that would hang on the dead peer — so resume falls back to
+    the last CRC-verified step (bound the window with
+    ``train.checkpoint_every_steps``)."""
+
+    def __init__(self, step: int, lost, survivors):
+        self.step = int(step)
+        self.lost = sorted(int(r) for r in lost)
+        self.survivors = sorted(int(r) for r in survivors)
+        super().__init__(
+            f"fleet shrink at step {self.step}: rank(s) {self.lost} lost "
+            f"heartbeat lease; survivors {self.survivors} re-form at world "
+            f"size {len(self.survivors)}"
+        )
 
 
 # --------------------------------------------------------------- jitted gate
@@ -373,6 +400,9 @@ def run_topology(config=None, mesh=None) -> Dict[str, Any]:
     topo: Dict[str, Any] = {
         "process_count": jax.process_count(),
         "device_count": jax.device_count(),
+        # fleet generation (elastic training): 0 for a static fleet; the
+        # elastic supervisor bumps it per re-formation via the child env
+        "generation": int(os.environ.get("FRCNN_FLEET_GENERATION", "0") or 0),
     }
     if mesh is not None:
         topo["mesh_shape"] = {

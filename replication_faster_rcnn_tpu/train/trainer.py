@@ -40,6 +40,7 @@ from replication_faster_rcnn_tpu.parallel import (
     stage_to_devices,
     validate_parallel,
 )
+from replication_faster_rcnn_tpu.parallel import elastic as elastic_fleet
 from replication_faster_rcnn_tpu.train import fault
 from replication_faster_rcnn_tpu.train.async_checkpoint import (
     AsyncCheckpointWriter,
@@ -260,7 +261,13 @@ class Trainer:
                 process_count=self._process_count,
             )
             steps_per_epoch = max(len(self.loader), 1)
-        self.tx, self.schedule = make_optimizer(config, steps_per_epoch)
+        # n_shards sizes LAMB's psum'd trust-ratio norms to the data axis
+        # the per-shard ZeRO update runs over; inert for adam/lars.
+        self.tx, self.schedule = make_optimizer(
+            config,
+            steps_per_epoch,
+            n_shards=self.mesh.shape[config.mesh.data_axis],
+        )
         # host-math twin for log rows: evaluating the jnp schedule on the
         # host would build + sync a device scalar every logged step
         self.host_schedule = host_schedule(config, steps_per_epoch)
@@ -357,6 +364,34 @@ class Trainer:
         # saved fully replicated; fault.verified_restore re-places), the
         # stamp just makes a cross-topology resume visible in the logs
         self._topology = fault.run_topology(config, self.mesh)
+        # elastic fleet membership (parallel/elastic.py): when a
+        # supervisor exported the fleet dir and we have peers to watch,
+        # run the heartbeat/lease agent. It is STARTED lazily at the
+        # first dispatch boundary (_check_fleet) — leases during the
+        # multi-minute compile window would read as dead ranks. The
+        # agent's watchdog thread writes the durable shrink intent and
+        # hard-exits EXIT_FLEET_SHRINK if the main thread is stuck in a
+        # dead fleet's collective; the on_lost hook records the incident
+        # before that exit.
+        fleet_dir, fleet_gen = elastic_fleet.fleet_env()
+        self._fleet_generation = fleet_gen
+        self.elastic_agent: Optional[elastic_fleet.ElasticAgent] = None
+        if fleet_dir and self._process_count > 1:
+            el = config.elastic
+            self.elastic_agent = elastic_fleet.ElasticAgent(
+                fleet_dir,
+                fleet_gen,
+                self._rank,
+                self._process_count,
+                heartbeat_interval_s=el.heartbeat_interval_s,
+                lease_timeout_s=el.lease_timeout_s,
+                on_lost=lambda lost, survivors: self._fault_incident(
+                    "fleet_rank_lost",
+                    generation=fleet_gen,
+                    lost=lost,
+                    survivors=survivors,
+                ),
+            )
         # background scheduled-checkpoint writer (train.async_checkpoint).
         # Single-process: the writer serializes a host numpy snapshot.
         # Multi-process: EVERY rank runs a writer thread and the snapshot
@@ -863,6 +898,43 @@ class Trainer:
             self.save(kind="emergency")
         raise fault.Preempted(step, reason)
 
+    def _check_fleet(self, step: int) -> None:
+        """Dispatch-boundary elastic check: start the heartbeat/watchdog
+        agent lazily on the first call (a dispatch retired, so compile is
+        over and lease cadence is trustworthy), then surface any
+        watchdog-detected rank loss as :class:`fault.FleetShrink`.
+        Deliberately NO emergency checkpoint here — saves are
+        cross-process collectives and would hang on the dead peer;
+        survivors fall back to the last CRC-verified step
+        (``train.checkpoint_every_steps`` bounds the rollback). The
+        incident and the durable shrink intent were already recorded by
+        the agent when it detected the loss."""
+        agent = self.elastic_agent
+        if agent is None:
+            return
+        agent.start()
+        lost = agent.check()
+        if lost:
+            raise fault.FleetShrink(step, lost, agent.survivors(lost))
+
+    def _maybe_step_checkpoint(self, step: int) -> None:
+        """Scheduled mid-epoch save every ``train.checkpoint_every_steps``
+        optimizer steps (0 = epoch-boundary saves only). Boundary-crossing
+        logic (not ``step % every``) so fused K-step dispatches cannot
+        jump over a save point. Deterministic across ranks — every rank
+        sees the same step sequence, so the collective save stays in
+        lockstep."""
+        every = self.config.train.checkpoint_every_steps
+        if not every or step - self._last_step_ckpt < every:
+            return
+        self._last_step_ckpt = step
+        if self.watchdog is not None:
+            self.watchdog.beat(phase="checkpoint")
+        with self.tracer.span(
+            "checkpoint/save", cat="checkpoint", boundary="step"
+        ):
+            self.save()
+
     def evaluate(self, max_images: Optional[int] = None) -> Dict[str, float]:
         """mAP on the val split with the CURRENT training parameters
         (reference: impossible — its eval was never written, SURVEY §2.1 #15).
@@ -937,15 +1009,29 @@ class Trainer:
             len(self.sampler if self.device_cache is not None else self.loader), 1
         )
         start_epoch = start_step // steps_per_epoch
-        # mid-epoch resume (emergency checkpoints land at arbitrary steps):
-        # replay the resumed epoch's already-trained prefix through the
-        # feed WITHOUT training on it — set_epoch re-derives the epoch's
-        # deterministic batch order, so skipping the first `replay` batches
-        # puts the feed exactly where the interrupted run stopped and the
-        # loss trajectory matches an uninterrupted run step-for-step
+        # mid-epoch resume (emergency/step-interval checkpoints land at
+        # arbitrary steps): consume the resumed epoch from its global-order
+        # OFFSET — set_epoch(epoch, start_batch=replay) re-derives the
+        # epoch's deterministic batch order and starts the iterator at the
+        # first untrained batch, so the already-consumed prefix never
+        # reaches the loader and the loss trajectory still matches an
+        # uninterrupted run step-for-step. Under an elastic re-formation
+        # the same offset re-partitions the epoch's unconsumed suffix
+        # disjointly across the NEW world size (each rank takes its
+        # contiguous block of every remaining global batch).
         replay = start_step - start_epoch * steps_per_epoch
         step = start_step  # host-side mirror: no device sync to read it
         self._host_step = start_step
+        self._last_step_ckpt = start_step
+        if self._fleet_generation > 0:
+            # step-free fields: same-seed replays of a shrink produce the
+            # identical incident regardless of wall clock or rollback depth
+            self._fault_incident(
+                "fleet_reformed",
+                generation=self._fleet_generation,
+                world_size=self._process_count,
+                survivors=list(range(self._process_count)),
+            )
 
         last: Dict[str, float] = {}
         eval_result: Dict[str, float] = {}
@@ -957,17 +1043,18 @@ class Trainer:
                 k = self.steps_per_dispatch
                 prefetch = self.config.data.prefetch_device
                 for epoch in range(start_epoch, cfg.n_epoch):
-                    feed.set_epoch(epoch)
+                    feed.set_epoch(epoch, start_batch=replay)
+                    replay = 0
                     t_epoch = time.time()
                     n_images = 0
                     if prefetch > 0:
                         # overlap path (data.prefetch_device): a producer
                         # thread collates + stages batch K+1's device
                         # transfer while dispatch K runs, so the consumer
-                        # loop below only dequeues resident buffers. The
-                        # resumed epoch's replay prefix is discarded by the
-                        # producer (skip=) BEFORE staging — no batch is
-                        # consumed twice and none is trained out of order.
+                        # loop below only dequeues resident buffers. A
+                        # resumed epoch's trained prefix never reaches the
+                        # producer — the feed itself starts at the resume
+                        # offset (set_epoch start_batch above).
                         stage = (
                             (lambda bs: self._stage_chunk(bs, wait=True))
                             if k > 1
@@ -975,9 +1062,8 @@ class Trainer:
                         )
                         stager = DevicePrefetcher(
                             iter(feed), stage,
-                            depth=prefetch, chunk=k, skip=replay,
+                            depth=prefetch, chunk=k,
                         )
-                        replay = 0
                         if self.watchdog is not None:
                             self.watchdog.providers["staged_queue_depth"] = (
                                 stager.queue_depth
@@ -1032,6 +1118,8 @@ class Trainer:
                                     if row is not None:
                                         last = row
                                 self._check_preemption(step)
+                                self._check_fleet(step)
+                                self._maybe_step_checkpoint(step)
                         finally:
                             # drops staged-but-untrained buffers; resume
                             # replay regenerates them deterministically
@@ -1048,9 +1136,6 @@ class Trainer:
                                     batch = next(it)
                                 except StopIteration:
                                     break
-                            if replay > 0:
-                                replay -= 1
-                                continue
                             if k > 1:
                                 chunk.append(batch)
                                 if len(chunk) < k:
@@ -1071,6 +1156,8 @@ class Trainer:
                                 if row is not None:
                                     last = row
                                 self._check_preemption(step)
+                                self._check_fleet(step)
+                                self._maybe_step_checkpoint(step)
                                 continue
                             metrics = self.train_one_batch(batch)
                             n_images += batch[
@@ -1083,6 +1170,8 @@ class Trainer:
                             if row is not None:
                                 last = row
                             self._check_preemption(step)
+                            self._check_fleet(step)
+                            self._maybe_step_checkpoint(step)
                         # epoch tail: a feed length not divisible by K
                         # leaves <K batches pending — run them through the
                         # per-step path (its jit compiles lazily, only when
@@ -1099,6 +1188,8 @@ class Trainer:
                             if row is not None:
                                 last = row
                             self._check_preemption(step)
+                            self._check_fleet(step)
+                            self._maybe_step_checkpoint(step)
                     # epoch-boundary sync for an honest throughput number
                     with tracer.span("step/sync", cat="sync", boundary="epoch"):
                         jax.device_get(
@@ -1125,8 +1216,14 @@ class Trainer:
                             # interval retries
                             self.save()
                     self._check_preemption(step)
+                    self._check_fleet(step)
         finally:
             self._shutdown = None
+            # stop the heartbeat thread on a HEALTHY exit only: after a
+            # detected rank loss it stays armed, so its EXIT_FLEET_SHRINK
+            # backstop still fires if teardown wedges on the dead peer
+            if self.elastic_agent is not None and not self.elastic_agent.check():
+                self.elastic_agent.stop()
             # the last scheduled save must be on disk before train()
             # returns (callers immediately save(kind="final") or exit)
             self._drain_async_saves()

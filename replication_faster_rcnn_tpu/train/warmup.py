@@ -103,7 +103,7 @@ class ProgramSpec:
     """
 
     name: str
-    feed: str  # "loader" | "cached" | "spmd" | "zero" | "eval"
+    feed: str  # "loader" | "cached" | "spmd" | "zero" | "zero_lamb" | "eval"
     k: int  # fused steps per dispatch (1 = single step; 0 for eval)
     arg_roles: Tuple[str, ...]
     build: Callable[[], Tuple[Any, Tuple[Any, ...]]]
@@ -113,8 +113,12 @@ class ProgramSpec:
 # "zero" is the shard_map backend with ZeRO-1 weight-update sharding
 # forced on (train.shard_opt_state): same step math as "spmd" but the
 # optimizer state is sharded over the data axis and the update is
-# reduce-scatter / sharded-Adam / all-gather (parallel/spmd.py)
-TRAIN_FEEDS: Tuple[str, ...] = ("loader", "cached", "spmd", "zero")
+# reduce-scatter / sharded-Adam / all-gather (parallel/spmd.py).
+# "zero_lamb" is the same feed with train.optimizer='lamb' — the chain
+# gains the sharded trust ratio (psum'd per-layer norms, see
+# train/train_step.py::scale_by_sharded_trust_ratio), a distinct program
+# with its own fingerprint.
+TRAIN_FEEDS: Tuple[str, ...] = ("loader", "cached", "spmd", "zero", "zero_lamb")
 
 
 def program_name(feed: str, k: int) -> str:
@@ -357,6 +361,38 @@ def build_program_specs(
             return jitted, (state_zero, batch_abs)
         return jitted, (state_zero, _chunk_abs(k))
 
+    def _zero_lamb(k: int):
+        from replication_faster_rcnn_tpu.parallel.spmd import (
+            make_shard_map_train_step,
+        )
+
+        lcfg = config.replace(
+            train=dataclasses.replace(
+                config.train,
+                backend="spmd",
+                shard_opt_state=True,
+                optimizer="lamb",
+            )
+        )
+        # The module-level tx is the config's own chain (adam for the
+        # audit config); this feed needs the LAMB chain whose trust
+        # ratio psums its norms over the data axis, and a matching
+        # state template (the chain's opt_state structure differs).
+        ltx, _ = make_optimizer(
+            lcfg,
+            steps_per_epoch=100,
+            n_shards=mesh.shape[mesh_cfg.data_axis],
+        )
+        _, lstate_raw, _ = abstract_step_inputs(lcfg, ltx)
+        lamb_shardings = train_state_shardings(lstate_raw, mesh, mesh_cfg, True)
+        state_lamb = _attach(lstate_raw, lamb_shardings)
+        jitted, _ = make_shard_map_train_step(
+            lcfg, ltx, mesh, steps_per_dispatch=k, state_template=lstate_raw
+        )
+        if k == 1:
+            return jitted, (state_lamb, batch_abs)
+        return jitted, (state_lamb, _chunk_abs(k))
+
     def _eval():
         from replication_faster_rcnn_tpu.eval import Evaluator
 
@@ -384,12 +420,14 @@ def build_program_specs(
 
     builders = {
         "loader": _loader, "cached": _cached, "spmd": _spmd, "zero": _zero,
+        "zero_lamb": _zero_lamb,
     }
     roles = {
         "loader": ("state", "batch"),
         "cached": ("state", "cache", "sel"),
         "spmd": ("state", "batch"),
         "zero": ("state", "batch"),
+        "zero_lamb": ("state", "batch"),
     }
     specs: Dict[str, ProgramSpec] = {}
     for feed in feeds:
@@ -438,7 +476,12 @@ def warmup_compile(
     warmed instead (same step math, different feed plumbing)."""
     tracer = tspans.current_tracer()
     if config.train.backend == "spmd":
-        feed = "zero" if config.train.shard_opt_state else "spmd"
+        if config.train.shard_opt_state:
+            feed = (
+                "zero_lamb" if config.train.optimizer == "lamb" else "zero"
+            )
+        else:
+            feed = "spmd"
     elif config.data.cache_device and cache_n is not None:
         feed = "cached"
     else:

@@ -23,6 +23,18 @@ def main() -> int:
         + " --xla_force_host_platform_device_count=4"
     ).strip()
 
+    mode = sys.argv[4] if len(sys.argv) > 4 else ""
+    if mode == "elastic":
+        # the elastic supervisor never initializes jax: it outlives its
+        # training children across fleet generations and owns no devices
+        return _elastic_supervisor(
+            coordinator, process_id, num_processes, sys.argv[5]
+        )
+    if mode == "elastic-child":
+        return _elastic_child(
+            coordinator, process_id, num_processes, sys.argv[5], sys.argv[6]
+        )
+
     import jax
 
     from replication_faster_rcnn_tpu.parallel import initialize_distributed
@@ -81,6 +93,151 @@ def main() -> int:
 
                 shutil.rmtree(workdir)
         _zero_checkpoint_across_processes(process_id, workdir)
+    return 0
+
+
+def _elastic_supervisor(
+    coordinator: str, process_id: int, num_processes: int, workdir: str
+) -> int:
+    """Per-host side of the elastic acceptance leg: the REAL
+    ``elastic.run_supervisor`` generation loop, spawning this same script
+    in ``elastic-child`` mode once per fleet generation.
+
+    The chaos spec arms a seeded ``heartbeat.beat`` drop that kills rank 1
+    on its 21st lease renewal (~4 s into steady-state training, well past
+    the first dispatch and well before the 16-step run can finish). Rank
+    1's supervisor then leaves the fleet without claiming; rank 0's child
+    exits ``EXIT_FLEET_SHRINK`` and its supervisor re-forms a 1-host
+    generation 1 that resumes from the last CRC-verified step and
+    finishes the run — so rank 0's supervisor returns 0 and rank 1's
+    returns the casualty's own exit code.
+    """
+    import subprocess
+
+    from replication_faster_rcnn_tpu.parallel import elastic
+
+    host, _, port = coordinator.rpartition(":")
+    fleet_dir = os.path.join(workdir, "fleet")
+    # seeded drop: rank 1 (arg), 21st hit (after=20), exactly once. The
+    # landing step is time-based, so the pytest assertions are
+    # step-agnostic; same seed replays the same decision stream.
+    chaos = "heartbeat.beat:drop:1.0:20260807:1:1:20"
+    script = os.path.abspath(__file__)
+
+    def spawn(generation, rank, world, coordinator):
+        # children inherit this supervisor's stdout/stderr, so their
+        # stage markers land in the harness-captured stream
+        return subprocess.Popen(
+            [
+                sys.executable, "-u", script, coordinator or "-",
+                str(rank), str(world), "elastic-child", workdir, chaos,
+            ],
+            env=elastic.child_env(os.environ, fleet_dir, generation),
+        )
+
+    rc = elastic.run_supervisor(
+        spawn,
+        fleet_dir=fleet_dir,
+        rank=process_id,
+        world=num_processes,
+        host=host or "127.0.0.1",
+        base_port=int(port),
+        settle_s=1.0,
+        max_generations=4,
+    )
+    print(f"proc {process_id}: elastic supervisor rc={rc}", flush=True)
+    return rc
+
+
+def _elastic_child(
+    coordinator: str,
+    process_id: int,
+    num_processes: int,
+    workdir: str,
+    chaos_spec: str,
+) -> int:
+    """One fleet generation of the elastic acceptance run: the plain
+    Trainer on the preempt-leg config plus the elastic knobs (fast
+    heartbeats, 2-step checkpoint interval). Generation 0 arms the seeded
+    rank-drop chaos; re-formed generations run clean and resume. A
+    watchdog-detected shrink surfaces as ``FleetShrink`` at a dispatch
+    boundary — or, when the main thread is wedged in the dead fleet's
+    collective, as the agent's own hard ``EXIT_FLEET_SHRINK`` exit."""
+    import jax
+
+    from replication_faster_rcnn_tpu.config import (
+        DataConfig,
+        ElasticConfig,
+        FasterRCNNConfig,
+        MeshConfig,
+        ModelConfig,
+        ProposalConfig,
+        ROITargetConfig,
+        TrainConfig,
+    )
+    from replication_faster_rcnn_tpu.data import SyntheticDataset
+    from replication_faster_rcnn_tpu.faultlib import failpoints
+    from replication_faster_rcnn_tpu.parallel import (
+        elastic,
+        initialize_distributed,
+    )
+    from replication_faster_rcnn_tpu.train import fault
+    from replication_faster_rcnn_tpu.train.trainer import Trainer
+
+    _, generation = elastic.fleet_env()
+
+    def mark(msg: str) -> None:
+        print(
+            f"proc {process_id}: elastic-leg gen {generation} {msg}",
+            flush=True,
+        )
+
+    if generation == 0 and chaos_spec and chaos_spec != "-":
+        failpoints.configure(chaos_spec)
+    if num_processes > 1:
+        initialize_distributed(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    cfg = FasterRCNNConfig(
+        model=ModelConfig(
+            backbone="resnet18", roi_op="align", compute_dtype="float32"
+        ),
+        data=DataConfig(dataset="synthetic", image_size=(64, 64), max_boxes=4),
+        train=TrainConfig(
+            batch_size=8,
+            n_epoch=2,
+            backend="spmd",
+            shard_opt_state=True,
+            grad_allreduce_dtype="bfloat16",
+            checkpoint_every_steps=2,
+        ),
+        # num_data=-1: each generation's mesh fits whatever devices its
+        # world has (gen 0: 2 procs x 4 = 8; re-formed gen 1: 4)
+        mesh=MeshConfig(),
+        proposals=ProposalConfig(pre_nms_train=128, post_nms_train=32),
+        roi_targets=ROITargetConfig(n_sample=8),
+        elastic=ElasticConfig(heartbeat_interval_s=0.2, lease_timeout_s=1.5),
+    )
+    # 64 synthetic images / global batch 8 -> 8 steps per epoch, 16 total:
+    # long enough that the ~4 s drop always lands mid-run
+    ds = SyntheticDataset(cfg.data, length=64)
+    trainer = Trainer(
+        cfg,
+        workdir=workdir,
+        dataset=ds,
+        telemetry_dir=os.path.join(workdir, "telemetry"),
+    )
+    mark(f"trainer built shards={trainer.mesh.shape[cfg.mesh.data_axis]}")
+    try:
+        trainer.train(log_every=1, resume=generation > 0)
+    except fault.FleetShrink as exc:
+        mark(f"shrink at step {exc.step}: lost {exc.lost}")
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(fault.EXIT_FLEET_SHRINK)
+    mark(f"done step={int(jax.device_get(trainer.state.step))}")
     return 0
 
 

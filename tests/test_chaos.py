@@ -383,6 +383,13 @@ class TestChaosSmoke:
             < result["legs"]["checkpoint"]["torn_step"]
         )
         assert result["legs"]["batcher"]["recovered"] is True
+        # the fleet leg: seeded rank-1 loss, 1-rank re-formed plan, and
+        # the bring-up (collective.init) replay of the same loss
+        assert result["legs"]["fleet"] == {
+            "dropped_rank": 1,
+            "reformed_world": 1,
+            "init_dropped_rank": 1,
+        }
         assert not failpoints.armed()  # run_smoke must clean up
 
     def test_cli_chaos_smoke_subcommand(self, tmp_path, capsys):

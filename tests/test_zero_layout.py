@@ -85,3 +85,211 @@ def test_config_knobs_exist():
     assert tc.lars is False
     with pytest.raises(ValueError, match="lr_scaling"):
         dataclasses.replace(tc, lr_scaling="sqrt")
+
+
+class TestOptimizerKnob:
+    def test_defaults_and_validation(self):
+        tc = TrainConfig(batch_size=2)
+        assert tc.optimizer == "adam"
+        assert tc.checkpoint_every_steps == 0
+        with pytest.raises(ValueError, match="optimizer"):
+            dataclasses.replace(tc, optimizer="sgd")
+        with pytest.raises(ValueError, match="checkpoint_every_steps"):
+            dataclasses.replace(tc, checkpoint_every_steps=-1)
+
+    def test_lamb_plus_lars_rejected(self):
+        # lars already appends a trust ratio; stacking two is never right
+        with pytest.raises(ValueError, match="lars"):
+            TrainConfig(batch_size=2, optimizer="lamb", lars=True)
+
+    def test_lamb_passes_zero_spmd_validation(self):
+        """The LARS rejection is about full-leaf norms inside the
+        per-shard update; LAMB's sharded trust ratio psums its norms, so
+        the combination is exactly what it exists for."""
+        cfg = _cfg(backend="spmd", shard_opt_state=True, optimizer="lamb")
+        validate_parallel(cfg, 8)
+
+
+class TestShardedTrustRatio:
+    def _trees(self):
+        import numpy as np
+
+        rng = np.random.RandomState(0)
+        params = {
+            "w": rng.randn(8, 4).astype("float32"),  # shard dim 0 at n=2
+            "b": rng.randn(3).astype("float32"),     # indivisible: replicated
+        }
+        updates = {
+            "w": rng.randn(8, 4).astype("float32"),
+            "b": rng.randn(3).astype("float32"),
+        }
+        return params, updates
+
+    def test_plain_variant_matches_optax(self):
+        import jax.numpy as jnp
+        import optax
+
+        from replication_faster_rcnn_tpu.train.train_step import (
+            scale_by_sharded_trust_ratio,
+        )
+
+        params, updates = self._trees()
+        ref = optax.scale_by_trust_ratio()
+        got_t = scale_by_sharded_trust_ratio()
+        want, _ = ref.update(updates, ref.init(params), params)
+        got, _ = got_t.update(updates, got_t.init(params), params)
+        for k in params:
+            assert jnp.array_equal(want[k], got[k]), k
+
+    def test_sharded_norms_match_full_leaf_math(self):
+        """The load-bearing LAMB property: per-shard slices + psum'd
+        sums-of-squares reproduce the full-leaf trust ratio exactly.
+        vmap's axis_name gives psum the same semantics as the shard_map
+        the spmd backend runs, without needing multiple devices."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from replication_faster_rcnn_tpu.train.train_step import (
+            scale_by_sharded_trust_ratio,
+        )
+
+        params, updates = self._trees()
+        dims = {"w": 0, "b": -1}
+
+        plain = scale_by_sharded_trust_ratio()
+        want, _ = plain.update(updates, plain.init(params), params)
+
+        sharded = scale_by_sharded_trust_ratio(
+            axis_name="data", param_dims=dims
+        )
+
+        def per_shard(u, p):
+            out, _ = sharded.update(u, optax.EmptyState(), p)
+            return out
+
+        def split(tree):  # leading shard axis: slices for w, copies for b
+            return {
+                "w": jnp.reshape(jnp.asarray(tree["w"]), (2, 4, 4)),
+                "b": jnp.stack([jnp.asarray(tree["b"])] * 2),
+            }
+
+        got_sh = jax.vmap(per_shard, axis_name="data")(
+            split(updates), split(params)
+        )
+        assert jnp.allclose(
+            jnp.reshape(got_sh["w"], (8, 4)), want["w"], atol=1e-6
+        )
+        # replicated leaf: every shard computes the identical full update
+        assert jnp.allclose(got_sh["b"][0], want["b"], atol=1e-6)
+        assert jnp.allclose(got_sh["b"][0], got_sh["b"][1], atol=0)
+
+    def test_lamb_chain_equals_lars_chain_when_unsharded(self):
+        """optimizer='lamb' (plain variant) and lars=True build the same
+        math — Adam then trust ratio then lr — so one update step must
+        match bitwise. Pins the chain order of the new branch."""
+        import jax
+        import jax.numpy as jnp
+
+        from replication_faster_rcnn_tpu.train.train_step import (
+            make_optimizer,
+        )
+
+        params, grads = self._trees()
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        grads = jax.tree_util.tree_map(jnp.asarray, grads)
+        outs = {}
+        for name, over in (
+            ("lamb", {"optimizer": "lamb"}),
+            ("lars", {"lars": True}),
+        ):
+            tx, _ = make_optimizer(_cfg(**over), steps_per_epoch=10)
+            upd, _ = tx.update(grads, tx.init(params), params)
+            outs[name] = upd
+        for k in params:
+            assert jnp.array_equal(outs["lamb"][k], outs["lars"][k]), k
+
+    def test_lamb_param_dims_follow_shard_rule(self):
+        """The abstract-shape derivation must agree leaf-for-leaf with
+        the spmd backend's own rule (zero.shard_dim over real shapes)."""
+        import jax
+
+        from replication_faster_rcnn_tpu.train.train_step import (
+            lamb_param_dims,
+        )
+
+        dims = lamb_param_dims(_cfg(), n_shards=8)
+        flat = jax.tree_util.tree_leaves(dims)
+        assert flat and all(isinstance(d, int) for d in flat)
+        # a real resnet tree has both sharded and replicated leaves
+        assert any(d >= 0 for d in flat)
+        assert any(d == -1 for d in flat)
+
+
+class TestSuffixRepartition:
+    """Mid-epoch elastic re-sharding invariant: for the SAME
+    (seed, epoch) global order and the same ``start_batch``, the union of
+    every rank's remaining rows equals the unconsumed suffix of the
+    order, disjointly — at ANY process_count. This is what lets a
+    re-formed fleet finish the epoch it was interrupted in without
+    repeating or dropping a sample."""
+
+    def _loader(self, world: int, rank: int, n=32, bs=8):
+        from replication_faster_rcnn_tpu.config import DataConfig
+        from replication_faster_rcnn_tpu.data import SyntheticDataset
+        from replication_faster_rcnn_tpu.data.loader import DataLoader
+
+        ds = SyntheticDataset(
+            DataConfig(dataset="synthetic", image_size=(16, 16), max_boxes=4),
+            length=n,
+        )
+        return DataLoader(
+            ds, batch_size=bs, prefetch=0, num_workers=1, seed=3,
+            process_index=rank, process_count=world,
+        )
+
+    def _rows(self, loader, epoch, start_batch):
+        loader.set_epoch(epoch, start_batch=start_batch)
+        return [list(map(int, b)) for b in loader._batches()]
+
+    @pytest.mark.parametrize("world", [1, 2, 4])
+    @pytest.mark.parametrize("start_batch", [0, 1, 3])
+    def test_disjoint_union_is_the_suffix(self, world, start_batch):
+        import numpy as np
+
+        full = self._loader(1, 0)
+        full.set_epoch(5)
+        order = np.concatenate(list(full._batches()))
+        suffix = order[start_batch * 8 :]
+
+        per_rank = [
+            self._rows(self._loader(world, r), 5, start_batch)
+            for r in range(world)
+        ]
+        seen: list = []
+        for rows in per_rank:
+            flat = [i for b in rows for i in b]
+            assert not set(flat) & set(seen), "ranks overlap"
+            seen += flat
+        # union == suffix, and per-batch interleave reassembles it exactly
+        n_batches = len(per_rank[0])
+        reassembled = [
+            i
+            for b in range(n_batches)
+            for r in range(world)
+            for i in per_rank[r][b]
+        ]
+        assert reassembled == list(map(int, suffix))
+
+    def test_offset_equals_discard(self):
+        """set_epoch(start_batch=s) must yield bitwise the batches that
+        full iteration yields from position s (no draw-and-discard)."""
+        ld = self._loader(2, 1)
+        whole = self._rows(ld, 2, 0)
+        resumed = self._rows(ld, 2, 2)
+        assert resumed == whole[2:]
+
+    def test_negative_start_batch_rejected(self):
+        ld = self._loader(1, 0)
+        with pytest.raises(ValueError, match="start_batch"):
+            ld.set_epoch(0, start_batch=-1)
