@@ -1,0 +1,415 @@
+"""2D-mesh profile: (dp, mp) model-parallel memory/collective/throughput gate.
+
+One command measures what the model-axis parameter sharding
+(`--mesh-shape DP,MP`; `parallel/zero.py::compose_spec` +
+`parallel/plan.py` pjit plans) actually buys on a 2D device mesh, and
+fails loudly when the win rots:
+
+* **per-device param bytes** — read from the placed arrays'
+  ``addressable_shards`` (what the runtime committed to memory, not what
+  a sharding annotation promised), for the replicated dp-only placement
+  and the mp-sharded placement of the SAME train state. The gate: the mp
+  placement must hold at most ``1/mp + slack`` of the replicated bytes
+  per device — the whole point of naming a model axis.
+* **collective inventory** — `analysis.fingerprint.
+  parse_partitioned_collectives` over both COMPILED step programs (the
+  mp exchange is GSPMD-inserted post-partitioning, invisible in lowered
+  StableHLO): the mp step must carry model-axis all-gathers (weight
+  reassembly), the dp-only step must carry zero model-axis collectives.
+  The structural contract also lives in hlolint HX003; repeating it here
+  keeps this harness self-contained for off-CI runs.
+* **throughput** — images/sec through both compiled steps; the mp number
+  is checked against the committed record for the same
+  (config, mesh, platform) under ``benchmarks/records/`` exactly like
+  benchmarks/scaling_profile.py checks the ZeRO profile:
+
+      python benchmarks/mesh_profile.py            # check
+      python benchmarks/mesh_profile.py --update   # re-bank
+
+The memory and collective gates are structural and run on EVERY
+invocation (bank or no bank); only the throughput comparison needs a
+banked record. Cross-platform comparisons are skipped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+RECORDS_DIR = os.path.join(_REPO, "benchmarks", "records")
+SCHEMA = "mesh_profile/v1"
+DEFAULT_TOL = 0.15
+
+# per-device mp param bytes may exceed the ideal replicated/mp by this
+# relative slack (leaves with no dimension divisible by mp stay
+# replicated — scalars, odd-shaped biases) before the memory gate fails
+PARAM_BYTES_SLACK = 0.5
+
+GATE_KEY = "images_per_sec_mp"
+
+
+# ---------------------------------------------------------------------------
+# pure record logic (no jax): unit-testable without placing anything
+
+
+def record_key(config_token: str, platform: str, dp: int, mp: int) -> str:
+    """Identity of a banked record. The mesh shape is part of the
+    identity because the sharding factor IS the measurement."""
+    return f"{config_token}_{platform}_mesh{dp}x{mp}"
+
+
+def record_path(key: str, records_dir: str = RECORDS_DIR) -> str:
+    return os.path.join(records_dir, f"mesh_profile_{key}.json")
+
+
+def check_structural(record, slack: float = PARAM_BYTES_SLACK):
+    """The bank-free gates: per-device param-memory reduction and the
+    model-axis collective inventory.
+
+    Returns a list of human-readable failures (empty = pass)."""
+    failures = []
+    mp = int(record.get("mesh_mp", 1))
+    repl = float(record.get("param_bytes_per_device_replicated", 0))
+    shrd = float(record.get("param_bytes_per_device_mp", 0))
+    if repl <= 0 or shrd <= 0:
+        failures.append("param byte measurement missing or zero")
+        return failures
+    frac = shrd / repl
+    ceiling = (1.0 / mp) * (1.0 + slack)
+    if frac > ceiling:
+        failures.append(
+            f"per-device params not sharded: mp placement holds {frac:.1%} "
+            f"of the replicated bytes (ceiling {ceiling:.1%} = 1/{mp} "
+            f"+ {slack:.0%} slack) — the model-axis split is gone"
+        )
+
+    def _model_ops(inventory):
+        return {
+            kind: entry.get("axes", {}).get("model", 0)
+            for kind, entry in (inventory or {}).items()
+            if entry.get("axes", {}).get("model", 0)
+        }
+
+    mp_ops = _model_ops(record.get("collectives_mp"))
+    if not mp_ops.get("all-gather"):
+        failures.append(
+            "mp step compiled without model-axis all-gathers — GSPMD "
+            f"emitted no weight exchange (model-axis ops: {mp_ops or 'none'})"
+        )
+    dp_ops = _model_ops(record.get("collectives_dp"))
+    if dp_ops:
+        failures.append(
+            f"dp-only step emits model-axis collectives {dp_ops} — the "
+            "baseline is supposed to leave the model axis idle"
+        )
+    return failures
+
+
+def check_regression(current, banked, tol: float = DEFAULT_TOL):
+    """Throughput comparison against the banked record.
+
+    Returns (failures, warnings)."""
+    failures, warnings = [], []
+    if banked.get("schema") != SCHEMA:
+        warnings.append(
+            f"banked record has schema {banked.get('schema')!r}, "
+            f"expected {SCHEMA!r}; skipping comparison"
+        )
+        return failures, warnings
+    for key in (GATE_KEY, "images_per_sec_dp"):
+        old = banked.get(key)
+        new = current.get(key)
+        if not old or not new:
+            continue
+        drop = 1.0 - new / old
+        if drop > tol:
+            failures.append(
+                f"{key} regressed {drop:+.1%}: {new:.3f} vs banked "
+                f"{old:.3f} (tolerance {tol:.0%})"
+            )
+        elif drop > tol / 2:
+            warnings.append(
+                f"{key} within tolerance but slipping {drop:+.1%}: "
+                f"{new:.3f} vs banked {old:.3f}"
+            )
+    old_frac = banked.get("param_bytes_frac")
+    new_frac = current.get("param_bytes_frac")
+    if old_frac and new_frac and new_frac > old_frac * (1.0 + tol):
+        failures.append(
+            f"param_bytes_frac grew: {new_frac:.4f} vs banked {old_frac:.4f} "
+            "— the mp placement is holding more than it used to"
+        )
+    return failures, warnings
+
+
+def load_record(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_record(record, path: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# measurement
+
+
+def _per_device_bytes(tree) -> int:
+    """Bytes the FIRST local device holds for a placed pytree — summed
+    over leaves from ``addressable_shards`` (committed layout, including
+    any replicated leaves the sharder left whole)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = [s for s in leaf.addressable_shards if s.index is not None]
+        first = min(shards, key=lambda s: s.device.id)
+        total += first.data.nbytes
+    return total
+
+
+def profile(cfg_mp, config_token: str, n_steps: int = 5):
+    """Measure one config's 2D-mesh profile; returns the record dict.
+
+    ``cfg_mp`` must be an auto-backend config with
+    ``mesh.param_sharding`` on and ``mesh.num_model > 1``; the dp-only
+    baseline is derived by flattening the mesh onto the data axis so both
+    placements price the same model/optimizer."""
+    import copy
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from replication_faster_rcnn_tpu import parallel
+    from replication_faster_rcnn_tpu.analysis.fingerprint import (
+        parse_partitioned_collectives,
+    )
+    from replication_faster_rcnn_tpu.data import SyntheticDataset
+    from replication_faster_rcnn_tpu.data.loader import collate
+    from replication_faster_rcnn_tpu.parallel import zero as pzero
+    from replication_faster_rcnn_tpu.parallel.plan import (
+        Plan,
+        compile_step_with_plan,
+    )
+    from replication_faster_rcnn_tpu.train.train_step import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    dp = cfg_mp.mesh.num_data
+    mp = cfg_mp.mesh.num_model
+    cfg_dp = cfg_mp.replace(
+        mesh=dataclasses.replace(
+            cfg_mp.mesh, num_data=dp * mp, num_model=1, param_sharding=False
+        )
+    )
+
+    mesh_mp = parallel.make_mesh(cfg_mp.mesh)
+    mesh_dp = parallel.make_mesh(cfg_dp.mesh)
+    tx, _ = make_optimizer(cfg_mp, steps_per_epoch=100)
+    model, state = create_train_state(cfg_mp, jax.random.PRNGKey(0), tx)
+    host_state = jax.device_get(state)
+
+    sh_mp = pzero.train_state_shardings(
+        state, mesh_mp, cfg_mp.mesh, cfg_mp.train.shard_opt_state
+    )
+    sh_dp = pzero.train_state_shardings(state, mesh_dp, cfg_dp.mesh, False)
+    # independent host copies: both placements get private buffers, so the
+    # donating steps can't invalidate each other's state mid-measurement
+    state_mp = pzero.place_train_state(copy.deepcopy(host_state), sh_mp)
+    state_dp = pzero.place_train_state(copy.deepcopy(host_state), sh_dp)
+
+    bytes_mp = _per_device_bytes(state_mp.params)
+    bytes_dp = _per_device_bytes(state_dp.params)
+
+    step_mp = compile_step_with_plan(
+        make_train_step(model, cfg_mp, tx),
+        Plan(mesh=mesh_mp, donate_argnums=(0,), out_shardings=(sh_mp, None)),
+    )
+    step_dp = compile_step_with_plan(
+        make_train_step(model, cfg_dp, tx),
+        Plan(mesh=mesh_dp, donate_argnums=(0,), out_shardings=(sh_dp, None)),
+    )
+
+    batch_size = cfg_mp.train.batch_size
+    ds = SyntheticDataset(cfg_mp.data, length=batch_size)
+    batch = collate([ds[i] for i in range(batch_size)])
+
+    def staged(mesh, mesh_cfg):
+        return parallel.shard_batch(
+            {k: np.array(v) for k, v in batch.items()}, mesh, mesh_cfg
+        )
+
+    coll = {}
+    for name, step, st, mesh, mesh_cfg in (
+        ("mp", step_mp, state_mp, mesh_mp, cfg_mp.mesh),
+        ("dp", step_dp, state_dp, mesh_dp, cfg_dp.mesh),
+    ):
+        compiled = step.lower(st, staged(mesh, mesh_cfg)).compile()
+        try:
+            text = compiled.as_text()
+        except Exception:  # pragma: no cover - some backends hide HLO text
+            text = ""
+        coll[name] = parse_partitioned_collectives(text, dict(mesh.shape))
+
+    def timed(step, st, mesh, mesh_cfg):
+        # donation consumes the placed state every dispatch; threading the
+        # returned state through mirrors the trainer's loop
+        st, metrics = step(st, staged(mesh, mesh_cfg))  # compile + stabilize
+        jax.device_get(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            st, metrics = step(st, staged(mesh, mesh_cfg))
+        jax.device_get(metrics["loss"])
+        wall = time.perf_counter() - t0
+        return st, batch_size * n_steps / wall, wall / n_steps * 1e3
+
+    state_mp, ips_mp, ms_mp = timed(step_mp, state_mp, mesh_mp, cfg_mp.mesh)
+    state_dp, ips_dp, ms_dp = timed(step_dp, state_dp, mesh_dp, cfg_dp.mesh)
+
+    dev = jax.devices()[0]
+    return {
+        "schema": SCHEMA,
+        "config": config_token,
+        "backend": cfg_mp.train.backend,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", None),
+        "n_dev": jax.device_count(),
+        "mesh_dp": int(dp),
+        "mesh_mp": int(mp),
+        "batch_size": batch_size,
+        "image_size": list(cfg_mp.data.image_size),
+        "n_steps_timed": n_steps,
+        "param_bytes_per_device_replicated": int(bytes_dp),
+        "param_bytes_per_device_mp": int(bytes_mp),
+        "param_bytes_frac": round(bytes_mp / bytes_dp, 6) if bytes_dp else None,
+        "param_bytes_ideal_frac": round(1.0 / mp, 6),
+        "collectives_mp": coll["mp"],
+        "collectives_dp": coll["dp"],
+        "step_ms_mp": round(ms_mp, 3),
+        "step_ms_dp": round(ms_dp, 3),
+        "images_per_sec_mp": round(ips_mp, 3),
+        "images_per_sec_dp": round(ips_dp, 3),
+        "measured": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument(
+        "--mesh-shape",
+        default="2,4",
+        metavar="DP,MP",
+        help="2D device mesh: DP-way data x MP-way model parallelism",
+    )
+    p.add_argument(
+        "--devices",
+        type=int,
+        default=8,
+        help="host-platform device count to force when jax is not yet "
+        "imported and no accelerator is attached (CPU CI)",
+    )
+    p.add_argument("--steps", type=int, default=5, help="timed dispatches")
+    p.add_argument(
+        "--update", action="store_true", help="write/overwrite the banked record"
+    )
+    p.add_argument(
+        "--no-check", action="store_true", help="measure + print only"
+    )
+    p.add_argument("--tol", type=float, default=DEFAULT_TOL)
+    p.add_argument("--slack", type=float, default=PARAM_BYTES_SLACK)
+    p.add_argument("--records-dir", default=RECORDS_DIR)
+    args = p.parse_args(argv)
+
+    try:
+        dp, mp = (int(t) for t in args.mesh_shape.split(","))
+    except ValueError:
+        raise SystemExit(
+            f"--mesh-shape expects 'DP,MP', got {args.mesh_shape!r}"
+        )
+    if mp < 2:
+        raise SystemExit("--mesh-shape needs MP >= 2 (nothing to measure)")
+
+    if args.devices > 1 and "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={args.devices}"
+            ).strip()
+
+    import dataclasses
+
+    from benchmarks.step_profile import tiny_config
+    from replication_faster_rcnn_tpu.config import MeshConfig
+
+    cfg = tiny_config(
+        batch_size=args.batch_size, image_size=args.image_size, backend="auto"
+    )
+    cfg = cfg.replace(
+        mesh=MeshConfig(num_data=dp, num_model=mp, param_sharding=True)
+    )
+    token = f"tiny{args.image_size}b{args.batch_size}"
+
+    record = profile(cfg, token, n_steps=args.steps)
+    key = record_key(token, record["platform"], dp, mp)
+    path = record_path(key, args.records_dir)
+    print(json.dumps(record, indent=1, sort_keys=True))
+
+    structural = check_structural(record, slack=args.slack)
+    for f in structural:
+        print(f"mesh_profile: FAIL {f}", file=sys.stderr)
+    if structural:
+        return 1
+
+    if args.update:
+        save_record(record, path)
+        print(f"mesh_profile: banked {path}", file=sys.stderr)
+        return 0
+    if args.no_check:
+        return 0
+    if not os.path.exists(path):
+        print(
+            f"mesh_profile: no banked record at {path} — run with "
+            "--update to create one (not checking)",
+            file=sys.stderr,
+        )
+        return 0
+    failures, warnings = check_regression(record, load_record(path), tol=args.tol)
+    for w in warnings:
+        print(f"mesh_profile: WARN {w}", file=sys.stderr)
+    for f in failures:
+        print(f"mesh_profile: FAIL {f}", file=sys.stderr)
+    if failures:
+        print(
+            f"mesh_profile: REGRESSION vs {path} — if intentional, "
+            "re-bank with --update",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"mesh_profile: OK vs {path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

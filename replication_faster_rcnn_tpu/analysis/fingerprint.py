@@ -118,6 +118,139 @@ def parse_collectives(stablehlo_text: str) -> Dict[str, Any]:
     return inv
 
 
+# ------------------------------------------------- partitioned collectives
+#
+# The lowered StableHLO only shows collectives the *program* wrote
+# (shard_map bodies). Auto-partitioned programs (pjit with shardings)
+# get theirs inserted by GSPMD/ShardingPropagation *after* lowering, so
+# the model-parallel weight all-gathers are only visible in the COMPILED
+# module's HLO text. Inventory those separately and classify each op's
+# replica groups against the (data, model) mesh axes: with the row-major
+# device grid `make_mesh` builds, model-axis groups are consecutive runs
+# ({{0,1,2,3},{4,5,6,7}} on a (2,4) mesh) and data-axis groups are
+# strided ({{0,4},{1,5},{2,6},{3,7}}).
+
+# `%all-reduce.1 = f32[8]{0} all-reduce(%x), channel_id=1,
+#  replica_groups={{0,1},{2,3}}, ...` — opcode after `= <shape>`, so the
+# instruction *name* (%all-reduce.1) is not double-counted
+_PARTITIONED_OP_RE = re.compile(
+    r"=\s+\S+\s+"
+    r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
+    r"(?:-start)?\("
+)
+_REPLICA_GROUPS_RE = re.compile(
+    r"replica_groups=(\{\{[\d,{}\s]*\}\}|\[[\d,]+\]<=\[[\d,]+\](?:T\([\d,]+\))?)"
+)
+
+
+def _parse_replica_groups(text: str) -> Optional[List[List[int]]]:
+    """Decode one ``replica_groups=`` value into a list of device-id
+    groups. Handles the explicit ``{{0,1},{2,3}}`` form and the iota
+    form ``[G,S]<=[d0,d1,...]T(perm)`` (reshape iota(prod d) to ``d``,
+    transpose by ``perm``, regroup as G rows of S)."""
+    text = text.strip()
+    if text.startswith("{{"):
+        groups = []
+        for grp in re.findall(r"\{([\d,\s]*)\}", text[1:-1]):
+            ids = [int(t) for t in grp.replace(" ", "").split(",") if t]
+            if ids:
+                groups.append(ids)
+        return groups or None
+    m = re.match(r"\[([\d,]+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?$", text)
+    if not m:
+        return None
+    gshape = [int(t) for t in m.group(1).split(",")]
+    dshape = [int(t) for t in m.group(2).split(",")]
+    n = 1
+    for d in dshape:
+        n *= d
+    flat = list(range(n))
+    # reshape to dshape, apply transpose, flatten (row-major throughout)
+    if m.group(3):
+        perm = [int(t) for t in m.group(3).split(",")]
+        strides = [0] * len(dshape)
+        acc = 1
+        for i in range(len(dshape) - 1, -1, -1):
+            strides[i] = acc
+            acc *= dshape[i]
+        tshape = [dshape[p] for p in perm]
+        tstrides = [strides[p] for p in perm]
+        out = []
+
+        def _walk(dim: int, off: int) -> None:
+            if dim == len(tshape):
+                out.append(off)
+                return
+            for i in range(tshape[dim]):
+                _walk(dim + 1, off + i * tstrides[dim])
+
+        _walk(0, 0)
+        flat = out
+    if len(gshape) != 2 or gshape[0] * gshape[1] != len(flat):
+        return None
+    size = gshape[1]
+    return [flat[i * size : (i + 1) * size] for i in range(gshape[0])]
+
+
+def _classify_groups(
+    groups: List[List[int]], mesh_shape: Dict[str, int]
+) -> str:
+    """Which mesh axis a replica-group set spans: 'model' (consecutive
+    runs of the minor axis), 'data' (strided over the major axis), 'all'
+    (one group of every device), else 'other'. 'world' when the mesh
+    shape is unknown/degenerate."""
+    n_data = int(mesh_shape.get("data", 0) or 0)
+    n_model = int(mesh_shape.get("model", 0) or 0)
+    got = {frozenset(g) for g in groups}
+    if n_data <= 0 or n_model <= 0:
+        return "world"
+    n = n_data * n_model
+    if got == {frozenset(range(n))}:
+        return "all"
+    model_axis = {
+        frozenset(r * n_model + c for c in range(n_model))
+        for r in range(n_data)
+    }
+    if got == model_axis:
+        return "model"
+    data_axis = {
+        frozenset(r * n_model + c for r in range(n_data))
+        for c in range(n_model)
+    }
+    if got == data_axis:
+        return "data"
+    return "other"
+
+
+def parse_partitioned_collectives(
+    compiled_text: str, mesh_shape: Optional[Dict[str, int]] = None
+) -> Dict[str, Any]:
+    """Inventory of collective ops in a COMPILED module's HLO text, with
+    per-mesh-axis classification of each op's replica groups:
+
+    {"all-gather": {"count": N, "axes": {"model": i, "data": j}}, ...}
+
+    Kinds with zero occurrences are omitted. ``axes`` buckets: 'model' /
+    'data' (one mesh axis each), 'all' (every device in one group),
+    'world' (mesh shape unknown), 'other' (anything else)."""
+    inv: Dict[str, Any] = {}
+    mesh_shape = mesh_shape or {}
+    for line in compiled_text.splitlines():
+        m = _PARTITIONED_OP_RE.search(line)
+        if not m:
+            continue
+        kind = m.group(1)
+        entry = inv.setdefault(kind, {"count": 0, "axes": {}})
+        entry["count"] += 1
+        gm = _REPLICA_GROUPS_RE.search(line)
+        groups = _parse_replica_groups(gm.group(1)) if gm else None
+        axis = _classify_groups(groups, mesh_shape) if groups else "world"
+        entry["axes"][axis] = entry["axes"].get(axis, 0) + 1
+    for entry in inv.values():
+        entry["axes"] = dict(sorted(entry["axes"].items()))
+    return dict(sorted(inv.items()))
+
+
 def contains_f64(stablehlo_text: str) -> bool:
     """True when any tensor in the lowered IR has element type f64 — the
     silent x64-promotion the dtype contract (HX002) forbids."""
@@ -215,6 +348,9 @@ def fingerprint_program(spec) -> Dict[str, Any]:
         "outputs": summarize_abstract(out_tree),
         "aliasing": parse_alias_map(compiled_text),
         "collectives": parse_collectives(stablehlo),
+        "partitioned_collectives": parse_partitioned_collectives(
+            compiled_text, spec.meta.get("mesh_shape")
+        ),
         "has_f64": contains_f64(stablehlo),
         "cost": lowered_cost_analysis(lowered),
         "memory": memory_stats(compiled),
@@ -280,7 +416,10 @@ def make_bank(
 COST_REL_TOL = 0.02
 MEMORY_REL_TOL = 0.25
 
-# structural fields compared exactly
+# structural fields compared exactly. `partitioned_collectives` is
+# deliberately absent: pre-existing banks predate the field, and the
+# post-partitioning inventory wobbles with XLA's SPMD pass pipeline —
+# the hlolint HX003 mp cells assert on the live value instead.
 _EXACT_FIELDS = ("args", "params", "outputs", "aliasing", "collectives", "has_f64")
 
 

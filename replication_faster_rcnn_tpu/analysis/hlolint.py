@@ -16,8 +16,11 @@ HX002  dtype contracts: no silent f32→f64 promotion anywhere; the
        all_reduce per float grad leaf; f32 config ⇒ zero bf16).
 HX003  collective inventory matches the backend: the shard_map feed
        carries hand-placed psums (all_reduce only); loader/cached/eval
-       programs lower collective-free IR (GSPMD inserts collectives
-       after partitioning, never in the lowered module).
+       and the model-parallel (mp/mp_zero) programs lower collective-free
+       IR (GSPMD inserts collectives after partitioning, never in the
+       lowered module) — and on the COMPILED side, mp programs must show
+       model-axis collectives (the GSPMD weight exchange) while every
+       other feed must show none on the model axis.
 HX004  compiled peak-memory estimate within ``analysis.hbm_budget_bytes``.
 HX005  per-program drift vs the banked fingerprint: structural fields
        (shapes, shardings, aliasing, collectives) exactly, flops/bytes
@@ -50,10 +53,11 @@ HLO_RULES: Dict[str, str] = {
 
 # the audited program matrix: every feed the Trainer can run, single-step
 # and fused — including the ZeRO-1 variant of the shard_map backend and
-# its LAMB chain (sharded trust ratio) — plus eval (11 programs) and the
-# serving engine's bucket matrix (audit_config's 2 resolutions × 2 batch
-# sizes = 4 more)
-AUDIT_FEEDS = ("loader", "cached", "spmd", "zero", "zero_lamb")
+# its LAMB chain (sharded trust ratio), and the model-parallel auto-
+# partitioned feeds on the audit (dp, mp) mesh — plus eval (15 programs)
+# and the serving engine's bucket matrix (audit_config's 2 resolutions ×
+# 2 batch sizes = 4 more)
+AUDIT_FEEDS = ("loader", "cached", "spmd", "zero", "zero_lamb", "mp", "mp_zero")
 AUDIT_KS = (1, 2)
 AUDIT_BANK_NAME = "ci"
 AUDIT_CACHE_N = 4
@@ -351,6 +355,42 @@ def check_contracts(
                     "partitioning, not here)",
                 )
             )
+
+        # HX003 — model-axis partitioned collectives: the mp feeds' weight
+        # exchange is GSPMD-inserted, so it only shows in the COMPILED
+        # module's inventory (`partitioned_collectives`, classified per
+        # mesh axis). mp programs must carry it; every other feed must
+        # lower ZERO model-axis collectives. `.get` throughout: records
+        # banked before the field existed simply skip this rule.
+        pcoll = fp.get("partitioned_collectives")
+        if pcoll is not None:
+            model_ops = {
+                kind: entry.get("axes", {}).get("model", 0)
+                for kind, entry in pcoll.items()
+                if entry.get("axes", {}).get("model", 0)
+            }
+            if fp.get("feed") in ("mp", "mp_zero"):
+                if not model_ops:
+                    out.append(
+                        Violation(
+                            "HX003",
+                            name,
+                            "no model-axis collectives in the compiled "
+                            "module — GSPMD emitted no weight exchange, so "
+                            "the 1/mp parameter sharding was optimized away "
+                            f"(partitioned inventory: {sorted(pcoll) or 'empty'})",
+                        )
+                    )
+            elif model_ops:
+                out.append(
+                    Violation(
+                        "HX003",
+                        name,
+                        f"model-axis collectives {model_ops} in a "
+                        f"{fp.get('feed')} program — only the mp feeds "
+                        "shard over the model axis",
+                    )
+                )
 
         # HX004 — memory budget
         mem = fp.get("memory")

@@ -46,6 +46,23 @@ def _apply_distributed(args) -> None:
     )
 
 
+def _parse_mesh_shape(text):
+    """`--mesh-shape DP,MP` -> MeshConfig overrides. MP > 1 turns on
+    model-axis parameter sharding (the whole point of naming a 2D mesh);
+    `--mesh-shape 8,1` is an explicit dp-only pin."""
+    parts = text.split(",")
+    try:
+        dp, mp = (int(p.strip()) for p in parts)
+        if dp < 1 or mp < 1:
+            raise ValueError
+    except ValueError:
+        raise SystemExit(
+            f"--mesh-shape expects 'DP,MP' with two positive integers "
+            f"(e.g. 2,4), got {text!r}"
+        )
+    return {"num_data": dp, "num_model": mp, "param_sharding": mp > 1}
+
+
 def _build_config(args):
     from replication_faster_rcnn_tpu.config import get_config
 
@@ -158,6 +175,8 @@ def _build_config(args):
             model_kw["norm"] = args.norm
         cfg = cfg.replace(model=dataclasses.replace(cfg.model, **model_kw))
     mesh_kw = {}
+    if getattr(args, "mesh_shape", None):
+        mesh_kw.update(_parse_mesh_shape(args.mesh_shape))
     if getattr(args, "num_model", None) is not None:
         mesh_kw["num_model"] = args.num_model
     if getattr(args, "spatial", False):
@@ -364,6 +383,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--spatial", action="store_true",
                    help="shard image rows over the model axis (spatial "
                         "partitioning; GSPMD conv halo exchange)")
+    p.add_argument("--mesh-shape", default=None, metavar="DP,MP",
+                   help="2D device mesh as 'DP,MP' (e.g. 2,4): DP-way "
+                        "data parallelism x MP-way model parallelism with "
+                        "parameters sharded 1/MP over the model axis "
+                        "(mesh.param_sharding; requires the jit "
+                        "auto-partitioning backend)")
 
 
 def _threadsan_session(enabled: bool):
@@ -652,7 +677,8 @@ def cmd_bench(args) -> int:
         for v in (
             args.dataset, args.data_root, args.image_size, args.backbone,
             args.roi_op, args.batch_size, args.lr, args.epochs, args.seed,
-            args.num_model, args.backend, args.mu_dtype, args.loader_workers,
+            args.num_model, args.mesh_shape, args.backend, args.mu_dtype,
+            args.loader_workers,
             args.loader_mode, args.augment_scale, args.norm,
             args.steps_per_dispatch, args.grad_allreduce_dtype,
             args.nonfinite_policy, args.max_consecutive_skips,

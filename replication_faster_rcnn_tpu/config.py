@@ -487,13 +487,26 @@ class MeshConfig:
     SURVEY.md §5 — the long axis is image extent). GSPMD inserts the halo
     exchanges every conv needs at shard boundaries; one image then spans
     ``num_model`` chips, so images larger than a single chip's HBM budget
-    still train. Requires the default jit auto-partitioning backend."""
+    still train. Requires the default jit auto-partitioning backend.
+
+    ``param_sharding`` turns on model parallelism over the same ``model``
+    axis: every conv kernel / head weight is sharded on its largest
+    mp-divisible dimension (the `parallel/zero.py` ``shard_dim`` rule,
+    pointed at the model axis), so each chip holds ~1/num_model of the
+    parameters and GSPMD inserts the weight all-gathers / gradient
+    reductions the forward/backward needs. The CLI spelling is
+    ``--mesh-shape DP,MP`` (sets num_data=DP, num_model=MP and flips this
+    flag when MP > 1). Composes with ZeRO-1 (``train.shard_opt_state``)
+    over the ``data`` axis; requires the jit auto-partitioning backend,
+    and is mutually exclusive with ``spatial`` (one sharding story per
+    model axis)."""
 
     data_axis: str = "data"
     model_axis: str = "model"
     num_data: int = -1  # -1: all available devices
     num_model: int = 1
     spatial: bool = False  # shard image rows over the model axis
+    param_sharding: bool = False  # shard weights over the model axis (mp)
 
 
 @dataclasses.dataclass(frozen=True)

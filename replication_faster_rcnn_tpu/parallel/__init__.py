@@ -15,6 +15,11 @@ from replication_faster_rcnn_tpu.parallel.mesh import (  # noqa: F401
     validate_parallel,
     validate_spatial,
 )
+from replication_faster_rcnn_tpu.parallel.plan import (  # noqa: F401
+    Plan,
+    PlanContext,
+    compile_step_with_plan,
+)
 from replication_faster_rcnn_tpu.parallel.spmd import (  # noqa: F401
     make_shard_map_train_step,
 )

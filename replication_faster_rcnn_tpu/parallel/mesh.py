@@ -101,97 +101,30 @@ def fit_data_parallelism(batch_size: int, n_devices: int) -> int:
 def validate_spatial(config) -> None:
     """Reject configs where spatial partitioning would silently do nothing
     or cannot work (shared by the Trainer and the benchmark so every
-    entry point fails the same way).
+    entry point fails the same way). The spatial rows of the
+    `parallel/plan.py` decision table.
 
     Args: config — a full FasterRCNNConfig.
     """
-    if not config.mesh.spatial:
-        if config.mesh.num_model > 1:
-            # nothing shards over the model axis without spatial
-            # partitioning (or a future tensor-parallel layout): every
-            # model-axis peer would replicate identical work
-            import warnings
+    from replication_faster_rcnn_tpu.parallel.plan import (
+        SPATIAL_CELLS,
+        PlanContext,
+        apply_table,
+    )
 
-            warnings.warn(
-                f"mesh.num_model={config.mesh.num_model} with "
-                "spatial=False: the model axis carries no sharding, so "
-                f"{config.mesh.num_model - 1} of every "
-                f"{config.mesh.num_model} chips duplicate work; pass "
-                "--spatial or drop --num-model",
-                stacklevel=2,
-            )
-        return
-    if config.train.backend == "spmd":
-        raise ValueError(
-            "spatial partitioning requires the jit auto-partitioning "
-            "backend (GSPMD places the conv halo exchanges); the "
-            "explicit shard_map backend shards batch dims only"
-        )
-    if config.mesh.num_model < 2:
-        raise ValueError(
-            "spatial partitioning shards image rows over the model "
-            "axis; set mesh.num_model >= 2 (--num-model), got "
-            f"{config.mesh.num_model}"
-        )
-    if config.data.image_size[0] % config.mesh.num_model:
-        raise ValueError(
-            "spatial partitioning needs image rows "
-            f"({config.data.image_size[0]}) divisible by the model "
-            f"axis ({config.mesh.num_model})"
-        )
+    apply_table(PlanContext.from_config(config), names=SPATIAL_CELLS)
 
 
 def validate_parallel(config, n_devices: Optional[int] = None) -> None:
     """All parallelism config checks shared by every entry point (Trainer,
-    benchmark): spatial partitioning constraints, backend conflicts, and
-    mesh-vs-device-count fit. ``n_devices`` defaults to every visible
-    device; pass the size of an explicit device subset if using one."""
-    validate_spatial(config)
-    if (
-        config.train.shard_opt_state
-        and config.train.backend == "spmd"
-        and config.train.lars
-    ):
-        raise ValueError(
-            "lars trust ratios need full-leaf norms, but the shard_map "
-            "ZeRO-1 backend updates 1/N parameter slices (partial norms); "
-            "use the jit auto-partitioning backend (backend='auto') for "
-            "lars + shard_opt_state"
-        )
-    if jax.process_count() > 1:
-        if config.mesh.spatial:
-            raise ValueError(
-                "spatial partitioning is single-process only: the "
-                "per-process feed ships batch rows, not image-row shards"
-            )
-        if config.train.batch_size % jax.process_count():
-            raise ValueError(
-                f"global batch_size={config.train.batch_size} must divide "
-                f"evenly over {jax.process_count()} processes (each feeds "
-                "its own contiguous rows of the global batch)"
-            )
-    n = n_devices if n_devices is not None else len(jax.devices())
-    n_model = max(1, config.mesh.num_model)
-    if config.mesh.num_data > 0:
-        # explicit sub-mesh: the user chose both axes — only require that
-        # the requested grid actually fits the devices
-        need = config.mesh.num_data * n_model
-        if need > n:
-            raise ValueError(
-                f"mesh {config.mesh.num_data}x{n_model} needs {need} "
-                f"device(s) but only {n} are available"
-            )
-        return
-    if n_model > n:
-        raise ValueError(
-            f"num_model={n_model} exceeds the {n} available device(s); "
-            "the model axis cannot be wider than the mesh"
-        )
-    if n % n_model != 0:
-        raise ValueError(
-            f"{n} device(s) cannot be split evenly into model groups of "
-            f"{n_model}; pick num_model dividing {n}"
-        )
+    benchmark): spatial partitioning constraints, backend/feed/optimizer
+    conflicts, model-parallel constraints, and mesh-vs-device-count fit —
+    the full `parallel/plan.py` decision table (``Plan.validate``).
+    ``n_devices`` defaults to every visible device; pass the size of an
+    explicit device subset if using one."""
+    from replication_faster_rcnn_tpu.parallel.plan import Plan
+
+    Plan.validate(config, n_devices=n_devices)
 
 
 def make_mesh(cfg: MeshConfig, devices: Optional[Sequence[Any]] = None) -> Mesh:

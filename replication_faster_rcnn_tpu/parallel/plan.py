@@ -1,0 +1,408 @@
+"""Declarative compile plans — ONE dispatch layer for every jitted program.
+
+Before this module the jit wrapping of each program was hand-threaded at
+its call site: the Trainer picked donation/out_shardings per feed, the
+shard_map backend wrapped its own body, the warmup registry duplicated
+both, and the serving engine jitted bare. A :class:`Plan` captures that
+choice declaratively — mesh, shard_map in/out specs OR jit out-shardings,
+donation, per-module parameter PartitionSpecs, warmup policy, the
+strict-mode dispatch label — and :func:`compile_step_with_plan` is the
+single place that turns (step_fn, plan) into the jitted callable:
+
+  * ``in_specs``/``out_specs`` present  -> ``jax.jit(shard_map(fn, ...))``
+    (the explicit-collective backend, `parallel/spmd.py`);
+  * ``out_shardings`` present           -> ``jax.jit`` with donation +
+    out-shardings (jit auto-partitioning, GSPMD inserts collectives);
+  * neither                             -> plain ``jax.jit`` (inference:
+    eval sweep, serving buckets).
+
+The wrappings are byte-identical to the pre-Plan call sites — the
+committed HLO fingerprints (`analysis/fingerprints/ci_cpu.json`) pin
+that.
+
+:meth:`Plan.validate` is the companion DECISION TABLE: every
+feed × backend × optimizer compatibility rule that used to live scattered
+across `Trainer.__init__` and `parallel/mesh.py`, one cell per rule, each
+cell unit-testable in isolation (tests/test_plan.py).
+
+This module deliberately imports nothing from the config layer, so the
+config module stays jax-free (the elastic supervisor and `frcnn audit`
+rely on configuring XLA_FLAGS before jax loads).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+
+# jax >= 0.6 promotes shard_map to the top level and renames the
+# replication-check kwarg check_rep -> check_vma; 0.4.x only has the
+# experimental module. Resolve once at import so every Plan consumer
+# works on both.
+if hasattr(jax, "shard_map"):  # pragma: no cover - jax >= 0.6 only
+    _shard_map = jax.shard_map
+    _NO_CHECK = {"check_vma": False}
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _NO_CHECK = {"check_rep": False}
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """How one program compiles: the mesh it runs on, the partitioning
+    mode (shard_map specs, jit out-shardings, or neither), donation, and
+    the metadata its consumers read (per-module param specs for the
+    model-parallel axis, the strict-mode dispatch label, whether AOT
+    warmup should pre-compile it).
+
+    Exactly one partitioning mode may be populated:
+    ``in_specs``/``out_specs`` (shard_map) or ``out_shardings`` (jit
+    auto-partitioning); with neither the program jits plain (single-
+    device inference). ``param_specs`` is documentation-grade truth for
+    the (dp, mp) layout — the pytree of `PartitionSpec`s the state
+    placement used — not an input to compilation (the shardings ride the
+    abstract inputs / out_shardings)."""
+
+    mesh: Any = None
+    # explicit shard_map mode (both or neither)
+    in_specs: Any = None
+    out_specs: Any = None
+    # jit auto-partitioning mode
+    out_shardings: Any = None
+    donate_argnums: Tuple[int, ...] = ()
+    # metadata
+    param_specs: Any = None
+    label: Optional[str] = None
+    warmup: bool = True
+
+    @property
+    def mode(self) -> str:
+        """"shard_map" | "pjit" | "jit" — what compile_step_with_plan does."""
+        if self.in_specs is not None or self.out_specs is not None:
+            return "shard_map"
+        if self.out_shardings is not None:
+            return "pjit"
+        return "jit"
+
+    @classmethod
+    def validate(
+        cls,
+        config,
+        n_devices: Optional[int] = None,
+        process_count: Optional[int] = None,
+    ) -> None:
+        """Run the full decision table against a FasterRCNNConfig, raising
+        ValueError on the first failing cell (and warning on warn-severity
+        cells). The one entry point behind `parallel.validate_parallel`
+        and `Trainer.__init__`."""
+        ctx = PlanContext.from_config(
+            config, n_devices=n_devices, process_count=process_count
+        )
+        apply_table(ctx)
+
+
+def compile_step_with_plan(step_fn: Callable, plan: Plan):
+    """(step_fn, plan) -> the jitted callable, via the plan's mode.
+
+    The three wrappings reproduce the historical call sites byte-for-byte
+    (fingerprint-pinned): shard_map plans wrap the per-shard body first;
+    pjit plans jit with donation + out_shardings; bare plans jit plain.
+    Empty donation / absent out_shardings are NOT passed through, so a
+    bare plan lowers the identical program a bare ``jax.jit`` did."""
+    if plan.mode == "shard_map":
+        if plan.mesh is None:
+            raise ValueError("a shard_map plan needs a mesh")
+        if plan.in_specs is None or plan.out_specs is None:
+            raise ValueError(
+                "a shard_map plan needs both in_specs and out_specs"
+            )
+        step_fn = _shard_map(
+            step_fn,
+            mesh=plan.mesh,
+            in_specs=plan.in_specs,
+            out_specs=plan.out_specs,
+            **_NO_CHECK,
+        )
+    kwargs = {}
+    if plan.donate_argnums:
+        kwargs["donate_argnums"] = plan.donate_argnums
+    if plan.mode == "pjit":
+        kwargs["out_shardings"] = plan.out_shardings
+    return jax.jit(step_fn, **kwargs)
+
+
+# --------------------------------------------------------- decision table
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanContext:
+    """The flattened inputs the compatibility table reads — a plain value
+    object so every cell is testable without building a full config or
+    initializing jax."""
+
+    backend: str = "auto"
+    optimizer: str = "adam"
+    lars: bool = False
+    shard_opt_state: bool = False
+    cache_device: bool = False
+    spatial: bool = False
+    param_sharding: bool = False
+    num_data: int = -1
+    num_model: int = 1
+    image_rows: int = 0
+    batch_size: int = 0
+    n_devices: int = 1
+    process_count: int = 1
+
+    @property
+    def n_model(self) -> int:
+        return max(1, self.num_model)
+
+    @classmethod
+    def from_config(
+        cls,
+        config,
+        n_devices: Optional[int] = None,
+        process_count: Optional[int] = None,
+    ) -> "PlanContext":
+        if n_devices is None:
+            n_devices = len(jax.devices())
+        if process_count is None:
+            process_count = jax.process_count()
+        return cls(
+            backend=config.train.backend,
+            optimizer=config.train.optimizer,
+            lars=config.train.lars,
+            shard_opt_state=config.train.shard_opt_state,
+            cache_device=config.data.cache_device,
+            spatial=config.mesh.spatial,
+            param_sharding=config.mesh.param_sharding,
+            num_data=config.mesh.num_data,
+            num_model=config.mesh.num_model,
+            image_rows=config.data.image_size[0],
+            batch_size=config.train.batch_size,
+            n_devices=n_devices,
+            process_count=process_count,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Cell:
+    """One row of the table: a named predicate over PlanContext plus the
+    uniform error (or warning) it produces when it fires."""
+
+    name: str
+    severity: str  # "error" | "warn"
+    applies: Callable[[PlanContext], bool]
+    message: Callable[[PlanContext], str]
+
+
+# Ordered: earlier cells win when several fire (the order the scattered
+# checks historically ran in: spatial, optimizer, multiprocess, mesh fit,
+# model parallelism, device-cache feed). Messages are pinned by tests —
+# change them only with their tests.
+DECISION_TABLE: Tuple[Cell, ...] = (
+    Cell(
+        "model_axis_unused",
+        "warn",
+        lambda c: (
+            not c.spatial and not c.param_sharding and c.num_model > 1
+        ),
+        lambda c: (
+            f"mesh.num_model={c.num_model} with spatial=False: the model "
+            f"axis carries no sharding, so {c.num_model - 1} of every "
+            f"{c.num_model} chips duplicate work; pass --spatial or drop "
+            "--num-model"
+        ),
+    ),
+    Cell(
+        "spatial_backend",
+        "error",
+        lambda c: c.spatial and c.backend == "spmd",
+        lambda c: (
+            "spatial partitioning requires the jit auto-partitioning "
+            "backend (GSPMD places the conv halo exchanges); the "
+            "explicit shard_map backend shards batch dims only"
+        ),
+    ),
+    Cell(
+        "spatial_num_model",
+        "error",
+        lambda c: c.spatial and c.num_model < 2,
+        lambda c: (
+            "spatial partitioning shards image rows over the model "
+            "axis; set mesh.num_model >= 2 (--num-model), got "
+            f"{c.num_model}"
+        ),
+    ),
+    Cell(
+        "spatial_rows",
+        "error",
+        lambda c: (
+            c.spatial and c.num_model >= 2 and c.image_rows % c.num_model != 0
+        ),
+        lambda c: (
+            "spatial partitioning needs image rows "
+            f"({c.image_rows}) divisible by the model "
+            f"axis ({c.num_model})"
+        ),
+    ),
+    Cell(
+        "lamb_lars",
+        "error",
+        lambda c: c.optimizer == "lamb" and c.lars,
+        lambda c: (
+            "optimizer='lamb' already applies the per-layer trust "
+            "ratio after Adam; combining it with lars=True would "
+            "rescale twice — drop one"
+        ),
+    ),
+    Cell(
+        "lars_sharded_spmd",
+        "error",
+        lambda c: c.shard_opt_state and c.backend == "spmd" and c.lars,
+        lambda c: (
+            "lars trust ratios need full-leaf norms, but the shard_map "
+            "ZeRO-1 backend updates 1/N parameter slices (partial norms); "
+            "use the jit auto-partitioning backend (backend='auto') for "
+            "lars + shard_opt_state"
+        ),
+    ),
+    Cell(
+        "spatial_multiprocess",
+        "error",
+        lambda c: c.process_count > 1 and c.spatial,
+        lambda c: (
+            "spatial partitioning is single-process only: the "
+            "per-process feed ships batch rows, not image-row shards"
+        ),
+    ),
+    Cell(
+        "multiprocess_batch",
+        "error",
+        lambda c: c.process_count > 1 and c.batch_size % c.process_count != 0,
+        lambda c: (
+            f"global batch_size={c.batch_size} must divide "
+            f"evenly over {c.process_count} processes (each feeds "
+            "its own contiguous rows of the global batch)"
+        ),
+    ),
+    Cell(
+        "mesh_fit",
+        "error",
+        lambda c: c.num_data > 0 and c.num_data * c.n_model > c.n_devices,
+        lambda c: (
+            f"mesh {c.num_data}x{c.n_model} needs "
+            f"{c.num_data * c.n_model} "
+            f"device(s) but only {c.n_devices} are available"
+        ),
+    ),
+    Cell(
+        "model_axis_width",
+        "error",
+        lambda c: c.num_data <= 0 and c.n_model > c.n_devices,
+        lambda c: (
+            f"num_model={c.n_model} exceeds the {c.n_devices} available "
+            "device(s); the model axis cannot be wider than the mesh"
+        ),
+    ),
+    Cell(
+        "model_axis_divide",
+        "error",
+        lambda c: c.num_data <= 0 and c.n_devices % c.n_model != 0,
+        lambda c: (
+            f"{c.n_devices} device(s) cannot be split evenly into model "
+            f"groups of {c.n_model}; pick num_model dividing {c.n_devices}"
+        ),
+    ),
+    Cell(
+        "mp_backend",
+        "error",
+        lambda c: c.param_sharding and c.backend == "spmd",
+        lambda c: (
+            "model-parallel parameter sharding (mesh.param_sharding / "
+            "--mesh-shape) requires the jit auto-partitioning backend "
+            "(GSPMD places the weight all-gathers); the explicit "
+            "shard_map backend shards batch dims only"
+        ),
+    ),
+    Cell(
+        "mp_spatial",
+        "error",
+        lambda c: c.param_sharding and c.spatial,
+        lambda c: (
+            "param_sharding and spatial both claim the model axis; "
+            "pick ONE sharding story per mesh axis (--mesh-shape for "
+            "weights, --spatial for image rows)"
+        ),
+    ),
+    Cell(
+        "mp_cache",
+        "error",
+        lambda c: c.param_sharding and c.cache_device,
+        lambda c: (
+            "cache_device pairs with replicated parameters; the "
+            "model-parallel feed (--mesh-shape with MP > 1) uses the "
+            "host loader — drop --cache-device or --mesh-shape"
+        ),
+    ),
+    Cell(
+        "cache_backend",
+        "error",
+        lambda c: c.cache_device and c.backend == "spmd",
+        lambda c: (
+            "cache_device currently pairs with the jit auto-"
+            "partitioned backend only (train.backend='auto'); the "
+            "explicit shard_map backend feeds host batches"
+        ),
+    ),
+    Cell(
+        "cache_multiprocess",
+        "error",
+        lambda c: c.cache_device and c.process_count > 1,
+        lambda c: (
+            "cache_device requires a single-process runtime: "
+            "DeviceCache device_puts the full dataset from this "
+            "host to a replicated sharding, which one process "
+            "cannot place across a multi-host mesh. Drop "
+            "--cache-device (use the host loader, optionally with "
+            "device_normalize) on multi-host runs."
+        ),
+    ),
+)
+
+
+def check_cells(ctx: PlanContext, names: Optional[Tuple[str, ...]] = None):
+    """Every firing cell (optionally restricted to ``names``), in table
+    order, as (cell, message) pairs. Pure — no raising, no warning."""
+    out = []
+    for cell in DECISION_TABLE:
+        if names is not None and cell.name not in names:
+            continue
+        if cell.applies(ctx):
+            out.append((cell, cell.message(ctx)))
+    return out
+
+
+def apply_table(
+    ctx: PlanContext, names: Optional[Tuple[str, ...]] = None
+) -> None:
+    """Evaluate the table: warn on warn-severity cells, raise ValueError
+    on the first error cell (table order)."""
+    for cell, message in check_cells(ctx, names):
+        if cell.severity == "warn":
+            warnings.warn(message, stacklevel=3)
+        else:
+            raise ValueError(message)
+
+
+SPATIAL_CELLS: Tuple[str, ...] = (
+    "model_axis_unused",
+    "spatial_backend",
+    "spatial_num_model",
+    "spatial_rows",
+)

@@ -54,20 +54,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from replication_faster_rcnn_tpu.config import FasterRCNNConfig
 from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
 from replication_faster_rcnn_tpu.parallel import zero
+from replication_faster_rcnn_tpu.parallel.plan import Plan, compile_step_with_plan
 from replication_faster_rcnn_tpu.train import fault
 from replication_faster_rcnn_tpu.train.train_step import TrainState, compute_losses
-
-# jax >= 0.6 promotes shard_map to the top level and renames the
-# replication-check kwarg check_rep -> check_vma; 0.4.x only has the
-# experimental module. Resolve once at import so the builder below works
-# on both.
-if hasattr(jax, "shard_map"):  # pragma: no cover - jax >= 0.6 only
-    _shard_map = jax.shard_map
-    _NO_CHECK = {"check_vma": False}
-else:
-    from jax.experimental.shard_map import shard_map as _shard_map
-
-    _NO_CHECK = {"check_rep": False}
 
 Array = jnp.ndarray
 
@@ -356,11 +345,14 @@ def make_shard_map_train_step(
     else:
         body, batch_spec = step_body, P(axis)
 
-    sharded = _shard_map(
-        body,
+    plan = Plan(
         mesh=mesh,
         in_specs=(state_spec, batch_spec),
         out_specs=(state_spec, P()),
-        **_NO_CHECK,
+        donate_argnums=(0,),
+        param_specs=state_spec,
+        label="train_step"
+        if steps_per_dispatch <= 1
+        else f"multi_step_k{steps_per_dispatch}",
     )
-    return jax.jit(sharded, donate_argnums=(0,)), model
+    return compile_step_with_plan(body, plan), model

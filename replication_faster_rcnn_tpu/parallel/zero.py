@@ -65,11 +65,49 @@ def shard_spec(shape: Sequence[int], n: int, axis_name: str) -> P:
     return P(*spec)
 
 
+def compose_spec(
+    shape: Sequence[int],
+    n_data: int,
+    n_model: int,
+    data_axis: str,
+    model_axis: str,
+) -> P:
+    """The (dp, mp)-composed PartitionSpec for one leaf: the model axis
+    takes the leaf's `shard_dim` under ``n_model`` (the mp weight layout),
+    then the data axis takes the largest remaining dim divisible by
+    ``n_data`` (ZeRO-1 over dp, displaced off the mp dim). With
+    ``n_model <= 1`` this degenerates EXACTLY to `shard_spec` over the
+    data axis — the dp-only layout every committed fingerprint pins."""
+    mp_d = shard_dim(shape, n_model)
+    spec = [None] * len(shape)
+    if mp_d >= 0:
+        spec[mp_d] = model_axis
+    if n_data > 1:
+        cands = [
+            d
+            for d, s in enumerate(shape)
+            if d != mp_d and s % n_data == 0 and s >= n_data
+        ]
+        if cands:
+            spec[max(cands, key=lambda d: shape[d])] = data_axis
+    if not any(spec):
+        return P()
+    return P(*spec)
+
+
 def _leaf_sharding(leaf: Any, mesh: Mesh, cfg: MeshConfig) -> NamedSharding:
     """Shard the largest dim divisible by the data-axis size; scalars and
-    indivisible shapes stay replicated."""
+    indivisible shapes stay replicated. Under ``param_sharding`` the model
+    axis claims its dim first (`compose_spec`) so the moments mirror the
+    mp weight layout and ZeRO-dp moves to a remaining dim."""
     n = mesh.shape[cfg.data_axis]
-    return NamedSharding(mesh, shard_spec(np.shape(leaf), n, cfg.data_axis))
+    n_mp = mesh.shape[cfg.model_axis] if cfg.param_sharding else 1
+    return NamedSharding(
+        mesh,
+        compose_spec(
+            np.shape(leaf), n, n_mp, cfg.data_axis, cfg.model_axis
+        ),
+    )
 
 
 def opt_state_shardings(opt_state: Any, mesh: Mesh, cfg: MeshConfig) -> Any:
@@ -79,15 +117,37 @@ def opt_state_shardings(opt_state: Any, mesh: Mesh, cfg: MeshConfig) -> Any:
     )
 
 
+def param_shardings(params: Any, mesh: Mesh, cfg: MeshConfig) -> Any:
+    """Model-parallel per-module parameter shardings: every leaf splits
+    its largest mp-divisible dim over the ``model`` axis (the same
+    `shard_dim` rule ZeRO-1 applies on the data axis), indivisible leaves
+    stay replicated. This is the (dp, mp) tentpole's weight layout — each
+    chip holds ~1/num_model of the backbone/head weights and GSPMD
+    inserts the all-gathers the forward needs."""
+    n = mesh.shape[cfg.model_axis]
+    return jax.tree_util.tree_map(
+        lambda leaf: NamedSharding(
+            mesh, shard_spec(np.shape(leaf), n, cfg.model_axis)
+        ),
+        params,
+    )
+
+
 def train_state_shardings(
     state: Any, mesh: Mesh, cfg: MeshConfig, shard_opt: bool
 ) -> Any:
-    """Shardings for a full TrainState: params/BN stats/step/rng replicated,
-    optimizer state leafwise-sharded when ``shard_opt``. Usable as both the
-    jit in_shardings (via device_put) and out_shardings — the state layout
-    is then stable across steps under donation."""
+    """Shardings for a full TrainState: BN stats/step/rng replicated,
+    params replicated (or mp-sharded over the model axis under
+    ``cfg.param_sharding``), optimizer state leafwise-sharded when
+    ``shard_opt``. Usable as both the jit in_shardings (via device_put)
+    and out_shardings — the state layout is then stable across steps
+    under donation."""
     replicated = NamedSharding(mesh, P())
     full = jax.tree_util.tree_map(lambda _: replicated, state)
+    if cfg.param_sharding and mesh.shape[cfg.model_axis] > 1:
+        full = full.replace(
+            params=param_shardings(state.params, mesh, cfg)
+        )
     if not shard_opt:
         return full
     return full.replace(opt_state=opt_state_shardings(state.opt_state, mesh, cfg))

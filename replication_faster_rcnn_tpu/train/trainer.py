@@ -32,6 +32,8 @@ from replication_faster_rcnn_tpu.data.prefetch_device import (
     DevicePrefetcher,
 )
 from replication_faster_rcnn_tpu.parallel import (
+    Plan,
+    compile_step_with_plan,
     fit_data_parallelism,
     is_coordinator,
     make_mesh,
@@ -207,22 +209,9 @@ class Trainer:
             # (data/device_cache.py — the route past a transfer-bound
             # loader). The jitter resample necessarily runs on device in
             # this mode, the path already proven at training quality
-            # (0.591 vs host 0.592 val mAP, PARITY.md).
-            if config.train.backend == "spmd":
-                raise ValueError(
-                    "cache_device currently pairs with the jit auto-"
-                    "partitioned backend only (train.backend='auto'); the "
-                    "explicit shard_map backend feeds host batches"
-                )
-            if jax.process_count() > 1:
-                raise ValueError(
-                    "cache_device requires a single-process runtime: "
-                    "DeviceCache device_puts the full dataset from this "
-                    "host to a replicated sharding, which one process "
-                    "cannot place across a multi-host mesh. Drop "
-                    "--cache-device (use the host loader, optionally with "
-                    "device_normalize) on multi-host runs."
-                )
+            # (0.591 vs host 0.592 val mAP, PARITY.md). Feed/backend
+            # compatibility (cache×spmd, cache×multiprocess, ...) was
+            # already rejected above by the Plan.validate decision table.
             from replication_faster_rcnn_tpu.data.device_cache import (
                 CachedSampler,
                 DeviceCache,
@@ -279,13 +268,33 @@ class Trainer:
             train_state_shardings,
         )
 
-        # params/BN replicated; Adam moments sharded over the data axis
-        # when ZeRO-1 weight-update sharding is on (`parallel/zero.py`)
+        # params/BN replicated (params mp-sharded over the model axis
+        # under mesh.param_sharding); Adam moments sharded over the data
+        # axis when ZeRO-1 weight-update sharding is on (`parallel/zero.py`)
         self._state_shardings = train_state_shardings(
             state, self.mesh, config.mesh, config.train.shard_opt_state
         )
+        self._mp = (
+            config.mesh.param_sharding
+            and self.mesh.shape[config.mesh.model_axis] > 1
+        )
         self.state: TrainState = place_train_state(state, self._state_shardings)
 
+        # --- dispatch: every train program compiles through ONE layer,
+        # parallel/plan.py::compile_step_with_plan. The shard_map backend
+        # builds its own Plan (in/out specs) inside
+        # make_shard_map_train_step; the jit auto-partitioning feeds share
+        # this pjit plan — donated state, out_shardings pinning the
+        # (possibly mp-sharded) state layout stable across steps.
+        self._step_plan = Plan(
+            mesh=self.mesh,
+            donate_argnums=(0,),
+            out_shardings=(self._state_shardings, None),
+            param_specs=jax.tree_util.tree_map(
+                lambda s: s.spec, self._state_shardings.params
+            ),
+            label="train_step",
+        )
         if config.train.backend == "spmd":
             from replication_faster_rcnn_tpu.parallel import make_shard_map_train_step
 
@@ -303,19 +312,14 @@ class Trainer:
 
             # (state, cache, sel) step; the cache argument is the same
             # device-resident buffers every call — never donated
-            self.jitted_step = jax.jit(
+            self.jitted_step = compile_step_with_plan(
                 make_cached_train_step(self.model, config, self.tx),
-                donate_argnums=(0,),
-                out_shardings=(self._state_shardings, None),
+                self._step_plan,
             )
         else:
-            step_fn = make_train_step(self.model, config, self.tx)
-            # pinning out_shardings keeps the state layout stable across
-            # steps (donation reuses the buffers in place)
-            self.jitted_step = jax.jit(
-                step_fn,
-                donate_argnums=(0,),
-                out_shardings=(self._state_shardings, None),
+            self.jitted_step = compile_step_with_plan(
+                make_train_step(self.model, config, self.tx),
+                self._step_plan,
             )
         # fused multi-step dispatch (train.steps_per_dispatch > 1): one
         # jitted call trains K steps via lax.scan (train_chunk). The plain
@@ -326,6 +330,9 @@ class Trainer:
         self.jitted_multi_step = None
         if self.steps_per_dispatch > 1:
             k = self.steps_per_dispatch
+            multi_plan = dataclasses.replace(
+                self._step_plan, label=f"multi_step_k{k}"
+            )
             if config.train.backend == "spmd":
                 from replication_faster_rcnn_tpu.parallel import (
                     make_shard_map_train_step,
@@ -336,18 +343,16 @@ class Trainer:
                     state_template=self.state,
                 )
             elif config.data.cache_device:
-                self.jitted_multi_step = jax.jit(
+                self.jitted_multi_step = compile_step_with_plan(
                     make_cached_multi_step(self.model, config, self.tx, k),
-                    donate_argnums=(0,),
-                    out_shardings=(self._state_shardings, None),
+                    multi_plan,
                 )
             else:
-                self.jitted_multi_step = jax.jit(
+                self.jitted_multi_step = compile_step_with_plan(
                     build_multi_step(
                         make_train_step(self.model, config, self.tx), k
                     ),
-                    donate_argnums=(0,),
-                    out_shardings=(self._state_shardings, None),
+                    multi_plan,
                 )
         # runtime hygiene gate (debug.strict / --strict): transfer guard +
         # recompile detector around every dispatch, armed after warmup
@@ -423,12 +428,18 @@ class Trainer:
         (`gather_replicated`) — a plain device_put cannot reshard leaves
         whose shards live on other processes' chips (multi-host)."""
         state = self.state
+        if self._mp:
+            # model-parallel weights live 1/mp per chip; checkpoints stay
+            # fully replicated (topology-portable), so gather them back
+            state = state.replace(
+                params=gather_replicated(state.params, self.mesh)
+            )
         if self.config.train.shard_opt_state:
-            # gather ONLY the sharded subtree: params/BN are already
-            # replicated, and a jitted identity (unlike device_put) always
-            # materializes fresh output buffers — gathering the whole state
-            # would transiently hold a second copy of the model at every
-            # checkpoint event
+            # gather ONLY the sharded subtrees: BN stats (and params
+            # outside mp mode) are already replicated, and a jitted
+            # identity (unlike device_put) always materializes fresh
+            # output buffers — gathering the whole state would transiently
+            # hold a second copy of the model at every checkpoint event
             state = state.replace(
                 opt_state=gather_replicated(state.opt_state, self.mesh)
             )
@@ -722,8 +733,14 @@ class Trainer:
                 "batch_stats": jax.device_get(self.state.batch_stats),
             }
         grafted = convert.graft_into_variables(variables, pth_path)
+        from replication_faster_rcnn_tpu.parallel.mesh import put_host_tree
+
+        # params go back onto their plan layout (mp-sharded under
+        # mesh.param_sharding, replicated otherwise); BN stats replicate
         self.state = self.state.replace(
-            params=replicate_tree(grafted["params"], self.mesh),
+            params=put_host_tree(
+                grafted["params"], self._state_shardings.params
+            ),
             batch_stats=replicate_tree(grafted["batch_stats"], self.mesh),
         )
 

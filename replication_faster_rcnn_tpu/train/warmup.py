@@ -118,7 +118,43 @@ class ProgramSpec:
 # gains the sharded trust ratio (psum'd per-layer norms, see
 # train/train_step.py::scale_by_sharded_trust_ratio), a distinct program
 # with its own fingerprint.
-TRAIN_FEEDS: Tuple[str, ...] = ("loader", "cached", "spmd", "zero", "zero_lamb")
+# "mp" is the jit auto-partitioning backend on a 2D (dp, mp) mesh with
+# model-parallel weight sharding (mesh.param_sharding / --mesh-shape):
+# params arrive 1/mp per chip and GSPMD inserts the weight all-gathers.
+# "mp_zero" additionally shards the optimizer state (ZeRO-1 over dp,
+# composed off the mp dim — parallel/zero.py::compose_spec).
+TRAIN_FEEDS: Tuple[str, ...] = (
+    "loader", "cached", "spmd", "zero", "zero_lamb", "mp", "mp_zero"
+)
+
+# the (dp, mp) topology the audited mp programs lower against when the
+# config itself is not model-parallel: mp = 4, dp = devices/4 (the audit
+# tier runs 8 fake CPU devices -> a (2, 4) mesh)
+MP_AUDIT_NUM_MODEL = 4
+
+
+def mp_audit_config(config: FasterRCNNConfig) -> FasterRCNNConfig:
+    """The config the "mp"/"mp_zero" feeds lower: the given config if it
+    is already model-parallel, else the audit (dp, mp) topology forced
+    onto it (num_model=4, dp = devices/4, param_sharding on)."""
+    if config.mesh.param_sharding and config.mesh.num_model > 1:
+        return config
+    n, m = len(jax.devices()), MP_AUDIT_NUM_MODEL
+    if n % m:
+        raise ValueError(
+            f"the mp audit feeds need a device count divisible by {m}, "
+            f"got {n} (run under XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 on CPU)"
+        )
+    return config.replace(
+        mesh=dataclasses.replace(
+            config.mesh,
+            num_data=n // m,
+            num_model=m,
+            param_sharding=True,
+            spatial=False,
+        )
+    )
 
 
 def program_name(feed: str, k: int) -> str:
@@ -177,8 +213,18 @@ def build_serving_specs(
         for n in sorted(set(config.serving.batch_sizes)):
             name = serve_program_name(h, w, n)
 
-            def _build(hh=h, ww=w, nn=n):
-                jitted = jax.jit(make_infer_fn(model, config, (hh, ww)))
+            def _build(hh=h, ww=w, nn=n, name_=name):
+                from replication_faster_rcnn_tpu.parallel.plan import (
+                    Plan,
+                    compile_step_with_plan,
+                )
+
+                # a bare plan: serving buckets jit plain (single-device
+                # inference, params resident, nothing donated)
+                jitted = compile_step_with_plan(
+                    make_infer_fn(model, config, (hh, ww)),
+                    Plan(label=name_),
+                )
                 images_abs = jax.ShapeDtypeStruct((nn, hh, ww, 3), np.float32)
                 return jitted, (variables_abs, images_abs)
 
@@ -224,6 +270,10 @@ def build_program_specs(
         image_sharding,
         replicated,
         stacked_batch_sharding,
+    )
+    from replication_faster_rcnn_tpu.parallel.plan import (
+        Plan,
+        compile_step_with_plan,
     )
     from replication_faster_rcnn_tpu.parallel.zero import train_state_shardings
     from replication_faster_rcnn_tpu.train.train_step import (
@@ -304,16 +354,22 @@ def build_program_specs(
         "mesh_shape": dict(mesh.shape),
     }
 
+    # the pjit plan every jit auto-partitioning feed compiles through:
+    # donated state, out_shardings pinning the state layout across steps
+    def _pjit_plan(shardings, mesh_=None):
+        return Plan(
+            mesh=mesh_ if mesh_ is not None else mesh,
+            donate_argnums=(0,),
+            out_shardings=(shardings, None),
+        )
+
     def _loader(k: int):
         step_fn = make_train_step(model, config, tx)
         if k == 1:
             fn, args = step_fn, (state_abs, batch_abs)
         else:
             fn, args = build_multi_step(step_fn, k), (state_abs, _chunk_abs(k))
-        jitted = jax.jit(
-            fn, donate_argnums=(0,), out_shardings=(state_shardings, None)
-        )
-        return jitted, args
+        return compile_step_with_plan(fn, _pjit_plan(state_shardings)), args
 
     def _cached(k: int):
         if k == 1:
@@ -324,10 +380,51 @@ def build_program_specs(
             args = (state_abs, cache_abs, _sel_abs((k,)))
         # donate the state ONLY — the cache must survive the dispatch
         # (train/train_step.py::make_cached_train_step)
-        jitted = jax.jit(
-            fn, donate_argnums=(0,), out_shardings=(state_shardings, None)
+        return compile_step_with_plan(fn, _pjit_plan(state_shardings)), args
+
+    def _mp(k: int, shard_opt: bool = False):
+        # model-parallel feed: the mp (dp, mp) mesh, params sharded 1/mp
+        # over the model axis in BOTH the abstract inputs and the
+        # out_shardings; the step function itself is the plain auto-
+        # partitioning one — GSPMD does the rest. ``shard_opt`` composes
+        # ZeRO-1 over dp (the "mp_zero" feed).
+        mcfg = mp_audit_config(config)
+        if shard_opt != mcfg.train.shard_opt_state:
+            mcfg = mcfg.replace(
+                train=dataclasses.replace(
+                    mcfg.train, shard_opt_state=shard_opt
+                )
+            )
+        mesh_mp, mesh_mp_cfg = _mesh_for(mcfg)
+        mp_shardings = train_state_shardings(
+            state_raw, mesh_mp, mesh_mp_cfg, shard_opt
         )
-        return jitted, args
+        state_mp = _attach(state_raw, mp_shardings)
+        img_mp = image_sharding(mesh_mp, mesh_mp_cfg)
+        other_mp = batch_sharding(mesh_mp, mesh_mp_cfg)
+        batch_mp = {
+            key: jax.ShapeDtypeStruct(
+                v.shape, v.dtype,
+                sharding=img_mp if key == "image" else other_mp,
+            )
+            for key, v in batch_raw.items()
+        }
+        step_fn = make_train_step(model, mcfg, tx)
+        if k == 1:
+            fn, args = step_fn, (state_mp, batch_mp)
+        else:
+            stacked_mp = stacked_batch_sharding(mesh_mp, mesh_mp_cfg)
+            chunk_mp = {
+                key: jax.ShapeDtypeStruct(
+                    (k,) + v.shape, v.dtype, sharding=stacked_mp
+                )
+                for key, v in batch_mp.items()
+            }
+            fn, args = build_multi_step(step_fn, k), (state_mp, chunk_mp)
+        return (
+            compile_step_with_plan(fn, _pjit_plan(mp_shardings, mesh_mp)),
+            args,
+        )
 
     def _spmd(k: int):
         from replication_faster_rcnn_tpu.parallel.spmd import (
@@ -421,6 +518,8 @@ def build_program_specs(
     builders = {
         "loader": _loader, "cached": _cached, "spmd": _spmd, "zero": _zero,
         "zero_lamb": _zero_lamb,
+        "mp": _mp,
+        "mp_zero": (lambda k: _mp(k, shard_opt=True)),
     }
     roles = {
         "loader": ("state", "batch"),
@@ -428,7 +527,15 @@ def build_program_specs(
         "spmd": ("state", "batch"),
         "zero": ("state", "batch"),
         "zero_lamb": ("state", "batch"),
+        "mp": ("state", "batch"),
+        "mp_zero": ("state", "batch"),
     }
+    mp_meta = dict(meta)
+    if any(f in ("mp", "mp_zero") for f in feeds):
+        # mp programs lower on their own (dp, mp) mesh — stamp ITS shape
+        # so the collective-contract rules know the model-axis width
+        mp_mesh, _ = _mesh_for(mp_audit_config(config))
+        mp_meta["mesh_shape"] = dict(mp_mesh.shape)
     specs: Dict[str, ProgramSpec] = {}
     for feed in feeds:
         for k in ks:
@@ -439,7 +546,7 @@ def build_program_specs(
                 k=k,
                 arg_roles=roles[feed],
                 build=(lambda f=feed, kk=k: builders[f](kk)),
-                meta=dict(meta),
+                meta=dict(mp_meta if feed in ("mp", "mp_zero") else meta),
             )
     if include_eval:
         specs["eval_infer"] = ProgramSpec(
@@ -475,7 +582,11 @@ def warmup_compile(
     (= len(dataset)) to pin shapes; without it the loader program is
     warmed instead (same step math, different feed plumbing)."""
     tracer = tspans.current_tracer()
-    if config.train.backend == "spmd":
+    if config.mesh.param_sharding and config.mesh.num_model > 1:
+        # model-parallel run (--mesh-shape with MP > 1; the decision
+        # table already pinned backend='auto' for this combination)
+        feed = "mp_zero" if config.train.shard_opt_state else "mp"
+    elif config.train.backend == "spmd":
         if config.train.shard_opt_state:
             feed = (
                 "zero_lamb" if config.train.optimizer == "lamb" else "zero"
@@ -496,18 +607,15 @@ def warmup_compile(
         # serve` start against the same persistent cache deserializes
         # instead of compiling
         specs = {**specs, **build_serving_specs(config)}
-    # legacy names: the CLI's warmup report (and its consumers) predate
-    # the registry's canonical feed-qualified names
-    legacy = {program_name(feed, 1): "train_step"}
-    if k > 1:
-        legacy[program_name(feed, k)] = "multi_step"
 
+    # report under the registry's canonical feed-qualified names
+    # (train_<feed>_k<K> / eval_infer / serve_<HxW>_b<N>) — the same keys
+    # `frcnn audit` banks, so the two reports line up program-for-program
     times: Dict[str, float] = {}
     for spec in specs.values():
-        name = legacy.get(spec.name, spec.name) if spec.feed != "eval" else spec.name
-        with tracer.span(f"compile/{name}", cat="compile"):
+        with tracer.span(f"compile/{spec.name}", cat="compile"):
             t0 = time.perf_counter()
             jitted, args = spec.build()
             jitted.lower(*args).compile()
-            times[name] = round(time.perf_counter() - t0, 3)
+            times[spec.name] = round(time.perf_counter() - t0, 3)
     return times

@@ -65,6 +65,79 @@ module @jit_train_step {
 """
 
 
+# COMPILED module with GSPMD-inserted collectives on a (2, 4) mesh:
+# row-major device grid, so model-axis groups are consecutive runs and
+# data-axis groups are strided — in both the explicit replica_groups
+# form and the iota [G,S]<=[N] (optionally transposed) form
+COMPILED_PARTITIONED = """\
+HloModule jit_train, entry_computation_layout={...}
+
+ENTRY %main {
+  %ag = f32[64,4]{1,0} all-gather(%x), channel_id=1, replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={1}, use_global_device_ids=true
+  %ar = f32[64]{0} all-reduce(%y), channel_id=2, replica_groups=[4,2]<=[2,4]T(1,0), use_global_device_ids=true, to_apply=%add
+  %ar.1 = bf16[8]{0} all-reduce(%z), channel_id=3, replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add
+  %rs = f32[8]{0} reduce-scatter(%w), channel_id=4, replica_groups=[2,4]<=[8], dimensions={0}, to_apply=%add
+}
+"""
+
+MESH_2x4 = {"data": 2, "model": 4}
+
+
+class TestPartitionedCollectives:
+    def test_axis_classification_on_2x4_mesh(self):
+        inv = fp_mod.parse_partitioned_collectives(
+            COMPILED_PARTITIONED, MESH_2x4
+        )
+        # explicit consecutive groups -> model axis
+        assert inv["all-gather"] == {"count": 1, "axes": {"model": 1}}
+        # iota [2,4]<=[8] reshapes to consecutive rows -> model axis
+        assert inv["reduce-scatter"] == {"count": 1, "axes": {"model": 1}}
+        # transposed iota -> strided {{0,4},{1,5},...} -> data axis;
+        # the single 8-device group is 'all'
+        assert inv["all-reduce"] == {
+            "count": 2,
+            "axes": {"all": 1, "data": 1},
+        }
+
+    def test_unknown_mesh_buckets_as_world(self):
+        inv = fp_mod.parse_partitioned_collectives(COMPILED_PARTITIONED, None)
+        assert all(
+            set(entry["axes"]) == {"world"} for entry in inv.values()
+        )
+
+    def test_collective_free_module_is_empty(self):
+        assert (
+            fp_mod.parse_partitioned_collectives(COMPILED_HEADER, MESH_2x4)
+            == {}
+        )
+
+    def test_instruction_names_not_double_counted(self):
+        # `%all-reduce.1 = ... all-reduce(...)`: the NAME must not count
+        text = (
+            "  %all-reduce.1 = f32[4]{0} all-reduce(%x), "
+            "replica_groups={{0,1,2,3},{4,5,6,7}}, to_apply=%add\n"
+        )
+        inv = fp_mod.parse_partitioned_collectives(text, MESH_2x4)
+        assert inv == {"all-reduce": {"count": 1, "axes": {"model": 1}}}
+
+    def test_replica_group_decoding(self):
+        assert fp_mod._parse_replica_groups("{{0,1},{2,3}}") == [
+            [0, 1],
+            [2, 3],
+        ]
+        assert fp_mod._parse_replica_groups("[2,4]<=[8]") == [
+            [0, 1, 2, 3],
+            [4, 5, 6, 7],
+        ]
+        assert fp_mod._parse_replica_groups("[4,2]<=[2,4]T(1,0)") == [
+            [0, 4],
+            [1, 5],
+            [2, 6],
+            [3, 7],
+        ]
+        assert fp_mod._parse_replica_groups("garbage") is None
+
+
 class TestParsing:
     def test_alias_map_entries(self):
         entries = fp_mod.parse_alias_map(COMPILED_HEADER)
@@ -291,6 +364,46 @@ class TestContracts:
         )
         [v] = hlolint.check_contracts({"p": fp}, _cfg(), BUDGET)
         assert v.rule == "HX003" and "loader" in v.message
+
+    def test_hx003_mp_requires_model_axis_exchange(self):
+        fp = _fp(
+            feed="mp",
+            program="train_mp_k1",
+            collectives={},
+            partitioned_collectives={
+                "all-reduce": {"count": 2, "axes": {"data": 2}}
+            },
+        )
+        [v] = hlolint.check_contracts({"p": fp}, _cfg(), BUDGET)
+        assert v.rule == "HX003" and "model-axis" in v.message
+
+    def test_hx003_mp_with_model_gathers_is_clean(self):
+        fp = _fp(
+            feed="mp",
+            program="train_mp_k1",
+            collectives={},
+            partitioned_collectives={
+                "all-gather": {"count": 5, "axes": {"model": 5}},
+                "all-reduce": {"count": 2, "axes": {"data": 2}},
+            },
+        )
+        assert hlolint.check_contracts({"p": fp}, _cfg(), BUDGET) == []
+
+    def test_hx003_dp_feed_must_not_touch_model_axis(self):
+        fp = _fp(
+            feed="loader",
+            collectives={},
+            partitioned_collectives={
+                "all-gather": {"count": 1, "axes": {"model": 1}}
+            },
+        )
+        [v] = hlolint.check_contracts({"p": fp}, _cfg(), BUDGET)
+        assert v.rule == "HX003" and "only the mp feeds" in v.message
+
+    def test_records_without_partitioned_field_skip_the_mp_rule(self):
+        # pre-mp banked records have no partitioned_collectives: clean
+        assert "partitioned_collectives" not in _fp()
+        assert hlolint.check_contracts({"p": _fp()}, _cfg(), BUDGET) == []
 
     def test_hx004_over_budget(self):
         viols = hlolint.check_contracts({"p": _fp()}, _cfg(), 1)

@@ -1,0 +1,146 @@
+"""2D-mesh-profile harness machinery (`benchmarks/mesh_profile.py`):
+record identity, the structural mp gates (per-device param bytes, the
+model-axis collective inventory), and the throughput regression gate —
+exercised on synthetic records, no compiles or timing. The banked CPU
+record under benchmarks/records/ is validated for shape and for actually
+passing its own structural gate (a PR acceptance criterion: per-device
+param bytes ~1/mp of replicated with model-axis all-gathers present).
+"""
+
+import glob
+import importlib.util
+import json
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "mesh_profile",
+        os.path.join(_REPO, "benchmarks", "mesh_profile.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+mp = _load()
+
+_MP_COLL = {
+    "all-gather": {"count": 206, "axes": {"model": 202, "data": 4}},
+    "all-reduce": {"count": 438, "axes": {"model": 296, "data": 142}},
+}
+_DP_COLL = {
+    "all-reduce": {"count": 142, "axes": {"all": 142}},
+}
+
+
+def _rec(**over):
+    rec = {
+        "schema": mp.SCHEMA,
+        "n_dev": 8,
+        "mesh_dp": 2,
+        "mesh_mp": 4,
+        "param_bytes_per_device_replicated": 48_000_000,
+        "param_bytes_per_device_mp": 12_000_000,
+        "param_bytes_frac": 0.25,
+        "collectives_mp": {k: dict(v) for k, v in _MP_COLL.items()},
+        "collectives_dp": {k: dict(v) for k, v in _DP_COLL.items()},
+        "images_per_sec_mp": 3.0,
+        "images_per_sec_dp": 2.0,
+    }
+    rec.update(over)
+    return rec
+
+
+class TestRecordIdentity:
+    def test_key_and_path(self):
+        key = mp.record_key("tiny64b8", "cpu", 2, 4)
+        assert key == "tiny64b8_cpu_mesh2x4"
+        path = mp.record_path(key, "/bank")
+        assert path == "/bank/mesh_profile_tiny64b8_cpu_mesh2x4.json"
+
+
+class TestStructuralGate:
+    def test_ideal_sharding_passes(self):
+        assert mp.check_structural(_rec()) == []
+
+    def test_slack_admits_replicated_leaves(self):
+        # 1/4 ideal + 50% slack => ceiling 37.5% of replicated bytes
+        rec = _rec(param_bytes_per_device_mp=17_000_000)
+        assert mp.check_structural(rec) == []
+
+    def test_unsharded_params_fail(self):
+        rec = _rec(param_bytes_per_device_mp=48_000_000)
+        fails = mp.check_structural(rec)
+        assert len(fails) == 1 and "not sharded" in fails[0]
+
+    def test_missing_measurement_fails(self):
+        fails = mp.check_structural(_rec(param_bytes_per_device_mp=0))
+        assert fails == ["param byte measurement missing or zero"]
+
+    def test_missing_model_axis_gather_fails(self):
+        coll = {"all-reduce": dict(_MP_COLL["all-reduce"])}
+        fails = mp.check_structural(_rec(collectives_mp=coll))
+        assert any("model-axis all-gather" in f for f in fails)
+
+    def test_model_axis_ops_in_dp_baseline_fail(self):
+        dp = {"all-gather": {"count": 3, "axes": {"model": 3}}}
+        fails = mp.check_structural(_rec(collectives_dp=dp))
+        assert any("dp-only step" in f for f in fails)
+
+
+class TestRegressionGate:
+    def test_within_tolerance_passes(self):
+        fails, warns = mp.check_regression(
+            _rec(images_per_sec_mp=2.9), _rec(), tol=0.15
+        )
+        assert fails == [] and warns == []
+
+    def test_slip_past_half_tolerance_warns(self):
+        fails, warns = mp.check_regression(
+            _rec(images_per_sec_mp=3.0 * (1 - 0.10)), _rec(), tol=0.15
+        )
+        assert fails == []
+        assert len(warns) == 1 and "slipping" in warns[0]
+
+    def test_throughput_drop_fails(self):
+        fails, _ = mp.check_regression(
+            _rec(images_per_sec_mp=2.0), _rec(), tol=0.15
+        )
+        assert len(fails) == 1 and mp.GATE_KEY in fails[0]
+
+    def test_param_bytes_growth_fails(self):
+        fails, _ = mp.check_regression(
+            _rec(param_bytes_frac=0.5), _rec(), tol=0.15
+        )
+        assert len(fails) == 1 and "param_bytes_frac grew" in fails[0]
+
+    def test_schema_mismatch_skips(self):
+        banked = _rec(schema="mesh_profile/v0")
+        fails, warns = mp.check_regression(_rec(images_per_sec_mp=0.1), banked)
+        assert fails == [] and len(warns) == 1
+
+
+class TestBankedRecords:
+    def test_committed_records_pass_their_own_gates(self):
+        paths = glob.glob(
+            os.path.join(_REPO, "benchmarks", "records", "mesh_profile_*.json")
+        )
+        assert paths, "no banked mesh_profile record committed"
+        for path in paths:
+            with open(path) as f:
+                rec = json.load(f)
+            assert rec["schema"] == mp.SCHEMA
+            assert mp.check_structural(rec) == [], path
+            # the banked measurement shows the ~1/mp param reduction
+            # (the acceptance bound: <= 1/mp + 1.5 * slack headroom)
+            assert rec["param_bytes_frac"] <= (1.0 / rec["mesh_mp"]) * 1.5
+            # identity embedded in the filename matches the record
+            key = mp.record_key(
+                rec["config"], rec["platform"], rec["mesh_dp"], rec["mesh_mp"]
+            )
+            assert os.path.basename(path) == f"mesh_profile_{key}.json"
+            fails, _ = mp.check_regression(rec, rec)
+            assert fails == [], path

@@ -18,7 +18,11 @@ from replication_faster_rcnn_tpu.config import (
     TrainConfig,
 )
 from replication_faster_rcnn_tpu.parallel import validate_parallel
-from replication_faster_rcnn_tpu.parallel.zero import shard_dim, shard_spec
+from replication_faster_rcnn_tpu.parallel.zero import (
+    compose_spec,
+    shard_dim,
+    shard_spec,
+)
 
 
 class TestShardDim:
@@ -40,6 +44,40 @@ class TestShardDim:
         )
         assert shard_spec((8, 128), 8, "data") == P(None, "data")
         assert shard_spec((7,), 8, "data") == P()
+
+
+class TestComposeSpec:
+    """The 2D (dp, mp) leaf rule: the model axis claims shard_dim first,
+    the data axis takes the largest REMAINING divisible dim — and with a
+    1-wide model axis the rule degenerates EXACTLY to the dp-only
+    shard_spec (what keeps the pre-mp fingerprints byte-identical)."""
+
+    def test_model_axis_claims_shard_dim_first(self):
+        # conv kernel [3, 3, 16, 32] at (dp=2, mp=4): mp takes dim 3
+        # (32, the largest), dp takes dim 2 (16, largest remaining)
+        assert compose_spec((3, 3, 16, 32), 2, 4, "data", "model") == P(
+            None, None, "data", "model"
+        )
+
+    def test_single_divisible_dim_goes_to_model(self):
+        # only one shardable dim: mp wins it, dp finds nothing
+        assert compose_spec((3, 3, 64), 2, 4, "data", "model") == P(
+            None, None, "model"
+        )
+
+    def test_unshardable_leaf_is_replicated(self):
+        assert compose_spec((7,), 2, 4, "data", "model") == P()
+        assert compose_spec((), 2, 4, "data", "model") == P()
+
+    def test_degenerates_to_dp_only_rule(self):
+        for shape in ((16, 3, 3, 8), (8, 128), (64,), (7,), (), (4, 4)):
+            assert compose_spec(shape, 8, 1, "data", "model") == shard_spec(
+                shape, 8, "data"
+            ), shape
+
+    def test_data_axis_skips_the_model_dim(self):
+        # (64,) at (2, 4): mp takes dim 0; dp must NOT double-claim it
+        assert compose_spec((64,), 2, 4, "data", "model") == P("model")
 
 
 def _cfg(**train_over):
