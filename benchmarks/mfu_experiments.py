@@ -197,13 +197,19 @@ EXPERIMENTS = [
         # index 12 — TOMBSTONE (keeps later indices stable). The Pallas
         # NMS backend was deleted in round 5 (VERDICT r4 #6: three rounds
         # as "pending validation" with no live chip slot; see git history
-        # for ops/nms_pallas.py). Invoking this slot now just re-records
-        # the removal instead of erroring the queue.
+        # for ops/nms_pallas.py) and REBUILT under ISSUE 13 as
+        # ops/pallas/ behind ops.backend (FRCNN_NMS=pallas resolves to it
+        # again; interpret-mode parity gates it in tier 1, compiles go
+        # through the warmup registry only). This slot keeps recording
+        # the round-5 removal — on-chip measurement of the rebuilt
+        # backend belongs to a fresh experiment index, not a rewrite of
+        # this one's history.
         "name": "pallas_nms_instep_removed",
         "env": {},
         "cmd": ["/bin/sh", "-c",
                 "echo '{\"metric\": \"note\", \"value\": "
-                "\"pallas backend deleted round 5\"}'"],
+                "\"pallas backend deleted round 5; rebuilt as "
+                "ops/pallas behind ops.backend in ISSUE 13\"}'"],
         "success_key": "metric",
         "why": "tombstone: backend deleted round 5 per VERDICT #6",
     },

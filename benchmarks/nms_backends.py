@@ -5,13 +5,17 @@ Run on a healthy TPU (check the relay first — see
 
     python benchmarks/nms_backends.py [--batch 8] [--n 12000] [--out 600]
 
-Prints ms/call for the XLA selection loop (`ops/nms.py`) and the tiled
-exact algorithm (`ops/nms_tiled.py`), plus a selection-parity check.
+Prints ms/call for the XLA selection loop (`ops/nms.py`), the tiled
+exact algorithm (`ops/nms_tiled.py`), and the rebuilt Pallas kernel
+(`ops/pallas/nms_kernel.py` — ISSUE 13; the round-5 removal's successor,
+now CPU-validatable in interpret mode and compiled only through the
+warmup registry), plus a selection-parity check — all three must select
+identically. Each row names the path that actually EXECUTED: off-TPU the
+pallas row runs the interpreter, so its time is a correctness artifact,
+not a perf number; on a real chip it prices the Mosaic kernel (the
+removed round-5 kernel measured 3.2x the loop standalone on v5e).
 CPU reference numbers (1 core, 12k->600, batch 1): loop 88.6ms,
-tiled 8.2ms (identical selections). (A third backend — the Pallas
-kernel, standalone 3.2x the loop on v5e — was removed in round 5 after
-its in-train-step compile wedged the remote service twice and its
-validation slot never got a live chip; git history has it.)
+tiled 8.2ms (identical selections).
 """
 
 from __future__ import annotations
@@ -55,6 +59,7 @@ def main(argv=None) -> int:
     ap.add_argument("--thresh", type=float, default=0.7)
     args = ap.parse_args(argv)
 
+    from replication_faster_rcnn_tpu import ops as ops_pkg
     from replication_faster_rcnn_tpu.ops.nms import nms_fixed
     from replication_faster_rcnn_tpu.ops.nms_tiled import nms_fixed_tiled
 
@@ -65,12 +70,28 @@ def main(argv=None) -> int:
             jax.vmap(lambda b, s: nms_fixed_tiled(b, s, args.thresh, args.out))
         ),
     }
+    executed = {"loop": "xla", "tiled": "xla"}
+    if ops_pkg.pallas_available("nms"):
+        from replication_faster_rcnn_tpu.ops.pallas import nms_fixed_pallas
+
+        interpret = ops_pkg.interpret_mode()
+        backends["pallas"] = jax.jit(
+            jax.vmap(
+                lambda b, s: nms_fixed_pallas(
+                    b, s, args.thresh, args.out, interpret=interpret
+                )
+            )
+        )
+        executed["pallas"] = "pallas_interpret" if interpret else "pallas"
+    else:
+        print(" pallas: unavailable (ops/pallas failed to import) — skipped")
     results = {}
     for name, fn in backends.items():
         ms, idx, valid = _time(fn, boxes, scores)
         results[name] = (ms, np.asarray(idx), np.asarray(valid))
         print(f"{name:>7}: {ms:8.2f} ms/call  "
-              f"(batch {args.batch}, {args.n}->{args.out})")
+              f"(batch {args.batch}, {args.n}->{args.out})  "
+              f"[executed: {executed[name]}]")
 
     ref_idx, ref_val = results["loop"][1], results["loop"][2]
     for name, (_, idx, valid) in results.items():

@@ -40,6 +40,7 @@ if _REPO not in sys.path:
 
 RECORDS_DIR = os.path.join(_REPO, "benchmarks", "records")
 SCHEMA = "step_profile/v1"
+OPS_SCHEMA = "ops_profile/v1"
 DEFAULT_TOL = 0.15
 
 # throughput is the hard gate; phase means on a shared CPU jitter well
@@ -549,6 +550,184 @@ def profile(cfg, config_token: str, n_steps: int = 5):
 
 
 # ---------------------------------------------------------------------------
+# per-op backend profile (ISSUE 13): the detection hot ops, timed through
+# the SAME dispatch seams the train/serve programs use, once per ops
+# backend. On CPU the pallas rows run in interpret mode — structurally
+# faithful (the exact kernels tier 1 gates) but not a perf signal, so the
+# banked record is a coverage artifact there, never a regression gate;
+# on a real TPU the same command prices the Mosaic kernels for real.
+
+
+def ops_profile_path(config_token: str, platform: str,
+                     records_dir: str = RECORDS_DIR) -> str:
+    return os.path.join(
+        records_dir, f"ops_profile_{config_token}_{platform}.json"
+    )
+
+
+def ops_profile(cfg, config_token: str, n_reps: int = 10):
+    """Per-op (nms / roi_align / iou_match) × backend (xla / pallas)
+    timings on this config's shapes; returns the ``ops_profile/v1``
+    record. Each row names the backend it REQUESTED and the path that
+    actually executed (`executed`), so a silent pallas→xla fallback is
+    visible in the banked artifact."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from replication_faster_rcnn_tpu import ops as ops_pkg
+    from replication_faster_rcnn_tpu.ops import boxes as box_ops
+    from replication_faster_rcnn_tpu.ops import roi_ops
+    from replication_faster_rcnn_tpu.ops.nms_tiled import nms_fixed_tiled
+
+    rng = np.random.default_rng(0)
+    h, w = cfg.data.image_size
+    pre_nms = cfg.proposals.pre_nms_train
+    post_nms = cfg.proposals.post_nms_train
+    n_sample = cfg.roi_targets.n_sample
+    n_gt = cfg.data.max_boxes
+    # the RPN's anchor count at trunk stride 16, K=9 — same grid the
+    # target-assignment seam matches against
+    n_anchor = (h // 16) * (w // 16) * 9
+    fh, fw, c = h // 16, w // 16, 256
+
+    def boxes_of(n):
+        tl = rng.uniform(0, 0.7 * h, (n, 2)).astype(np.float32)
+        wh = rng.uniform(1.0, 0.3 * h, (n, 2)).astype(np.float32)
+        return jnp.asarray(np.concatenate([tl, tl + wh], axis=1))
+
+    nms_boxes = boxes_of(pre_nms)
+    nms_scores = jnp.asarray(rng.uniform(size=pre_nms).astype(np.float32))
+    anchors = boxes_of(n_anchor)
+    gt = boxes_of(n_gt)
+    gt_mask = jnp.asarray(np.arange(n_gt) < max(1, n_gt // 2))
+    feat = jnp.asarray(rng.standard_normal((fh, fw, c)).astype(np.float32))
+    rois = boxes_of(n_sample) * (min(fh, fw) / float(h))
+
+    interpret = ops_pkg.interpret_mode()
+
+    def xla_match(a, g, m):
+        ious = jnp.where(m[None, :], box_ops.iou(a, g), -1.0)
+        return ious, jnp.argmax(ious, 1), jnp.max(jnp.maximum(ious, 0.0), 1)
+
+    def build(op, backend):
+        """(callable, args, executed-path label) for one (op, backend)
+        cell — pallas cells go through the real kernels, falling back to
+        the xla row's callable when the kernels can't import."""
+        if op == "nms":
+            if backend == "pallas" and ops_pkg.pallas_available("nms"):
+                from replication_faster_rcnn_tpu.ops.pallas import (
+                    nms_fixed_pallas,
+                )
+
+                fn = jax.jit(
+                    lambda b, s: nms_fixed_pallas(
+                        b, s, 0.7, post_nms, interpret=interpret
+                    )
+                )
+                return fn, (nms_boxes, nms_scores), _pallas_label(interpret)
+            fn = jax.jit(lambda b, s: nms_fixed_tiled(b, s, 0.7, post_nms))
+            return fn, (nms_boxes, nms_scores), "xla"
+        if op == "roi_align":
+            if backend == "pallas" and ops_pkg.pallas_available("roi_align"):
+                fn = jax.jit(
+                    lambda f, r: roi_ops.roi_align(f, r, method="pallas")
+                )
+                return fn, (feat, rois), _pallas_label(interpret)
+            fn = jax.jit(lambda f, r: roi_ops.roi_align(f, r, method="einsum"))
+            return fn, (feat, rois), "xla"
+        if op == "iou_match":
+            if backend == "pallas" and ops_pkg.pallas_available("anchor_match"):
+                from replication_faster_rcnn_tpu.ops.pallas import (
+                    match_boxes_pallas,
+                )
+
+                fn = jax.jit(
+                    lambda a, g, m: match_boxes_pallas(
+                        a, g, m, interpret=interpret
+                    )
+                )
+                return fn, (anchors, gt, gt_mask), _pallas_label(interpret)
+            return jax.jit(xla_match), (anchors, gt, gt_mask), "xla"
+        raise ValueError(op)
+
+    shapes = {
+        "nms": {"n_boxes": pre_nms, "max_out": post_nms},
+        "roi_align": {"feat": [fh, fw, c], "n_rois": n_sample, "out": 7},
+        "iou_match": {"n_anchors": n_anchor, "n_gt": n_gt},
+    }
+    ops: dict = {}
+    for op in ("nms", "roi_align", "iou_match"):
+        ops[op] = dict(shapes[op])
+        for backend in ("xla", "pallas"):
+            fn, args, executed = build(op, backend)
+            out = fn(*args)
+            jax.tree_util.tree_map(
+                lambda x: x.block_until_ready(), out
+            )  # compile
+            t0 = time.perf_counter()
+            for _ in range(n_reps):
+                out = fn(*args)
+            jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
+            ops[op][backend] = {
+                "mean_ms": round(
+                    (time.perf_counter() - t0) / n_reps * 1e3, 4
+                ),
+                "executed": executed,
+            }
+
+    dev = jax.devices()[0]
+    return {
+        "schema": OPS_SCHEMA,
+        "config": config_token,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", None),
+        "interpret": interpret,
+        "n_reps": n_reps,
+        "ops": ops,
+        "measured": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+def _pallas_label(interpret: bool) -> str:
+    return "pallas_interpret" if interpret else "pallas"
+
+
+def check_ops_record(current, banked):
+    """Structural gate over the banked ops record: same schema, same
+    (op × backend) matrix, and every pallas row still executes a pallas
+    path (a row that silently degraded to 'xla' means the kernels
+    stopped importing — that fails like a regression). Timings are never
+    compared: the pallas rows are interpret-mode on CPU."""
+    failures = []
+    if banked.get("schema") != OPS_SCHEMA:
+        failures.append(
+            f"banked ops record has schema {banked.get('schema')!r}, "
+            f"expected {OPS_SCHEMA!r}"
+        )
+        return failures
+    cur_ops, bank_ops = current.get("ops", {}), banked.get("ops", {})
+    if sorted(cur_ops) != sorted(bank_ops):
+        failures.append(
+            f"ops matrix changed: {sorted(cur_ops)} vs banked "
+            f"{sorted(bank_ops)}"
+        )
+        return failures
+    for op, row in sorted(cur_ops.items()):
+        for backend in ("xla", "pallas"):
+            if backend not in row:
+                failures.append(f"ops.{op} lost its {backend} row")
+                continue
+            executed = row[backend].get("executed", "")
+            if backend == "pallas" and not executed.startswith("pallas"):
+                failures.append(
+                    f"ops.{op} pallas row executed {executed!r} — the "
+                    "pallas kernels fell back to xla"
+                )
+    return failures
+
+
+# ---------------------------------------------------------------------------
 # CLI
 
 
@@ -617,9 +796,15 @@ def main(argv=None) -> int:
     path = record_path(key, args.records_dir)
     print(json.dumps(record, indent=1, sort_keys=True))
 
+    ops_record = ops_profile(cfg, token)
+    ops_path = ops_profile_path(token, record["platform"], args.records_dir)
+    print(json.dumps(ops_record, indent=1, sort_keys=True))
+
     if args.update:
         save_record(record, path)
+        save_record(ops_record, ops_path)
         print(f"step_profile: banked {path}", file=sys.stderr)
+        print(f"step_profile: banked {ops_path}", file=sys.stderr)
         return 0
     if args.no_check:
         return 0
@@ -633,6 +818,11 @@ def main(argv=None) -> int:
     failures, warnings = check_regression(
         record, load_record(path), tol=args.tol, strict_phases=args.strict_phases
     )
+    if os.path.exists(ops_path):
+        failures.extend(
+            f"ops: {m}"
+            for m in check_ops_record(ops_record, load_record(ops_path))
+        )
     for w in warnings:
         print(f"step_profile: WARN {w}", file=sys.stderr)
     for f in failures:

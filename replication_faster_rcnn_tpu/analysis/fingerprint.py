@@ -25,6 +25,7 @@ analysis/hlolint.py.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
@@ -70,6 +71,37 @@ _ALIAS_ENTRY_RE = re.compile(
 # element types are the last 'x'-separated token of a tensor type
 # (`tensor<4xf64>`) or the whole body for scalars (`tensor<f64>`)
 _F64_RE = re.compile(r"[<x]f64>")
+# custom calls print either as the pretty form `stablehlo.custom_call
+# @target(...)` or the generic form with an explicit attribute
+# `call_target_name = "target"`; the same module never mixes both for
+# one op, so counting both patterns cannot double-count
+_CUSTOM_CALL_RES = (
+    re.compile(r"stablehlo\.custom_call\s+@([\w.$-]+)"),
+    re.compile(r'call_target_name\s*=\s*"([^"]+)"'),
+)
+
+
+def parse_custom_calls(stablehlo_text: str) -> Dict[str, int]:
+    """{call_target_name: count} over a lowered module's custom calls.
+
+    The ops-backend provenance signal for hlolint's HX007: on TPU the
+    pallas kernels lower to ``tpu_custom_call`` (Mosaic) targets, while a
+    backend=xla program must contain none of them. Empty dict == no
+    custom calls at all."""
+    counts: Dict[str, int] = {}
+    for pattern in _CUSTOM_CALL_RES:
+        for target in pattern.findall(stablehlo_text):
+            counts[target] = counts.get(target, 0) + 1
+    return dict(sorted(counts.items()))
+
+
+def module_hash(stablehlo_text: str) -> str:
+    """sha256[:16] of the lowered module text — a whole-program identity
+    cheap enough to bank. Interpret-mode pallas twins contain no custom
+    call on CPU, so this is the only artifact-level evidence that the
+    backend scope actually changed the lowered program (HX007 compares a
+    twin's hash against its base's)."""
+    return hashlib.sha256(stablehlo_text.encode()).hexdigest()[:16]
 
 
 def parse_alias_map(compiled_text: str) -> List[Dict[str, Any]]:
@@ -352,6 +384,8 @@ def fingerprint_program(spec) -> Dict[str, Any]:
             compiled_text, spec.meta.get("mesh_shape")
         ),
         "has_f64": contains_f64(stablehlo),
+        "custom_calls": parse_custom_calls(stablehlo),
+        "module_hash": module_hash(stablehlo),
         "cost": lowered_cost_analysis(lowered),
         "memory": memory_stats(compiled),
         "meta": dict(spec.meta),
@@ -420,6 +454,9 @@ MEMORY_REL_TOL = 0.25
 # deliberately absent: pre-existing banks predate the field, and the
 # post-partitioning inventory wobbles with XLA's SPMD pass pipeline —
 # the hlolint HX003 mp cells assert on the live value instead.
+# `custom_calls` / `module_hash` are likewise excluded: banks recorded
+# before those fields stay valid, and module text wobbles with the jax
+# version — the HX007 ops-backend rule asserts on the live values.
 _EXACT_FIELDS = ("args", "params", "outputs", "aliasing", "collectives", "has_f64")
 
 

@@ -28,6 +28,13 @@ HX005  per-program drift vs the banked fingerprint: structural fields
 HX006  program set = expected bucket count: the bank covers exactly the
        registry's programs on this platform (recompile/bucket drift
        caught before runtime, complementing analysis/strict.py).
+HX007  ops-backend provenance: a backend=xla program must contain NO
+       pallas custom-call targets (tpu_custom_call / mosaic / triton);
+       a backend=pallas program on a real TPU must contain at least one;
+       off-TPU (interpret mode lowers pallas to plain StableHLO, so no
+       custom call exists to witness) the twin's ``module_hash`` must
+       differ from its base's — the backend scope demonstrably changed
+       the lowered program.
 
 `frcnn audit` drives this (``--json``, ``--update`` to re-bank, nonzero
 exit on any violation); tests/test_hlolint.py gates a CPU subset in
@@ -49,14 +56,21 @@ HLO_RULES: Dict[str, str] = {
     "HX004": "compiled peak-memory estimate exceeds the HBM budget",
     "HX005": "fingerprint drift vs the banked record",
     "HX006": "program set does not match the expected bucket count / bank missing",
+    "HX007": "ops-backend provenance: pallas custom-calls in an xla program, or a pallas twin indistinguishable from its base",
 }
+
+# custom-call targets that witness a pallas lowering (Mosaic on TPU,
+# Triton on GPU) — matched as substrings of the call_target_name
+PALLAS_CALL_MARKERS = ("tpu_custom_call", "mosaic", "triton")
 
 # the audited program matrix: every feed the Trainer can run, single-step
 # and fused — including the ZeRO-1 variant of the shard_map backend and
 # its LAMB chain (sharded trust ratio), and the model-parallel auto-
 # partitioned feeds on the audit (dp, mp) mesh — plus eval (15 programs)
 # and the serving engine's bucket matrix (audit_config's 2 resolutions ×
-# 2 batch sizes = 4 more)
+# 2 batch sizes = 4 more) — plus the three ops.backend=pallas twins
+# (train/warmup.py::pallas_twin_base_names: loader k=1, eval, one
+# serving bucket), 22 programs total
 AUDIT_FEEDS = ("loader", "cached", "spmd", "zero", "zero_lamb", "mp", "mp_zero")
 AUDIT_KS = (1, 2)
 AUDIT_BANK_NAME = "ci"
@@ -146,8 +160,11 @@ def expected_program_names(
     config: Optional[FasterRCNNConfig] = None,
 ) -> List[str]:
     """The audited program set; with ``config`` the serving engine's
-    bucket programs (serving.resolutions × batch_sizes) are included."""
+    bucket programs (serving.resolutions × batch_sizes) and the
+    ops.backend=pallas twin programs are included."""
     from replication_faster_rcnn_tpu.train.warmup import (
+        pallas_program_name,
+        pallas_twin_base_names,
         program_name,
         serving_program_names,
     )
@@ -157,6 +174,9 @@ def expected_program_names(
         names.append("eval_infer")
     if config is not None:
         names.extend(serving_program_names(config))
+        names.extend(
+            pallas_program_name(b) for b in pallas_twin_base_names(config)
+        )
     return names
 
 
@@ -170,6 +190,7 @@ def collect_fingerprints(
     program on CPU; the contract/drift rules below are pure functions
     over the returned dicts."""
     from replication_faster_rcnn_tpu.train.warmup import (
+        build_pallas_program_specs,
         build_program_specs,
         build_serving_specs,
     )
@@ -177,7 +198,11 @@ def collect_fingerprints(
     specs = build_program_specs(
         config, feeds=AUDIT_FEEDS, ks=AUDIT_KS, include_eval=True, cache_n=cache_n
     )
-    specs = {**specs, **build_serving_specs(config)}
+    specs = {
+        **specs,
+        **build_serving_specs(config),
+        **build_pallas_program_specs(config),
+    }
     if programs is None:
         wanted = list(specs)
     else:
@@ -391,6 +416,61 @@ def check_contracts(
                         "shard over the model axis",
                     )
                 )
+
+        # HX007 — ops-backend provenance. Applied only to records that
+        # carry the `custom_calls` field (live fingerprints and post-
+        # ISSUE-13 banks; older banked records simply skip the rule).
+        cc = fp.get("custom_calls")
+        if cc is not None:
+            pallas_cc = {
+                t: n
+                for t, n in cc.items()
+                if any(m in t.lower() for m in PALLAS_CALL_MARKERS)
+            }
+            meta = fp.get("meta", {})
+            if meta.get("ops_backend", "xla") != "pallas":
+                if pallas_cc:
+                    out.append(
+                        Violation(
+                            "HX007",
+                            name,
+                            f"pallas custom-calls {pallas_cc} in a "
+                            "backend=xla program — the ops dispatch leaked "
+                            "a pallas kernel into the default lowering",
+                        )
+                    )
+            elif not meta.get("pallas_interpret"):
+                if not pallas_cc:
+                    out.append(
+                        Violation(
+                            "HX007",
+                            name,
+                            "no pallas custom-call in a backend=pallas "
+                            "program compiled for a real accelerator — the "
+                            "backend scope did not reach the lowering "
+                            f"(custom calls: {sorted(cc) or 'none'})",
+                        )
+                    )
+            else:
+                # interpret mode: no custom call exists to witness the
+                # backend, so require the twin's module to differ from
+                # its base's (skipped when the base wasn't collected in
+                # this audit — e.g. an explicit --programs subset)
+                base = fingerprints.get(meta.get("twin", ""))
+                if (
+                    base is not None
+                    and fp.get("module_hash")
+                    and fp.get("module_hash") == base.get("module_hash")
+                ):
+                    out.append(
+                        Violation(
+                            "HX007",
+                            name,
+                            "interpret-mode pallas twin lowered a module "
+                            f"byte-identical to its base {meta.get('twin')!r} "
+                            "— the backend scope changed nothing",
+                        )
+                    )
 
         # HX004 — memory budget
         mem = fp.get("memory")

@@ -745,6 +745,35 @@ class ServingConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class OpsConfig:
+    """Detection-op kernel backend (ops/__init__.py::resolve_backend).
+
+    ``backend`` selects the implementation family for the detection hot
+    ops — greedy NMS, ROIAlign, and the IoU/anchor-matching pass:
+
+    * ``"xla"`` (default): the pure-XLA tilings (`ops/nms_tiled.py`,
+      `ops/roi_ops.py`, `ops/boxes.py`). Compiled programs are
+      byte-identical to every committed fingerprint bank.
+    * ``"pallas"``: the Pallas kernels in `ops/pallas/` — interpret-mode
+      (pure JAX) off-TPU so the same kernel code is parity-tested on CPU,
+      Mosaic-compiled on a real TPU, and only ever compiled on-chip
+      through the warmup ProgramSpec registry.
+
+    The env var ``FRCNN_OPS_BACKEND`` overrides this key at process level
+    (resolved once, at the first dispatch); `ops.backend_scope` overrides
+    it lexically for a single trace.
+    """
+
+    backend: str = "xla"
+
+    def __post_init__(self):
+        if self.backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"ops.backend must be 'xla' or 'pallas', got {self.backend!r}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class FasterRCNNConfig:
     anchors: AnchorConfig = dataclasses.field(default_factory=AnchorConfig)
     proposals: ProposalConfig = dataclasses.field(default_factory=ProposalConfig)
@@ -760,6 +789,7 @@ class FasterRCNNConfig:
     analysis: AnalysisConfig = dataclasses.field(default_factory=AnalysisConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     elastic: ElasticConfig = dataclasses.field(default_factory=ElasticConfig)
+    ops: OpsConfig = dataclasses.field(default_factory=OpsConfig)
 
     def feature_size(self, image_size: Optional[Tuple[int, int]] = None) -> Tuple[int, int]:
         """Spatial size of the stride-16 feature map for a given image size.

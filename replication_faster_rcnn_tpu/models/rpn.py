@@ -115,8 +115,9 @@ def select_proposals(
     top_scores = scores[top_idx]
     top_boxes = props[top_idx]
 
-    # tiled exact NMS by default on every backend; FRCNN_NMS=loop (serial
-    # selection loop) opts in — see nms_fixed_auto
+    # tiled exact NMS by default; ops.backend=pallas (or FRCNN_NMS=pallas)
+    # swaps in the bit-identical ops/pallas kernel, FRCNN_NMS=loop the
+    # serial selection loop — see nms_fixed_auto
     from replication_faster_rcnn_tpu.ops.nms import nms_fixed_auto
 
     idx, valid = nms_fixed_auto(

@@ -1,3 +1,31 @@
+"""Detection ops + the `ops.backend` dispatch seam.
+
+Two implementation families live side by side:
+
+* **xla** (default): the pure-XLA tilings (`nms_tiled.py`, `roi_ops.py`,
+  `boxes.py`). The committed fingerprint banks (`frcnn audit`) pin these
+  programs byte-for-byte, so the default backend must never change HLO.
+* **pallas**: the Pallas kernels in `ops/pallas/` — interpret-mode off-TPU
+  (pure JAX, parity-tested on CPU in tier-1), Mosaic-compiled on a TPU.
+
+Resolution order, highest first:
+
+1. :func:`backend_scope` — lexical override (tests, warmup twin programs)
+2. ``FRCNN_OPS_BACKEND`` env var — read ONCE per process then cached, so a
+   mid-run env flip can't split a program between backends (the trace-time
+   ``FRCNN_NMS`` reads were a recurring source of that confusion)
+3. the ``config.ops.backend`` value the caller passes down
+4. ``"xla"``
+
+`want_pallas(op)` is the single question dispatch sites ask; it folds in
+availability (import failure of the kernel package warns once per op and
+falls back to XLA rather than erroring — e.g. a jax build without pallas).
+"""
+
+import os
+import threading
+import warnings
+
 from replication_faster_rcnn_tpu.ops import (  # noqa: F401
     anchors,
     boxes,
@@ -5,3 +33,115 @@ from replication_faster_rcnn_tpu.ops import (  # noqa: F401
     nms_tiled,
     roi_ops,
 )
+
+BACKENDS = ("xla", "pallas")
+
+_ENV_VAR = "FRCNN_OPS_BACKEND"
+_env_backend = None  # resolved-once cache: None = not read yet, "" = unset
+_env_lock = threading.Lock()
+_scope = threading.local()
+_warned: set = set()
+
+
+def _warn_once(key: str, msg: str) -> None:
+    if key in _warned:
+        return
+    _warned.add(key)
+    warnings.warn(msg, stacklevel=3)
+
+
+def _env_override() -> str:
+    """The FRCNN_OPS_BACKEND value, read once per process ("" = unset)."""
+    global _env_backend
+    if _env_backend is None:
+        with _env_lock:
+            if _env_backend is None:
+                raw = os.environ.get(_ENV_VAR, "").strip().lower()
+                if raw and raw not in BACKENDS:
+                    _warn_once(
+                        "env:invalid",
+                        f"{_ENV_VAR}={raw!r} is not one of {BACKENDS}; "
+                        "ignoring (using the config/default backend)",
+                    )
+                    raw = ""
+                _env_backend = raw
+    return _env_backend
+
+
+class backend_scope:
+    """Lexically pin the ops backend for the current thread.
+
+    with ops.backend_scope("pallas"):
+        ...   # every dispatch site in this block resolves to pallas
+
+    Wins over the env var and config — this is how the warmup registry
+    traces the ``__pallas`` twin programs and how tier-1 exercises both
+    families in one process.
+    """
+
+    def __init__(self, backend: str):
+        if backend not in BACKENDS:
+            raise ValueError(
+                f"backend must be one of {BACKENDS}, got {backend!r}"
+            )
+        self.backend = backend
+
+    def __enter__(self):
+        stack = getattr(_scope, "stack", None)
+        if stack is None:
+            stack = _scope.stack = []
+        stack.append(self.backend)
+        return self
+
+    def __exit__(self, *exc):
+        _scope.stack.pop()
+        return False
+
+
+def resolve_backend(config=None) -> str:
+    """The effective ops backend: scope > env (read once) > config > xla."""
+    stack = getattr(_scope, "stack", None)
+    if stack:
+        return stack[-1]
+    env = _env_override()
+    if env:
+        return env
+    if config is not None:
+        ops_cfg = getattr(config, "ops", config)
+        backend = getattr(ops_cfg, "backend", None)
+        if backend is not None:
+            if backend not in BACKENDS:
+                raise ValueError(
+                    f"config ops.backend must be one of {BACKENDS}, "
+                    f"got {backend!r}"
+                )
+            return backend
+    return "xla"
+
+
+def pallas_available(op: str = "") -> bool:
+    """Can the pallas kernels be used here? (warns once per op if not)"""
+    try:
+        from replication_faster_rcnn_tpu.ops import pallas  # noqa: F401
+
+        return True
+    except Exception as e:  # pragma: no cover - env without pallas support
+        _warn_once(
+            f"unavailable:{op}",
+            f"ops.backend=pallas requested but the kernel package failed "
+            f"to import ({type(e).__name__}: {e}); falling back to the XLA "
+            + (f"implementation for {op!r}" if op else "implementations"),
+        )
+        return False
+
+
+def want_pallas(op: str, config=None) -> bool:
+    """True iff dispatch site ``op`` should take the pallas path."""
+    return resolve_backend(config) == "pallas" and pallas_available(op)
+
+
+def interpret_mode() -> bool:
+    """Pallas interpret mode: everywhere except a real TPU backend."""
+    import jax
+
+    return jax.default_backend() != "tpu"

@@ -109,61 +109,81 @@ def nms_fixed_auto(
     3.7 ms of a 42.9 ms step), and b16 went 96 -> 210
     (benchmarks/bench_v5e_round2.json).
 
-    Overrides via FRCNN_NMS: ``loop`` (the selection loop above) or
-    ``tiled`` (explicit default). A third backend — an in-VMEM Pallas
-    kernel — existed through round 5 as opt-in ``FRCNN_NMS=pallas``:
-    standalone it measured 3.2x the XLA loop (9.4 ms vs 30.2 ms for a
-    batch-8 12k->600 NMS on v5e), but compiling it inside the full
-    train-step module wedged the remote TPU service (rounds 1 and 4),
-    its in-step validation slot never got a live chip, and per the
-    round-4 review three rounds as permanently-experimental code was
-    maintenance surface, not capability — deleted; see git history
-    (ops/nms_pallas.py) to resurrect on hardware with a local toolchain.
+    Overrides via FRCNN_NMS: ``loop`` (the selection loop above),
+    ``tiled`` (explicit default), or ``pallas`` (the `ops/pallas/` kernel
+    — same tile/fixpoint recurrence as tiled, bit-identical selections).
+    ``FRCNN_NMS=pallas`` and the legacy ``FRCNN_PALLAS_NMS=1`` spelling
+    were warn-and-fall-back tombstones between the round-5 removal of the
+    old kernel (git 431e219: no CPU-testable parity path, and in-train-step
+    compilation wedged the remote TPU service — see
+    benchmarks/STAGE_BREAKDOWN.md) and the ISSUE-13 rebuild; they now
+    resolve to the rebuilt backend. With no explicit FRCNN_NMS choice the
+    `ops.backend` axis decides (`ops.want_pallas`): backend=pallas routes
+    here too, backend=xla keeps the tiled default.
     """
     import os
 
-    choice = os.environ.get("FRCNN_NMS", "")
+    choice = os.environ.get("FRCNN_NMS", "").strip().lower()
     if not choice and os.environ.get("FRCNN_PALLAS_NMS") == "1":
-        # the legacy opt-in spelling for the deleted backend must not be
-        # silently ignored — same signal as FRCNN_NMS=pallas below
+        # the legacy opt-in spelling for the round-5 kernel — same signal
+        # as FRCNN_NMS=pallas below, resolving to the rebuilt backend
         choice = "pallas"
-    if choice and choice not in ("loop", "tiled"):
+    if choice and choice not in ("loop", "tiled", "pallas"):
         import warnings
 
         warnings.warn(
-            f"unknown FRCNN_NMS={choice!r} (choices: loop, tiled; the "
-            "experimental pallas backend was removed in round 5); "
+            f"unknown FRCNN_NMS={choice!r} (choices: loop, tiled, pallas); "
             "using the tiled default"
         )
         choice = ""
     if not choice:
-        choice = "tiled"
+        from replication_faster_rcnn_tpu import ops as ops_pkg
+
+        choice = "pallas" if ops_pkg.want_pallas("nms") else "tiled"
+    if choice == "pallas":
+        from replication_faster_rcnn_tpu import ops as ops_pkg
+
+        if ops_pkg.pallas_available("nms"):
+            from replication_faster_rcnn_tpu.ops.pallas import nms_fixed_pallas
+
+            return nms_fixed_pallas(
+                boxes, scores, iou_thresh, max_out, mask=mask,
+                tile=_tile_from_env(), assume_sorted=assume_sorted,
+                interpret=ops_pkg.interpret_mode(),
+            )
+        choice = "tiled"  # pallas_available warned once already
     if choice == "tiled":
         from replication_faster_rcnn_tpu.ops.nms_tiled import nms_fixed_tiled
 
-        # FRCNN_NMS_TILE tunes the candidates-per-sequential-step tile
-        # (default 512). Larger tiles mean fewer sequential steps but a
-        # bigger in-tile fixpoint matrix; the optimum is hardware- and
-        # budget-dependent (bench experiment: benchmarks/mfu_experiments.py).
-        # Bad values warn and fall back - a typo in a sweep must not
-        # crash a training run at trace time
-        try:
-            tile = int(os.environ.get("FRCNN_NMS_TILE", "512"))
-            if tile < 1:
-                raise ValueError(tile)
-        except ValueError:
-            import warnings
-
-            warnings.warn(
-                f"invalid FRCNN_NMS_TILE={os.environ['FRCNN_NMS_TILE']!r} "
-                "(want a positive int); using 512"
-            )
-            tile = 512
         return nms_fixed_tiled(
-            boxes, scores, iou_thresh, max_out, mask=mask, tile=tile,
-            assume_sorted=assume_sorted,
+            boxes, scores, iou_thresh, max_out, mask=mask,
+            tile=_tile_from_env(), assume_sorted=assume_sorted,
         )
     return nms_fixed(boxes, scores, iou_thresh, max_out, mask=mask)
+
+
+def _tile_from_env() -> int:
+    """FRCNN_NMS_TILE: candidates-per-sequential-step tile (default 512),
+    honored by the tiled and pallas backends alike. Larger tiles mean
+    fewer sequential steps but a bigger in-tile fixpoint matrix; the
+    optimum is hardware- and budget-dependent (bench experiment:
+    benchmarks/mfu_experiments.py). Bad values warn and fall back — a
+    typo in a sweep must not crash a training run at trace time."""
+    import os
+
+    try:
+        tile = int(os.environ.get("FRCNN_NMS_TILE", "512"))
+        if tile < 1:
+            raise ValueError(tile)
+        return tile
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"invalid FRCNN_NMS_TILE={os.environ['FRCNN_NMS_TILE']!r} "
+            "(want a positive int); using 512"
+        )
+        return 512
 
 
 def batched_nms_fixed(

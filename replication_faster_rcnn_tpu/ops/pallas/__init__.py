@@ -1,0 +1,37 @@
+"""Pallas TPU kernels for the detection hot ops (ROADMAP open item 1).
+
+This package is the `ops.backend = "pallas"` half of the dispatch seam in
+`ops/__init__.py`. Three kernels cover the ops the reference delegated to
+torchvision C++ and that pure-XLA tilings fuse worst:
+
+  * :func:`nms_fixed_pallas` — tiled exact greedy NMS, same tile/fixpoint
+    recurrence as `ops/nms_tiled.py::nms_fixed_tiled`; selections are
+    bit-identical (the in-kernel IoU replicates `ops/boxes.py::iou`
+    op-for-op, all elementwise IEEE arithmetic).
+  * :func:`roi_align_pallas` — multilevel ROIAlign forward with the
+    bilinear tent-weight sampling fused in VMEM (the separable-matmul
+    formulation of `ops/roi_ops.py` method="einsum" on the MXU), wrapped
+    in a custom_vjp whose backward falls back to the einsum formulation.
+  * :func:`match_boxes_pallas` / :func:`iou_matrix_pallas` — the dense
+    IoU matrix + row/column argmax matching pass used by RPN and head
+    target assignment, tiled over the anchor axis.
+
+Every kernel takes ``interpret`` (default: interpret unless running on a
+real TPU backend) so the exact same kernel code is parity-tested on CPU
+in tier-1 — the round-5 Pallas NMS removal (git 431e219) was driven by
+the old kernel having no CPU validation path. On-chip (non-interpret)
+compilation must only happen through the warmup ProgramSpec registry
+(`train/warmup.py::build_pallas_program_specs`), never lazily inside a
+train step — the other half of the 431e219 failure mode.
+"""
+
+from replication_faster_rcnn_tpu.ops.pallas.iou_kernel import (  # noqa: F401
+    iou_matrix_pallas,
+    match_boxes_pallas,
+)
+from replication_faster_rcnn_tpu.ops.pallas.nms_kernel import (  # noqa: F401
+    nms_fixed_pallas,
+)
+from replication_faster_rcnn_tpu.ops.pallas.roi_kernel import (  # noqa: F401
+    roi_align_pallas,
+)

@@ -1,0 +1,171 @@
+"""Pallas dense IoU matrix + anchor matching — exact vs the jnp pass.
+
+Target assignment (`targets/anchor_targets.py`, `targets/proposal_targets.py`)
+opens with the same shape of work: a dense ``[N, G]`` IoU matrix against the
+(padded) gt boxes, masked to -1 on padded gt columns, then row argmax/max and
+— for the RPN pass — the per-gt best anchor (column argmax). For 16k+ anchors
+that matrix is the dominant cost of the pass and XLA materializes it through
+HBM; here it is tiled over the anchor axis with the matching reductions fused
+in VMEM, one grid step per anchor tile.
+
+Exactness: the in-kernel IoU replicates `ops/boxes.py::iou` op-for-op
+(elementwise IEEE arithmetic — bitwise equal), row argmax/max use the same
+``jnp.argmax`` / ``jnp.max(jnp.maximum(x, 0.0))`` ops on the same values, and
+the column argmax streams across tiles with a strictly-greater update, which
+reproduces ``jnp.argmax(axis=0)`` first-occurrence tie-breaking exactly
+(padded anchor rows are forced to -1 and sit after all real rows, so they can
+tie but never win). Tier-1 pins all four outputs bitwise
+(tests/test_pallas_iou.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from replication_faster_rcnn_tpu.ops.pallas.nms_kernel import _iou_cols
+
+Array = jnp.ndarray
+
+
+def _match_kernel(
+    z_ref,
+    a_ref,
+    g_ref,
+    m_ref,
+    iou_ref,
+    am_ref,
+    mx_ref,
+    best_ref,
+    bval_ref,
+    *,
+    tile: int,
+    n_rows: int,
+):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        best_ref[...] = jnp.zeros_like(best_ref)
+        bval_ref[...] = jnp.full_like(bval_ref, -jnp.inf)
+
+    g_count = g_ref.shape[1]
+    ious = _iou_cols(a_ref[...], g_ref[...], z_ref[0, 0])  # [tile, G]
+    ious = jnp.where(m_ref[0, :][None, :] != 0, ious, -1.0)  # padded gt cols
+    # padded anchor rows (beyond n_rows) must never win the column argmax;
+    # they sit after every real row, so forcing -1 lets them tie but not beat
+    row_ok = (
+        jax.lax.broadcasted_iota(jnp.int32, (tile, g_count), 0) + i * tile
+    ) < n_rows
+    ious = jnp.where(row_ok, ious, -1.0)
+
+    iou_ref[...] = ious
+    am_ref[0, :] = jnp.argmax(ious, axis=1).astype(jnp.int32)
+    mx_ref[0, :] = jnp.max(jnp.maximum(ious, 0.0), axis=1)
+
+    # streaming column argmax: strictly-greater keeps the earliest row on
+    # ties, matching jnp.argmax(axis=0) first-occurrence semantics
+    col_max = jnp.max(ious, axis=0)  # [G]
+    col_arg = jnp.argmax(ious, axis=0).astype(jnp.int32) + i * tile
+    prev = bval_ref[0, :]
+    beat = col_max > prev
+    bval_ref[0, :] = jnp.where(beat, col_max, prev)
+    best_ref[0, :] = jnp.where(beat, col_arg, best_ref[0, :])
+
+
+@partial(jax.jit, static_argnames=("tile", "interpret", "want_col"))
+def _match_boxes_pallas(
+    boxes: Array,
+    gt_boxes: Array,
+    gt_mask: Array,
+    tile: int,
+    interpret: bool,
+    want_col: bool,
+):
+    n = boxes.shape[0]
+    g = gt_boxes.shape[0]
+    tile = min(tile, max(n, 1))
+    n_tiles = -(-n // tile)
+    pad = n_tiles * tile - n
+
+    coords = jnp.pad(boxes.astype(jnp.float32), ((0, pad), (0, 0))).T  # [4, n_pad]
+    gt_cols = gt_boxes.astype(jnp.float32).T  # [4, G]
+    mask_row = gt_mask.astype(jnp.int32)[None, :]  # [1, G]
+
+    zero = jnp.zeros((1, 1), jnp.float32)  # runtime +0.0, see _iou_cols
+    # keep the pad/transpose producers out of the kernel body's fusion: on
+    # XLA:CPU, fusing them in changes LLVM vectorization of the inlined
+    # (interpret-mode) kernel and can drift the final division by 1 ulp
+    zero, coords, gt_cols, mask_row = jax.lax.optimization_barrier(
+        (zero, coords, gt_cols, mask_row)
+    )
+    ious_p, am_p, mx_p, best_p = pl.pallas_call(
+        partial(_match_kernel, tile=tile, n_rows=n),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((4, tile), lambda i: (0, i)),
+            pl.BlockSpec((4, g), lambda i: (0, 0)),
+            pl.BlockSpec((1, g), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile, g), lambda i: (i, 0)),
+            pl.BlockSpec((1, tile), lambda i: (0, i)),
+            pl.BlockSpec((1, tile), lambda i: (0, i)),
+            pl.BlockSpec((1, g), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_tiles * tile, g), jnp.float32),
+            jax.ShapeDtypeStruct((1, n_tiles * tile), jnp.int32),
+            jax.ShapeDtypeStruct((1, n_tiles * tile), jnp.float32),
+            jax.ShapeDtypeStruct((1, g), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, g), jnp.float32)],
+        interpret=interpret,
+    )(zero, coords, gt_cols, mask_row)
+
+    out = (ious_p[:n], am_p[0, :n], mx_p[0, :n])
+    if want_col:
+        return out + (best_p[0],)
+    return out
+
+
+def _resolve_interpret(interpret: bool | None) -> bool:
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return bool(interpret)
+
+
+def match_boxes_pallas(
+    boxes: Array,
+    gt_boxes: Array,
+    gt_mask: Array,
+    tile: int = 512,
+    interpret: bool | None = None,
+) -> tuple[Array, Array, Array, Array]:
+    """The RPN matching pass: boxes [N, 4], gt [G, 4], gt_mask [G] ->
+    (ious [N, G] masked to -1 on padded gt, argmax [N] int32,
+    max_iou [N] f32, gt_best [G] int32) — all bitwise equal to the jnp
+    formulation in `targets/anchor_targets.py`."""
+    return _match_boxes_pallas(
+        boxes, gt_boxes, gt_mask, tile, _resolve_interpret(interpret), True
+    )
+
+
+def iou_matrix_pallas(
+    boxes: Array,
+    gt_boxes: Array,
+    gt_mask: Array,
+    tile: int = 512,
+    interpret: bool | None = None,
+) -> tuple[Array, Array, Array]:
+    """The head-assignment variant (no column argmax): returns
+    (ious [N, G], argmax [N], max_iou [N]) as in
+    `targets/proposal_targets.py`."""
+    return _match_boxes_pallas(
+        boxes, gt_boxes, gt_mask, tile, _resolve_interpret(interpret), False
+    )

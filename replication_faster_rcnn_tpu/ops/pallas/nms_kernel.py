@@ -1,0 +1,286 @@
+"""Pallas tiled exact greedy NMS — bit-identical to `ops/nms_tiled.py`.
+
+Same algorithm, same recurrence, same arithmetic: candidates are processed
+in descending-score order one TILE per sequential grid step; within a tile
+the greedy keep vector is solved by fixpoint sweeps of
+``g = m0 & ~any(suppress & g[:, None], axis=0)``; selected boxes accumulate
+into a compact ``[4, max_out]`` VMEM buffer that suppresses later tiles in
+one matrix op. The in-kernel IoU replicates `ops/boxes.py::iou` op-for-op
+(maximum/minimum/subtract/multiply/where/divide in the same order), so every
+comparison against ``iou_thresh`` sees bitwise the same float as the XLA
+tiling and the selections are exactly identical — tier-1 pins this
+(tests/test_pallas_nms.py).
+
+The grid is static (``n_tiles`` steps) where the XLA tiling uses a
+while_loop that exits once the buffer fills; a ``count < max_out`` predicate
+skips the per-tile work instead, which appends nothing either way, so
+results match exactly.
+
+Interpret mode (the default off-TPU) runs the kernel as a pure JAX
+interpretation on any backend; on-chip lowering is reserved for the warmup
+ProgramSpec registry (see package docstring).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jnp.ndarray
+
+_NEG = -jnp.inf
+
+
+def _install_barrier_batching_rule() -> None:
+    """Backport the (identity) vmap rule for ``optimization_barrier``.
+
+    jax 0.4.37 has no batching rule for the primitive, so the producer
+    barriers in these wrappers would break `jax.vmap` over the kernels —
+    the batched `targets/anchor_targets.py` path. The barrier is
+    elementwise identity, so the rule is trivial: bind on the batched
+    operands, keep the dims. Newer jax registers exactly this upstream;
+    installing is a no-op there.
+    """
+    try:
+        from jax._src.lax.lax import optimization_barrier_p
+        from jax.interpreters import batching
+    except ImportError:  # pragma: no cover - future jax moves the internals
+        return
+    if optimization_barrier_p in batching.primitive_batchers:
+        return
+
+    def _rule(args, dims):
+        return optimization_barrier_p.bind(*args), list(dims)
+
+    batching.primitive_batchers[optimization_barrier_p] = _rule
+
+
+_install_barrier_batching_rule()
+
+
+def _iou_cols(a: Array, b: Array, zero: Array) -> Array:
+    """`ops/boxes.py::iou` on column-major boxes: a [4, Na], b [4, Nb] ->
+    [Na, Nb]. The elementwise op sequence is identical to the row-major
+    original, so results are bitwise equal — with one subtlety: ``zero``
+    is a RUNTIME +0.0 scalar added to each product. The interpreter
+    inlines the kernel jaxpr into the caller's XLA module, where LLVM
+    codegen FMA-contracts a product into a following add/subtract in some
+    fusion contexts (a 1-ulp drift off strict IEEE; HLO-level bitcast
+    roundtrips are optimized away before codegen, so they can't pin it).
+    Routing each product through ``+ zero`` is bit-exact on every codegen
+    path: left alone it adds +0.0 (identity on the areas/intersection,
+    which are never -0.0 here), and if contracted it becomes
+    ``fma(x, y, 0)`` = ``round(x*y)`` — the strict product — while the
+    remaining add/subtract chain has no multiply left to contract.
+
+    Together with the producer `optimization_barrier` in the wrappers
+    (which keeps pad/transpose producers from fusing into the kernel loop
+    and re-triggering the contraction on the division), this makes the
+    kernels strict-IEEE in every context tested — including ones where
+    XLA:CPU's own compilation of `ops/boxes.py::iou` drifts 1 ulp from
+    strict under heavy producer fusion (tests pin the kernels against a
+    strict numpy oracle as well as the XLA reference)."""
+    tl_r = jnp.maximum(a[0][:, None], b[0][None, :])
+    tl_c = jnp.maximum(a[1][:, None], b[1][None, :])
+    br_r = jnp.minimum(a[2][:, None], b[2][None, :])
+    br_c = jnp.minimum(a[3][:, None], b[3][None, :])
+    wh_r = br_r - tl_r
+    wh_c = br_c - tl_c
+    valid = (wh_r > 0) & (wh_c > 0)
+    inter = jnp.where(valid, wh_r * wh_c, 0.0) + zero
+    area_a = (a[2] - a[0]) * (a[3] - a[1]) + zero
+    area_b = (b[2] - b[0]) * (b[3] - b[1]) + zero
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.where(union > 0, union, 1.0), 0.0)
+
+
+def _nms_kernel(
+    thresh_ref,
+    zero_ref,
+    coords_ref,
+    scores_ref,
+    order_ref,
+    idx_ref,
+    valid_ref,
+    selbox_ref,
+    count_ref,
+    *,
+    tile: int,
+    max_out: int,
+):
+    i = pl.program_id(0)
+    n_tiles = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _init():
+        count_ref[0] = 0
+        idx_ref[...] = jnp.zeros_like(idx_ref)
+        valid_ref[...] = jnp.zeros_like(valid_ref)
+        selbox_ref[...] = jnp.zeros_like(selbox_ref)
+
+    count = count_ref[0]
+
+    @pl.when(count < max_out)
+    def _tile_step():
+        thresh = thresh_ref[0, 0]
+        zero = zero_ref[0, 0]
+        tb = coords_ref[...]  # [4, tile] column-major boxes
+        ts = scores_ref[0, :]  # [tile]
+        ti = order_ref[0, :]  # [tile] original indices
+        tv = ts > _NEG
+        sel = selbox_ref[...]  # [4, max_out]
+
+        # cross-tile: suppressed by any already-selected box (one matrix op)
+        cross = _iou_cols(sel, tb, zero) > thresh  # [max_out, tile]
+        kmask = jax.lax.broadcasted_iota(jnp.int32, (max_out, tile), 0) < count
+        m0 = tv & ~jnp.any(cross & kmask, axis=0)
+
+        # in-tile greedy via fixpoint sweeps (exact; see nms_tiled docstring)
+        later = (
+            jax.lax.broadcasted_iota(jnp.int32, (tile, tile), 0)
+            < jax.lax.broadcasted_iota(jnp.int32, (tile, tile), 1)
+        )  # a before b
+        suppress = (_iou_cols(tb, tb, zero) > thresh) & later
+
+        def sweep_cond(gs):
+            _, stable = gs
+            return ~stable
+
+        def sweep_body(gs):
+            g, _ = gs
+            g2 = m0 & ~jnp.any(suppress & g[:, None], axis=0)
+            return g2, jnp.all(g2 == g)
+
+        g, _ = jax.lax.while_loop(
+            sweep_cond, sweep_body, (m0, jnp.array(False, dtype=bool))
+        )
+
+        # append this tile's selections in order; the scatter of the XLA
+        # tiling (`at[slot].set(mode="drop")`) becomes a one-hot
+        # gather-free write: each output slot takes at most one candidate
+        pos = count + jnp.cumsum(g) - 1  # [tile] target slot per kept box
+        slots = jax.lax.broadcasted_iota(jnp.int32, (max_out, tile), 0)
+        onehot = g[None, :] & (slots == pos[None, :]) & (pos[None, :] < max_out)
+        taken = jnp.any(onehot, axis=1)  # [max_out]
+        new_box = jnp.sum(jnp.where(onehot[None, :, :], tb[:, None, :], 0.0), axis=2)
+        new_idx = jnp.sum(jnp.where(onehot, ti[None, :], 0), axis=1)
+        selbox_ref[...] = jnp.where(taken[None, :], new_box, sel)
+        idx_ref[0, :] = jnp.where(taken, new_idx, idx_ref[0, :]).astype(jnp.int32)
+        count_ref[0] = jnp.minimum(count + jnp.sum(g), max_out).astype(jnp.int32)
+
+    @pl.when(i == n_tiles - 1)
+    def _finalize():
+        final = count_ref[0]
+        valid_ref[...] = (
+            jax.lax.broadcasted_iota(jnp.int32, (1, max_out), 1) < final
+        ).astype(jnp.int32)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_out", "tile", "assume_sorted", "interpret"),
+)
+def _nms_fixed_pallas(
+    boxes: Array,
+    scores: Array,
+    iou_thresh: Array,
+    max_out: int,
+    mask: Array | None,
+    tile: int,
+    assume_sorted: bool,
+    interpret: bool,
+) -> tuple[Array, Array]:
+    # ---- prep: identical to nms_fixed_tiled ----
+    n = boxes.shape[0]
+    tile = min(tile, max(n, 1))
+    s = scores.astype(jnp.float32)
+    s = jnp.where(jnp.isfinite(s), s, _NEG)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG)
+
+    n_tiles = -(-n // tile)
+    n_pad = n_tiles * tile
+    pad = n_pad - n
+    if assume_sorted:
+        order_p = jnp.pad(jnp.arange(n, dtype=jnp.int32), (0, pad))
+        s_sorted = jnp.pad(s, (0, pad), constant_values=_NEG)
+        b_sorted = jnp.pad(boxes.astype(jnp.float32), ((0, pad), (0, 0)))
+    else:
+        order = jnp.argsort(-s)
+        order_p = jnp.pad(order, (0, pad)).astype(jnp.int32)
+        s_sorted = jnp.pad(s[order], (0, pad), constant_values=_NEG)
+        b_sorted = jnp.pad(boxes.astype(jnp.float32)[order], ((0, pad), (0, 0)))
+
+    thresh = jnp.full((1, 1), iou_thresh, jnp.float32)
+    zero = jnp.zeros((1, 1), jnp.float32)  # runtime +0.0, see _iou_cols
+    coords = b_sorted.T  # [4, n_pad] — lane-major for the kernel
+    s_row = s_sorted[None, :]
+    o_row = order_p[None, :]
+    # producer barrier: keep the sort/pad/transpose prep from fusing into
+    # the inlined kernel body on CPU, where it perturbs LLVM vectorization
+    # of the IoU arithmetic (see _iou_cols docstring)
+    thresh, zero, coords, s_row, o_row = jax.lax.optimization_barrier(
+        (thresh, zero, coords, s_row, o_row)
+    )
+
+    idx_row, valid_row = pl.pallas_call(
+        partial(_nms_kernel, tile=tile, max_out=max_out),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((4, tile), lambda i: (0, i)),
+            pl.BlockSpec((1, tile), lambda i: (0, i)),
+            pl.BlockSpec((1, tile), lambda i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, max_out), lambda i: (0, 0)),
+            pl.BlockSpec((1, max_out), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, max_out), jnp.int32),
+            jax.ShapeDtypeStruct((1, max_out), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((4, max_out), jnp.float32),
+            pltpu.SMEM((1,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(thresh, zero, coords, s_row, o_row)
+
+    valid = valid_row[0].astype(bool)
+    return jnp.where(valid, idx_row[0], 0), valid
+
+
+def nms_fixed_pallas(
+    boxes: Array,
+    scores: Array,
+    iou_thresh: float,
+    max_out: int,
+    mask: Array | None = None,
+    tile: int = 512,
+    assume_sorted: bool = False,
+    interpret: bool | None = None,
+) -> tuple[Array, Array]:
+    """Drop-in replacement for :func:`ops.nms_tiled.nms_fixed_tiled`
+    (same contract, bit-identical selections).
+
+    ``interpret=None`` resolves to interpret mode unless the default JAX
+    backend is a real TPU — the CPU tier-1 path always interprets.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _nms_fixed_pallas(
+        boxes,
+        scores,
+        jnp.asarray(iou_thresh, jnp.float32),
+        max_out,
+        mask,
+        tile,
+        assume_sorted,
+        bool(interpret),
+    )

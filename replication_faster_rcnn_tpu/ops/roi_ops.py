@@ -118,7 +118,20 @@ def roi_align(
     matmuls on the MXU, no gathers (each weight row has <= 2 nonzeros, but
     dense-matmul beats random HBM access on TPU for detection-sized maps).
     ``method="gather"``: the direct 4-corner gather implementation.
+    ``method="pallas"``: the fused `ops/pallas/roi_kernel.py` forward
+    (same einsum formulation inside one kernel; tolerance-gated parity —
+    see tests/test_pallas_roi.py), einsum VJP for the backward.
     """
+    if method == "pallas":
+        from replication_faster_rcnn_tpu import ops as ops_pkg
+        from replication_faster_rcnn_tpu.ops.pallas import roi_align_pallas
+
+        # the kernel wrapper applies spatial_scale itself — delegate before
+        # the shared pre-scaling below
+        return roi_align_pallas(
+            feat, rois, out_size, sampling_ratio, spatial_scale,
+            interpret=ops_pkg.interpret_mode(),
+        )
     rois = rois * spatial_scale
     s = sampling_ratio
     rr, cc = _sample_grid(rois, out_size, s, feat.dtype)
@@ -209,9 +222,20 @@ def extract_roi_features(
     sampling_ratio: int = 2,
     spatial_scale: float = 1.0,
 ) -> Array:
-    """Dispatch between ROIAlign and ROIPool by config string."""
+    """Dispatch between ROIAlign and ROIPool by config string.
+
+    ROIAlign additionally honors the `ops.backend` axis: backend=pallas
+    routes to the fused kernel forward (XLA einsum VJP for the backward),
+    backend=xla (default) keeps the einsum formulation byte-identical to
+    the committed fingerprints.
+    """
     if op == "align":
-        return roi_align(feat, rois, out_size, sampling_ratio, spatial_scale)
+        from replication_faster_rcnn_tpu import ops as ops_pkg
+
+        method = "pallas" if ops_pkg.want_pallas("roi_align") else "einsum"
+        return roi_align(
+            feat, rois, out_size, sampling_ratio, spatial_scale, method=method
+        )
     if op == "pool":
         return roi_pool(feat, rois, out_size, spatial_scale)
     raise ValueError(f"unknown roi op {op!r}")

@@ -228,8 +228,14 @@ class InferenceEngine:
             spec = self._specs[name]
             with tspans.current_tracer().span(f"compile/{name}", cat="compile"):
                 t0 = time.perf_counter()
-                jitted, args = spec.build()
-                prog = jitted.lower(*args).compile()
+                # trace under the config's resolved ops backend so an
+                # ops.backend=pallas deployment serves the pallas kernels
+                # (and hits the warmup registry's compile cache entries)
+                from replication_faster_rcnn_tpu import ops as ops_pkg
+
+                with ops_pkg.backend_scope(ops_pkg.resolve_backend(self.config)):
+                    jitted, args = spec.build()
+                    prog = jitted.lower(*args).compile()
                 self.compile_seconds[name] = round(time.perf_counter() - t0, 3)
             self._programs[name] = prog
             return prog

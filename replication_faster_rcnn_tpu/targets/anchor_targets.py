@@ -53,15 +53,27 @@ def anchor_targets(
     a = anchors.shape[0]
     has_gt = jnp.any(gt_mask)
 
-    ious = box_ops.iou(anchors, gt_boxes)  # [A, G]
-    ious = jnp.where(gt_mask[None, :], ious, -1.0)  # never match padded gt
+    from replication_faster_rcnn_tpu import ops as ops_pkg
 
-    argmax = jnp.argmax(ious, axis=1)  # [A] best gt per anchor
-    max_iou = jnp.max(jnp.maximum(ious, 0.0), axis=1)  # [A]
+    if ops_pkg.want_pallas("anchor_match"):
+        # the fused matching kernel: same ious/argmax/max/column-argmax as
+        # the jnp lines below (tests/test_pallas_iou.py pins all four)
+        from replication_faster_rcnn_tpu.ops.pallas import match_boxes_pallas
 
-    # Force-positive each gt's best anchor and redirect its match to that gt
-    # (`utils/utils.py:169-173`). Padded gts scatter to a dummy row.
-    gt_best_anchor = jnp.argmax(ious, axis=0)  # [G]
+        ious, argmax, max_iou, gt_best_anchor = match_boxes_pallas(
+            anchors, gt_boxes, gt_mask, interpret=ops_pkg.interpret_mode()
+        )
+    else:
+        ious = box_ops.iou(anchors, gt_boxes)  # [A, G]
+        ious = jnp.where(gt_mask[None, :], ious, -1.0)  # never match padded gt
+
+        argmax = jnp.argmax(ious, axis=1)  # [A] best gt per anchor
+        max_iou = jnp.max(jnp.maximum(ious, 0.0), axis=1)  # [A]
+
+        # Force-positive each gt's best anchor and redirect its match to
+        # that gt (`utils/utils.py:169-173`).
+        gt_best_anchor = jnp.argmax(ious, axis=0)  # [G]
+
     scatter_rows = jnp.where(gt_mask, gt_best_anchor, a)  # a = dropped
     argmax = argmax.at[scatter_rows].set(
         jnp.arange(gt_boxes.shape[0], dtype=jnp.int32), mode="drop"

@@ -64,10 +64,21 @@ def proposal_targets(
     cand = jnp.concatenate([rois, gt_boxes], axis=0)  # [R+G, 4]
     cand_valid = jnp.concatenate([roi_valid, gt_mask], axis=0)
 
-    ious = box_ops.iou(cand, gt_boxes)  # [R+G, G]
-    ious = jnp.where(gt_mask[None, :], ious, -1.0)
-    assignment = jnp.argmax(ious, axis=1)
-    max_iou = jnp.max(jnp.maximum(ious, 0.0), axis=1)
+    from replication_faster_rcnn_tpu import ops as ops_pkg
+
+    if ops_pkg.want_pallas("proposal_match"):
+        # fused IoU + row reductions (no column argmax needed here); same
+        # values as the jnp lines below (tests/test_pallas_iou.py)
+        from replication_faster_rcnn_tpu.ops.pallas import iou_matrix_pallas
+
+        ious, assignment, max_iou = iou_matrix_pallas(
+            cand, gt_boxes, gt_mask, interpret=ops_pkg.interpret_mode()
+        )
+    else:
+        ious = box_ops.iou(cand, gt_boxes)  # [R+G, G]
+        ious = jnp.where(gt_mask[None, :], ious, -1.0)
+        assignment = jnp.argmax(ious, axis=1)
+        max_iou = jnp.max(jnp.maximum(ious, 0.0), axis=1)
     max_iou = jnp.where(cand_valid, max_iou, -1.0)  # padded rois match nothing
 
     is_pos = cand_valid & (max_iou >= cfg.pos_iou_thresh)

@@ -354,6 +354,19 @@ class Trainer:
                     ),
                     multi_plan,
                 )
+        # ops.backend=pallas: pin the backend scope around every trace of
+        # the step programs (jit is lazy — without this the first dispatch
+        # would trace the default XLA ops; see train/warmup.py). xla
+        # configs get the jit objects back unchanged.
+        from replication_faster_rcnn_tpu import ops as ops_pkg
+        from replication_faster_rcnn_tpu.train.warmup import scope_jitted
+
+        if ops_pkg.resolve_backend(config) == "pallas":
+            self.jitted_step = scope_jitted(self.jitted_step, config)
+            if self.jitted_multi_step is not None:
+                self.jitted_multi_step = scope_jitted(
+                    self.jitted_multi_step, config
+                )
         # runtime hygiene gate (debug.strict / --strict): transfer guard +
         # recompile detector around every dispatch, armed after warmup
         self.strict = None

@@ -161,6 +161,61 @@ def program_name(feed: str, k: int) -> str:
     return "eval_infer" if feed == "eval" else f"train_{feed}_k{k}"
 
 
+PALLAS_TWIN_SUFFIX = "__pallas"
+
+
+def pallas_program_name(base: str) -> str:
+    """Registry name of the ops.backend=pallas twin of a base program."""
+    return base + PALLAS_TWIN_SUFFIX
+
+
+class _ScopedLower:
+    """Proxy a jitted callable so tracing happens under a pinned
+    `ops.backend_scope`.
+
+    jit is lazy: the ops-dispatch decisions (`ops.want_pallas`) run at
+    TRACE time, which for a ProgramSpec is inside ``.lower()`` and for the
+    Trainer is the first real dispatch. Wrapping the callable — instead of
+    asking every call site to remember the scope — guarantees a program
+    never half-resolves across backends, and that a pallas-backend program
+    is only ever built through this registry (the 431e219 lesson: no lazy
+    in-train-step pallas compiles). Everything else (`_clear_cache`, cache
+    probes) passes through to the wrapped callable.
+    """
+
+    def __init__(self, jitted, backend: str):
+        self._jitted = jitted
+        self._backend = backend
+
+    def lower(self, *args, **kwargs):
+        from replication_faster_rcnn_tpu import ops as ops_pkg
+
+        with ops_pkg.backend_scope(self._backend):
+            return self._jitted.lower(*args, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        from replication_faster_rcnn_tpu import ops as ops_pkg
+
+        with ops_pkg.backend_scope(self._backend):
+            return self._jitted(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._jitted, name)
+
+
+def scope_jitted(jitted, config=None, backend: Optional[str] = None):
+    """Wrap ``jitted`` so it traces under the config's resolved ops
+    backend. Returns the callable unchanged for backend=xla — the default
+    path must stay the exact jit object (and HLO) it always was."""
+    if backend is None:
+        from replication_faster_rcnn_tpu import ops as ops_pkg
+
+        backend = ops_pkg.resolve_backend(config)
+    if backend == "xla":
+        return jitted
+    return _ScopedLower(jitted, backend)
+
+
 def serve_program_name(h: int, w: int, batch: int) -> str:
     """Canonical name of one serving bucket program."""
     return f"serve_{h}x{w}_b{batch}"
@@ -560,6 +615,74 @@ def build_program_specs(
     return specs
 
 
+def pallas_twin_base_names(config: FasterRCNNConfig) -> Tuple[str, ...]:
+    """The base programs that get an ops.backend=pallas twin in the audit
+    registry: the canonical k=1 loader train step, the eval inference
+    program, and one serving bucket (full-size resolution, batch 1) —
+    one program per dispatch seam (targets matching + proposal NMS in the
+    train step; NMS + ROIAlign in the inference programs) without
+    doubling the whole (feed × K × bucket) matrix.
+    """
+    buckets = config.serving.bucket_resolutions(config.data.image_size)
+    h, w = buckets[-1]  # largest-area bucket = the full-size program
+    b = min(config.serving.batch_sizes)
+    return (
+        program_name("loader", 1),
+        "eval_infer",
+        serve_program_name(h, w, b),
+    )
+
+
+def build_pallas_program_specs(
+    config: FasterRCNNConfig,
+) -> Dict[str, ProgramSpec]:
+    """{twin_name: ProgramSpec} for the ops.backend=pallas twin programs.
+
+    Each twin is the SAME ProgramSpec as its base — same jit wrapping,
+    same abstract inputs — built and lowered under
+    ``ops.backend_scope("pallas")`` via :class:`_ScopedLower`, so the ops
+    dispatch sites resolve to the `ops/pallas/` kernels at trace time.
+    Twin meta records ``ops_backend``/``pallas_interpret``/``twin`` for
+    the fingerprint bank and the HX007 hlolint rule; off-TPU the kernels
+    lower in interpret mode (plain StableHLO loops, no custom-call), on a
+    real TPU they lower through Mosaic custom-calls.
+    """
+    from replication_faster_rcnn_tpu import ops as ops_pkg
+
+    base_specs = {
+        **build_program_specs(
+            config, feeds=("loader",), ks=(1,), include_eval=True
+        ),
+        **build_serving_specs(config),
+    }
+    interpret = ops_pkg.interpret_mode()
+    specs: Dict[str, ProgramSpec] = {}
+    for base_name in pallas_twin_base_names(config):
+        base = base_specs[base_name]
+        name = pallas_program_name(base_name)
+
+        def _build(b=base):
+            from replication_faster_rcnn_tpu import ops as ops_pkg
+
+            with ops_pkg.backend_scope("pallas"):
+                jitted, args = b.build()
+            return _ScopedLower(jitted, "pallas"), args
+
+        meta = dict(base.meta)
+        meta.update(
+            ops_backend="pallas", pallas_interpret=interpret, twin=base_name
+        )
+        specs[name] = ProgramSpec(
+            name=name,
+            feed=base.feed,
+            k=base.k,
+            arg_roles=base.arg_roles,
+            build=_build,
+            meta=meta,
+        )
+    return specs
+
+
 def warmup_compile(
     config: FasterRCNNConfig,
     include_eval: bool = True,
@@ -611,11 +734,19 @@ def warmup_compile(
     # report under the registry's canonical feed-qualified names
     # (train_<feed>_k<K> / eval_infer / serve_<HxW>_b<N>) — the same keys
     # `frcnn audit` banks, so the two reports line up program-for-program
+    from replication_faster_rcnn_tpu import ops as ops_pkg
+
+    # the config's resolved ops backend pins every program here: for
+    # backend=pallas this AOT pass (plus the persistent cache) is the ONLY
+    # sanctioned route to an on-chip pallas compile — the trainer and the
+    # serving engine trace under the same scope and hit the cache
+    backend = ops_pkg.resolve_backend(config)
     times: Dict[str, float] = {}
     for spec in specs.values():
         with tracer.span(f"compile/{spec.name}", cat="compile"):
             t0 = time.perf_counter()
-            jitted, args = spec.build()
-            jitted.lower(*args).compile()
+            with ops_pkg.backend_scope(backend):
+                jitted, args = spec.build()
+                jitted.lower(*args).compile()
             times[spec.name] = round(time.perf_counter() - t0, 3)
     return times

@@ -29,6 +29,12 @@ jax.config.update("jax_default_matmul_precision", "highest")
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running test")
+    config.addinivalue_line(
+        "markers",
+        "pallas_interpret: ops/pallas kernel parity under interpret mode "
+        "(tier 1 — runs on CPU without a chip; `-m pallas_interpret` "
+        "selects just the kernel gates)",
+    )
 
 
 def pytest_sessionstart(session):

@@ -1,5 +1,5 @@
 """HLO program auditor (ISSUE 6 tentpole): fingerprint parsing, the
-HX001-HX006 contract rules, bank round-trips, and the tier-1 audit gate.
+HX001-HX007 contract rules, bank round-trips, and the tier-1 audit gate.
 
 Two tiers inside this file:
 
@@ -166,6 +166,24 @@ class TestParsing:
         assert fp_mod.contains_f64("%0 = tensor<4xf64>")
         assert fp_mod.contains_f64("(tensor<f64>) -> tensor<f64>")
         assert not fp_mod.contains_f64("tensor<4xf32> tensor<bf16>")
+
+    def test_custom_calls_both_print_forms(self):
+        text = (
+            '%0 = stablehlo.custom_call @tpu_custom_call(%arg0) : ...\n'
+            '%1 = stablehlo.custom_call @tpu_custom_call(%0) : ...\n'
+            '%2 = "stablehlo.custom_call"(%1) <{api_version = 2 : i32, '
+            'call_target_name = "Sharding"}> : ...\n'
+        )
+        assert fp_mod.parse_custom_calls(text) == {
+            "Sharding": 1,
+            "tpu_custom_call": 2,
+        }
+        assert fp_mod.parse_custom_calls(STABLEHLO_SPMD) == {}
+
+    def test_module_hash_is_short_stable_and_content_sensitive(self):
+        h = fp_mod.module_hash(STABLEHLO_SPMD)
+        assert len(h) == 16 and h == fp_mod.module_hash(STABLEHLO_SPMD)
+        assert h != fp_mod.module_hash(STABLEHLO_SPMD + " ")
 
     def test_memory_stats_peak_math(self):
         class FakeMA:
@@ -413,6 +431,84 @@ class TestContracts:
         assert (
             hlolint.check_contracts({"p": _fp(memory=None)}, _cfg(), 1) == []
         )
+
+
+def _twin_pair(twin_over=None, base_over=None):
+    """A clean (base, __pallas twin) fingerprint pair (eval feed: no
+    aliasing/collective expectations to trip)."""
+    base = _fp(
+        program="eval_infer", feed="eval", params={"variables": [0, 4]},
+        aliasing=[], collectives={}, custom_calls={}, module_hash="a" * 16,
+        meta={},
+    )
+    base.update(base_over or {})
+    twin = dict(
+        base,
+        program="eval_infer__pallas",
+        custom_calls={},
+        module_hash="b" * 16,
+        meta={
+            "ops_backend": "pallas",
+            "pallas_interpret": True,
+            "twin": "eval_infer",
+        },
+    )
+    twin.update(twin_over or {})
+    return {"eval_infer": base, "eval_infer__pallas": twin}
+
+
+class TestHX007OpsBackend:
+    def test_clean_interpret_twin_passes(self):
+        assert hlolint.check_contracts(_twin_pair(), _cfg(), BUDGET) == []
+
+    def test_pallas_custom_call_in_xla_program(self):
+        fps = _twin_pair(base_over={"custom_calls": {"tpu_custom_call": 2}})
+        [v] = hlolint.check_contracts(fps, _cfg(), BUDGET)
+        assert v.rule == "HX007" and v.program == "eval_infer"
+        assert "leaked" in v.message
+
+    def test_interpret_twin_must_differ_from_base(self):
+        fps = _twin_pair(twin_over={"module_hash": "a" * 16})
+        [v] = hlolint.check_contracts(fps, _cfg(), BUDGET)
+        assert v.rule == "HX007" and v.program == "eval_infer__pallas"
+        assert "byte-identical" in v.message
+
+    def test_interpret_twin_skips_hash_check_without_base(self):
+        fps = _twin_pair(twin_over={"module_hash": "a" * 16})
+        del fps["eval_infer"]
+        assert hlolint.check_contracts(fps, _cfg(), BUDGET) == []
+
+    def test_compiled_twin_requires_pallas_custom_call(self):
+        fps = _twin_pair(
+            twin_over={"meta": {
+                "ops_backend": "pallas",
+                "pallas_interpret": False,
+                "twin": "eval_infer",
+            }}
+        )
+        [v] = hlolint.check_contracts(fps, _cfg(), BUDGET)
+        assert v.rule == "HX007" and "real accelerator" in v.message
+
+    def test_compiled_twin_with_mosaic_call_passes(self):
+        fps = _twin_pair(
+            twin_over={
+                "custom_calls": {"tpu_custom_call": 1},
+                "meta": {
+                    "ops_backend": "pallas",
+                    "pallas_interpret": False,
+                    "twin": "eval_infer",
+                },
+            }
+        )
+        assert hlolint.check_contracts(fps, _cfg(), BUDGET) == []
+
+    def test_records_without_custom_calls_field_skip_the_rule(self):
+        # banked records from before ISSUE 13 carry no custom_calls —
+        # the rule must not fire on them (mirrors the mp-rule skip)
+        fps = _twin_pair()
+        for fp in fps.values():
+            fp.pop("custom_calls")
+        assert hlolint.check_contracts(fps, _cfg(), BUDGET) == []
 
 
 class TestDriftRules:

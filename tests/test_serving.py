@@ -342,8 +342,15 @@ class TestServingSpecs:
         full = set(
             hlolint.expected_program_names(config=hlolint.audit_config())
         )
-        serving = {n for n in full - base if n.startswith("serve_")}
-        assert len(serving) == 4 and serving == full - base
+        extra = full - base
+        serving = {n for n in extra if n.startswith("serve_")}
+        # 4 bucket-matrix programs + the serve pallas twin (ISSUE 13)
+        assert len(serving) == 5 and "serve_64x64_b1__pallas" in serving
+        # the only other config-dependent names are the remaining twins
+        assert extra - serving == {
+            "train_loader_k1__pallas",
+            "eval_infer__pallas",
+        }
 
 
 # ------------------------------------------------- serving_profile harness

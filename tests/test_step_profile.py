@@ -97,6 +97,69 @@ class TestRegressionGate:
         assert fails == []
 
 
+def _ops_rec(**over):
+    base = {
+        "schema": sp.OPS_SCHEMA,
+        "ops": {
+            op: {
+                "xla": {"mean_ms": 1.0, "executed": "xla"},
+                "pallas": {"mean_ms": 1.0, "executed": "pallas_interpret"},
+            }
+            for op in ("nms", "roi_align", "iou_match")
+        },
+    }
+    base.update(over)
+    return base
+
+
+class TestOpsProfileRecord:
+    """The ops_profile/v1 structural gate (ISSUE 13): the matrix must
+    keep both backends per op, and a pallas row that silently executed
+    xla (kernel import rot) fails like a regression. Timings are never
+    compared — the pallas rows are interpret-mode on CPU."""
+
+    def test_clean_record_passes(self):
+        assert sp.check_ops_record(_ops_rec(), _ops_rec()) == []
+
+    def test_timing_drift_is_not_a_failure(self):
+        cur = _ops_rec()
+        cur["ops"]["nms"]["pallas"]["mean_ms"] = 999.0
+        assert sp.check_ops_record(cur, _ops_rec()) == []
+
+    def test_pallas_row_fallen_back_to_xla_fails(self):
+        cur = _ops_rec()
+        cur["ops"]["nms"]["pallas"]["executed"] = "xla"
+        [fail] = sp.check_ops_record(cur, _ops_rec())
+        assert "fell back" in fail
+
+    def test_ops_matrix_change_fails(self):
+        cur = _ops_rec()
+        del cur["ops"]["iou_match"]
+        [fail] = sp.check_ops_record(cur, _ops_rec())
+        assert "matrix changed" in fail
+
+    def test_unknown_schema_fails(self):
+        [fail] = sp.check_ops_record(_ops_rec(), _ops_rec(schema="nope"))
+        assert "schema" in fail
+
+    def test_committed_ops_record_shape(self):
+        paths = glob.glob(
+            os.path.join(_REPO, "benchmarks", "records", "ops_profile_*.json")
+        )
+        assert paths, "no committed ops_profile record (ISSUE 13 acceptance)"
+        for path in paths:
+            with open(path) as f:
+                rec = json.load(f)
+            assert rec["schema"] == sp.OPS_SCHEMA, path
+            assert sorted(rec["ops"]) == ["iou_match", "nms", "roi_align"]
+            for op, row in rec["ops"].items():
+                for backend in ("xla", "pallas"):
+                    assert row[backend]["mean_ms"] > 0, (path, op, backend)
+                assert row["pallas"]["executed"].startswith("pallas"), (
+                    path, op,
+                )
+
+
 class TestCommittedRecords:
     def test_committed_records_carry_mfu_and_phases(self):
         """Every committed step-profile record must have the PR-2
