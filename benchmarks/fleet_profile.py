@@ -1,0 +1,439 @@
+"""Fleet availability benchmark + regression gate.
+
+Prices the tentpole claim of the serving fleet (serving/fleet/): a
+health-checked router with failover, circuit breakers and hedging keeps
+serving through a replica death — availability >= 99.9% over a load run
+with a seeded mid-run replica kill — while aggregating replica capacity
+(>= 2x a single replica's throughput, the fleet acceptance floor).
+
+Replicas are simulated single-slot services: each models its capacity
+with a virtual busy-until queue (arrival waits for the slot, then
+sleeps the service time OUTSIDE any lock), so one replica tops out at
+~1/service_time regardless of client concurrency and N replicas
+genuinely aggregate — sleeps release the GIL, which is what makes the
+>=2x gate measurable on the single-core CI host where the real engine
+could never show fleet parallelism.  Everything above the client is the
+production stack: ReplicaRegistry + Prober (lease staleness),
+FleetRouter (consistent hashing, breakers, failover, hedging), and
+serving/loadgen.py's fleet loop.
+
+Measured legs:
+  * single   — closed loop against a 1-replica fleet: the baseline
+    capacity one replica offers.
+  * fleet    — the same load over 3 replicas with a seeded
+    ``router.dispatch`` drop at ~3/4 of the run: the router's kill hook
+    makes the selected replica actually die, failover + breakers absorb
+    it, and after the run the prober must notice the death (lease
+    expiry -> dead) and re-admit the revived replica (rejoin probes) —
+    the full self-healing loop, asserted structurally.
+  * hedge    — a fast/slow replica pair under tight hedge clamps: the
+    p99-derived hedge must fire and win at least once (tail tolerance
+    failover alone cannot see).
+
+Banked under benchmarks/records/ (step_profile.py conventions: atomic
+save, --update to re-bank, --no-check to just measure). The gate fails
+(exit 1) when availability drops below --min-availability (0.999),
+fleet/single speedup falls below --min-speedup (2.0), the self-healing
+structure breaks (no kill, no failover, no death detection, no rejoin,
+no hedge win), or fleet throughput regresses >tol vs the banked record.
+
+Usage:
+  python benchmarks/fleet_profile.py            # measure + gate
+  python benchmarks/fleet_profile.py --update   # re-bank
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+RECORDS_DIR = os.path.join(_REPO, "benchmarks", "records")
+SCHEMA = "fleet_profile/v1"
+DEFAULT_TOL = 0.25  # sleep-paced throughput is steadier than compute,
+#                     but the CI host still jitters thread wakeups
+DEFAULT_MIN_SPEEDUP = 2.0
+DEFAULT_MIN_AVAILABILITY = 0.999
+# the gate: fleet capacity through the kill
+GATE_KEY = "fleet_images_per_sec"
+# the benchmark is pure host threading — no accelerator in the loop —
+# so records are keyed by a constant platform token
+PLATFORM = "sim"
+
+
+def record_key(config_token: str, platform: str = PLATFORM) -> str:
+    return f"{config_token}_{platform}"
+
+
+def record_path(key: str, records_dir: str = RECORDS_DIR) -> str:
+    return os.path.join(records_dir, f"fleet_profile_{key}.json")
+
+
+def load_record(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_record(record, path: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def check_regression(
+    current,
+    banked,
+    tol: float = DEFAULT_TOL,
+    min_speedup: float = DEFAULT_MIN_SPEEDUP,
+    min_availability: float = DEFAULT_MIN_AVAILABILITY,
+):
+    """(failures, warnings) — pure, unit-testable.  Failures: the
+    availability floor, the fleet/single speedup floor, any broken
+    self-healing structure, or fleet capacity >tol below the banked
+    record."""
+    failures, warnings = [], []
+    if banked is not None and banked.get("schema") != SCHEMA:
+        warnings.append(
+            f"banked record has schema {banked.get('schema')!r}, expected "
+            f"{SCHEMA!r}; skipping comparison"
+        )
+        banked = None
+    if banked is not None:
+        old = banked.get(GATE_KEY)
+        new = current.get(GATE_KEY)
+        if old and new:
+            drop = 1.0 - new / old
+            if drop > tol:
+                failures.append(
+                    f"{GATE_KEY} regressed {drop:+.1%}: {new:.3f} vs banked "
+                    f"{old:.3f} (tolerance {tol:.0%})"
+                )
+            elif drop > tol / 2:
+                warnings.append(
+                    f"{GATE_KEY} within tolerance but slipping {drop:+.1%}: "
+                    f"{new:.3f} vs banked {old:.3f}"
+                )
+
+    availability = current.get("availability")
+    if availability is not None and availability < min_availability:
+        failures.append(
+            f"availability {availability:.4%} below the "
+            f"{min_availability:.2%} floor through the replica kill "
+            f"({current.get('fleet', {}).get('errors')} failed, "
+            f"{current.get('fleet', {}).get('n_requests')} offered)"
+        )
+    speedup = current.get("speedup")
+    if speedup is not None and speedup < min_speedup:
+        failures.append(
+            f"fleet/single speedup {speedup:.2f}x below the "
+            f"{min_speedup:.1f}x acceptance floor (fleet "
+            f"{current.get(GATE_KEY)} img/s vs single "
+            f"{current.get('single_images_per_sec')} img/s)"
+        )
+    # the self-healing structure: each False is a dead subsystem even
+    # when the headline numbers survive
+    for key, what in (
+        ("victim_killed", "the seeded router.dispatch drop never killed "
+                          "a replica"),
+        ("victim_dead_after_run", "the prober never lease-expired the "
+                                  "killed replica"),
+        ("victim_rejoined", "the revived replica never re-entered "
+                            "rotation"),
+    ):
+        if current.get(key) is False:
+            failures.append(f"{key}: {what}")
+    if current.get("failovers", 0) < 1:
+        failures.append(
+            "no failover recorded — the kill was not absorbed by "
+            "re-dispatch"
+        )
+    hedge = current.get("hedge") or {}
+    if hedge and hedge.get("hedge_wins", 0) < 1:
+        failures.append(
+            "hedge leg recorded no hedge win against the slow replica"
+        )
+    return failures, warnings
+
+
+# ---------------------------------------------------------------------------
+# simulated replicas
+
+
+def make_sim_replica(replica_id: str, service_s: float):
+    """A single-slot replica: capacity 1/service_s regardless of caller
+    concurrency.  The slot is a virtual busy-until queue — arrival
+    reserves the next free interval under the lock, then sleeps out its
+    own completion time outside it (never sleep while holding a lock)."""
+    from replication_faster_rcnn_tpu.serving.fleet.client import (
+        LocalReplicaClient,
+    )
+
+    lock = threading.Lock()
+    busy_until = [0.0]
+
+    def predict(payload):
+        with lock:
+            start = max(time.monotonic(), busy_until[0])
+            done = start + service_s
+            busy_until[0] = done
+        delay = done - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        return {"replica": replica_id, "payload": payload}
+
+    return LocalReplicaClient(replica_id, predict)
+
+
+def build_fleet(clients, cfg):
+    """(registry, prober, router) over ``clients`` — replicas are
+    probed into rotation before the router sees traffic."""
+    from replication_faster_rcnn_tpu.serving.fleet.registry import (
+        Prober,
+        ReplicaRegistry,
+    )
+    from replication_faster_rcnn_tpu.serving.fleet.router import FleetRouter
+
+    registry = ReplicaRegistry(cfg)
+    for rid, client in clients.items():
+        registry.add(rid, client)
+    for _ in range(cfg.rejoin_probes):  # admit synchronously
+        registry.probe_once()
+    router = FleetRouter(
+        registry, cfg, kill_hook=lambda rid: clients[rid].kill()
+    )
+    prober = Prober(registry, interval_s=cfg.probe_interval_s).start()
+    return registry, prober, router
+
+
+def _wait_for(predicate, timeout_s: float = 10.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return bool(predicate())
+
+
+# ---------------------------------------------------------------------------
+# measurement
+
+
+def profile(
+    config_token: str,
+    n_requests: int = 240,
+    service_ms: float = 4.0,
+    concurrency: int = 6,
+    seed: int = 0,
+):
+    import dataclasses
+
+    from replication_faster_rcnn_tpu.config import FleetConfig
+    from replication_faster_rcnn_tpu.faultlib import failpoints
+    from replication_faster_rcnn_tpu.serving import loadgen
+    from replication_faster_rcnn_tpu.serving.fleet.router import content_key
+
+    service_s = service_ms / 1000.0
+    cfg = FleetConfig(
+        probe_interval_s=0.05,
+        lease_timeout_s=0.2,
+        rejoin_probes=2,
+        breaker_threshold=3,
+        breaker_cooldown_s=0.5,
+        max_attempts=3,
+        request_timeout_s=10.0,
+        cache_entries=0,  # unique hashes anyway — measure replicas, not LRU
+        canary_fraction=0.0,
+        # clamp hedging above the healthy tail: a dead replica fails
+        # fast (failover handles it), so hedges stay out of the
+        # throughput measurement; the hedge leg prices them separately
+        hedge=True,
+        hedge_floor_ms=100.0,
+        hedge_ceiling_ms=400.0,
+    )
+    # unique content per request: every dispatch must reach a replica
+    requests = [
+        (f"img-{i:04d}", content_key(f"img-{i:04d}".encode()))
+        for i in range(n_requests)
+    ]
+
+    # -- single-replica baseline: one slot's capacity under full load
+    clients = {"r0": make_sim_replica("r0", service_s)}
+    registry, prober, router = build_fleet(clients, cfg)
+    try:
+        single = loadgen.run_fleet_loop(
+            router.dispatch, requests, concurrency=concurrency
+        )
+    finally:
+        prober.stop()
+        router.close()
+
+    # -- fleet leg: 3 replicas, seeded kill at ~2/3 of the run
+    clients = {
+        rid: make_sim_replica(rid, service_s) for rid in ("r0", "r1", "r2")
+    }
+    registry, prober, router = build_fleet(clients, cfg)
+    kill_at = max(1, (3 * n_requests) // 4)
+    failpoints.configure(
+        [
+            failpoints.Rule(
+                "router.dispatch", "drop", 1.0, seed,
+                max_fires=1, after=kill_at,
+            )
+        ]
+    )
+    try:
+        fleet = loadgen.run_fleet_loop(
+            router.dispatch, requests, concurrency=concurrency
+        )
+        victims = [rid for rid, c in clients.items() if c.killed]
+        victim = victims[0] if victims else None
+        # self-healing, second half: the prober lease-expires the dead
+        # replica, then readmits it after revival
+        dead_seen = victim is not None and _wait_for(
+            lambda: registry.state_of(victim) == "dead"
+        )
+        if victim is not None:
+            clients[victim].revive()
+        rejoined = victim is not None and _wait_for(
+            lambda: victim in registry.in_rotation()
+        )
+        router_stats = router.snapshot()["router"]
+    finally:
+        failpoints.disarm()
+        prober.stop()
+        router.close()
+
+    # -- hedge leg: fast/slow pair, tight clamps — the hedge must win
+    hedge_cfg = dataclasses.replace(
+        cfg, hedge_floor_ms=8.0, hedge_ceiling_ms=8.0, cache_entries=0
+    )
+    clients = {
+        "fast": make_sim_replica("fast", service_s / 2),
+        "slow": make_sim_replica("slow", 15 * service_s),
+    }
+    registry, prober, router = build_fleet(clients, hedge_cfg)
+    try:
+        hedge_run = loadgen.run_fleet_loop(
+            router.dispatch, requests[:32], concurrency=2
+        )
+        hedge_stats = router.snapshot()["router"]
+    finally:
+        prober.stop()
+        router.close()
+
+    speedup = (
+        round(fleet["images_per_sec"] / single["images_per_sec"], 3)
+        if single["images_per_sec"]
+        else None
+    )
+    return {
+        "schema": SCHEMA,
+        "config": config_token,
+        "platform": PLATFORM,
+        "service_ms": service_ms,
+        "n_requests": n_requests,
+        "concurrency": concurrency,
+        "seed": seed,
+        "kill_after_attempts": kill_at,
+        "single": single,
+        "single_images_per_sec": single["images_per_sec"],
+        "fleet": fleet,
+        GATE_KEY: fleet["images_per_sec"],
+        "availability": fleet["availability"],
+        "speedup": speedup,
+        "victim": victim,
+        "victim_killed": victim is not None,
+        "victim_dead_after_run": dead_seen,
+        "victim_rejoined": rejoined,
+        "failovers": router_stats["failovers"],
+        "router_stats": router_stats,
+        "hedge": {
+            "p99_ms": hedge_run["p99_ms"],
+            "availability": hedge_run["availability"],
+            "hedges": hedge_stats["hedges"],
+            "hedge_wins": hedge_stats["hedge_wins"],
+        },
+        "measured": True,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--requests", type=int, default=240)
+    p.add_argument("--service-ms", type=float, default=4.0)
+    p.add_argument("--concurrency", type=int, default=6)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--update", action="store_true",
+                   help="write/overwrite the banked record")
+    p.add_argument("--no-check", action="store_true",
+                   help="measure + print only")
+    p.add_argument("--tol", type=float, default=DEFAULT_TOL)
+    p.add_argument("--min-speedup", type=float, default=DEFAULT_MIN_SPEEDUP,
+                   help="fail when fleet/single throughput is below this "
+                        "floor (PR acceptance: 2.0)")
+    p.add_argument("--min-availability", type=float,
+                   default=DEFAULT_MIN_AVAILABILITY,
+                   help="fail when availability through the replica kill "
+                        "is below this floor (PR acceptance: 0.999)")
+    p.add_argument("--records-dir", default=RECORDS_DIR)
+    args = p.parse_args(argv)
+
+    token = f"sim3r{args.requests}s{args.service_ms:g}"
+    record = profile(
+        token,
+        n_requests=args.requests,
+        service_ms=args.service_ms,
+        concurrency=args.concurrency,
+        seed=args.seed,
+    )
+    path = record_path(record_key(token), args.records_dir)
+    print(json.dumps(record, indent=1, sort_keys=True))
+
+    if args.update:
+        save_record(record, path)
+        print(f"fleet_profile: banked {path}", file=sys.stderr)
+        return 0
+    if args.no_check:
+        return 0
+    banked = load_record(path) if os.path.exists(path) else None
+    if banked is None:
+        print(
+            f"fleet_profile: no banked record at {path} — run with "
+            "--update to create one (still enforcing the availability "
+            "and speedup floors)",
+            file=sys.stderr,
+        )
+    failures, warnings = check_regression(
+        record,
+        banked,
+        tol=args.tol,
+        min_speedup=args.min_speedup,
+        min_availability=args.min_availability,
+    )
+    for w in warnings:
+        print(f"fleet_profile: WARN {w}", file=sys.stderr)
+    for f in failures:
+        print(f"fleet_profile: FAIL {f}", file=sys.stderr)
+    if failures:
+        print(
+            f"fleet_profile: REGRESSION vs {path} — if intentional, "
+            "re-bank with --update",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"fleet_profile: OK vs {path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -818,6 +818,8 @@ def _cmd_serve_impl(args) -> int:
         serving = _dc.replace(serving, params_dtype=args.params_dtype)
     if args.request_timeout_s is not None:
         serving = _dc.replace(serving, request_timeout_s=args.request_timeout_s)
+    if args.adaptive_delay:
+        serving = _dc.replace(serving, adaptive_delay=True)
     cfg = cfg.replace(serving=serving)
     if cfg.debug.chaos_spec:
         from replication_faster_rcnn_tpu.faultlib import failpoints
@@ -848,7 +850,11 @@ def _cmd_serve_impl(args) -> int:
         )
     )
     server = make_server(
-        engine, args.host, args.port, score_thresh=args.score_thresh
+        engine,
+        args.host,
+        args.port,
+        score_thresh=args.score_thresh,
+        replica_id=args.replica_id,
     )
     host, port = server.server_address[:2]
     print(
@@ -856,16 +862,31 @@ def _cmd_serve_impl(args) -> int:
         "(POST /predict {\"paths\": [...]}, GET /healthz, GET /stats)",
         flush=True,
     )
-    # graceful drain on SIGTERM: stop ACCEPTING (server.shutdown must run
-    # off the serve_forever thread or it deadlocks), then the finally
-    # block below closes the listener and drains the engine — accepted
-    # requests still flush and respond before the process exits
+    # graceful drain on SIGTERM: advertise draining in /healthz first so
+    # a fleet router's prober pulls this replica out of rotation, hold
+    # the listener open for fleet.drain_grace_s (in-flight + newly routed
+    # requests still complete), then stop ACCEPTING (server.shutdown must
+    # run off the serve_forever thread or it deadlocks); the finally
+    # block below closes the listener and drains the engine
     import signal
     import threading
+    import time as _time
+
+    grace_s = cfg.fleet.drain_grace_s if args.replica_id else 0.0
 
     def _drain(signum, frame):  # noqa: ARG001 - signal signature
-        print("SIGTERM: draining in-flight requests...", file=sys.stderr)
-        threading.Thread(target=server.shutdown, daemon=True).start()
+        print(
+            f"SIGTERM: draining (grace {grace_s}s, then stop accepting)...",
+            file=sys.stderr,
+        )
+        server.draining = True
+
+        def _stop() -> None:
+            if grace_s > 0:
+                _time.sleep(grace_s)
+            server.shutdown()
+
+        threading.Thread(target=_stop, daemon=True).start()
 
     prev_term = signal.signal(signal.SIGTERM, _drain)
     with stack:
@@ -877,6 +898,120 @@ def _cmd_serve_impl(args) -> int:
             signal.signal(signal.SIGTERM, prev_term)
             server.server_close()
             engine.close()
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    """Self-healing multi-replica serving front (serving/fleet/): a
+    health-checked registry probes every `frcnn serve` replica's
+    /healthz on a lease, and the router consistent-hashes requests over
+    the live rotation with per-replica circuit breakers, failover
+    re-dispatch, p99-hedged retries, a content-hash result cache, and
+    canary/shadow traffic splits. Pure host-side routing — no jax, no
+    model; the replicas own the compute."""
+    with _threadsan_session(getattr(args, "threadsan", False)):
+        return _cmd_fleet_impl(args)
+
+
+def _cmd_fleet_impl(args) -> int:
+    import dataclasses as _dc
+    import json
+    import os
+
+    from replication_faster_rcnn_tpu.config import FleetConfig
+    from replication_faster_rcnn_tpu.serving import fleet as fleet_mod
+
+    if not args.replica:
+        print("fleet: need at least one --replica URL", file=sys.stderr)
+        return 2
+    overrides = {
+        k: v
+        for k, v in {
+            "probe_interval_s": args.probe_interval_s,
+            "lease_timeout_s": args.lease_timeout_s,
+            "breaker_threshold": args.breaker_threshold,
+            "max_attempts": args.max_attempts,
+            "request_timeout_s": args.request_timeout_s,
+            "cache_entries": args.cache_entries,
+            "canary_fraction": args.canary_fraction,
+        }.items()
+        if v is not None
+    }
+    if args.no_hedge:
+        overrides["hedge"] = False
+    fleet_cfg = _dc.replace(FleetConfig(), **overrides)
+    if args.chaos_spec:
+        from replication_faster_rcnn_tpu.faultlib import failpoints
+
+        failpoints.configure(args.chaos_spec)
+
+    registry = fleet_mod.ReplicaRegistry(fleet_cfg)
+    for url in args.replica:
+        registry.add(url, fleet_mod.HTTPReplicaClient(url, url))
+    for url in args.canary or []:
+        registry.add(url, fleet_mod.HTTPReplicaClient(url, url), role="canary")
+    for url in args.shadow or []:
+        registry.add(url, fleet_mod.HTTPReplicaClient(url, url), role="shadow")
+    router = fleet_mod.FleetRouter(registry, fleet_cfg)
+    prober = fleet_mod.Prober(registry, fleet_cfg.probe_interval_s).start()
+    server = fleet_mod.make_fleet_server(router, args.host, args.port)
+    host, port = server.server_address[:2]
+    print(
+        json.dumps(
+            {
+                "replicas": list(args.replica),
+                "canaries": list(args.canary or []),
+                "shadows": list(args.shadow or []),
+                "hedge": fleet_cfg.hedge,
+                "probe_interval_s": fleet_cfg.probe_interval_s,
+                "lease_timeout_s": fleet_cfg.lease_timeout_s,
+            },
+            indent=2,
+        )
+    )
+    print(
+        f"fleet router on http://{host}:{port}/ "
+        "(POST /predict {\"paths\": [...]}, GET /healthz, GET /stats)",
+        flush=True,
+    )
+    # same drain discipline as the replicas: /healthz says draining
+    # first, the listener keeps answering for the grace window, then the
+    # accept loop stops and the prober/hedge pool are joined
+    import signal
+    import threading
+    import time as _time
+
+    def _drain(signum, frame):  # noqa: ARG001 - signal signature
+        print(
+            f"SIGTERM: draining fleet front "
+            f"(grace {fleet_cfg.drain_grace_s}s)...",
+            file=sys.stderr,
+        )
+        server.draining = True
+
+        def _stop() -> None:
+            if fleet_cfg.drain_grace_s > 0:
+                _time.sleep(fleet_cfg.drain_grace_s)
+            server.shutdown()
+
+        threading.Thread(target=_stop, daemon=True).start()
+
+    prev_term = signal.signal(signal.SIGTERM, _drain)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+        server.server_close()
+        prober.stop()
+        router.close()
+        if args.telemetry:
+            os.makedirs(args.telemetry, exist_ok=True)
+            path = os.path.join(args.telemetry, "fleet.jsonl")
+            with open(path, "a") as fh:
+                fh.write(json.dumps(router.snapshot()) + "\n")
+            print(f"fleet telemetry appended to {path}", file=sys.stderr)
     return 0
 
 
@@ -1273,7 +1408,83 @@ def main(argv: Optional[List[str]] = None) -> int:
                               "time out to 504 and queued entries past "
                               "deadline are dropped at flush time, never "
                               "dispatched (0 = no deadline)")
+    p_serve.add_argument("--adaptive-delay", action="store_true",
+                         help="SLO-driven micro-batch deadlines "
+                              "(serving.adaptive_delay): adapt per-bucket "
+                              "max_delay_ms from observed queue-wait p99 "
+                              "with bounded multiplicative steps inside "
+                              "[delay_floor_ms, delay_ceiling_ms]")
+    p_serve.add_argument("--replica-id", default=None, metavar="ID",
+                         help="name this replica in /healthz for fleet "
+                              "membership; also enables the SIGTERM "
+                              "drain-grace window (fleet.drain_grace_s: "
+                              "advertise draining, keep serving, then stop "
+                              "accepting) so the fleet router rotates the "
+                              "replica out without dropped traffic")
     p_serve.set_defaults(fn=cmd_serve)
+
+    p_fleet = sub.add_parser(
+        "fleet",
+        help="self-healing multi-replica serving front: health-checked "
+             "replica registry (lease-staleness probes), consistent-hash "
+             "routing with a content-hash result cache, per-replica "
+             "circuit breakers, failover, p99-hedged retries, canary + "
+             "shadow traffic (serving/fleet/)",
+    )
+    p_fleet.add_argument("--replica", action="append", metavar="URL",
+                         help="serving replica base URL (repeatable), e.g. "
+                              "http://127.0.0.1:8008 — start each with "
+                              "`frcnn serve --replica-id ...`")
+    p_fleet.add_argument("--canary", action="append", metavar="URL",
+                         help="canary replica URL: a deterministic "
+                              "fleet.canary_fraction slice of the "
+                              "content-hash space tries it first")
+    p_fleet.add_argument("--shadow", action="append", metavar="URL",
+                         help="shadow replica URL: mirrored traffic, "
+                              "responses diffed (never returned)")
+    p_fleet.add_argument("--host", default="127.0.0.1")
+    p_fleet.add_argument("--port", type=int, default=8010,
+                         help="TCP port (0 = pick a free one)")
+    p_fleet.add_argument("--probe-interval-s", type=float, default=None,
+                         help="/healthz probe cadence per replica "
+                              "(fleet.probe_interval_s)")
+    p_fleet.add_argument("--lease-timeout-s", type=float, default=None,
+                         help="probe-staleness horizon before a replica "
+                              "is declared dead (fleet.lease_timeout_s)")
+    p_fleet.add_argument("--breaker-threshold", type=int, default=None,
+                         help="consecutive dispatch failures that open a "
+                              "replica's circuit breaker "
+                              "(fleet.breaker_threshold)")
+    p_fleet.add_argument("--max-attempts", type=int, default=None,
+                         help="primary + failover attempts per request "
+                              "(fleet.max_attempts)")
+    p_fleet.add_argument("--request-timeout-s", type=float, default=None,
+                         help="per-attempt replica call deadline "
+                              "(fleet.request_timeout_s)")
+    p_fleet.add_argument("--cache-entries", type=int, default=None,
+                         help="content-hash result cache size, 0 disables "
+                              "(fleet.cache_entries)")
+    p_fleet.add_argument("--canary-fraction", type=float, default=None,
+                         help="fraction of the content-hash space routed "
+                              "to the canary first (fleet.canary_fraction)")
+    p_fleet.add_argument("--no-hedge", action="store_true",
+                         help="disable hedged retries (fleet.hedge=False): "
+                              "dispatch becomes strictly sequential "
+                              "failover")
+    p_fleet.add_argument("--chaos-spec", default=None, metavar="SPEC",
+                         help="arm failpoints (site:kind:prob:seed[:arg]) "
+                              "— the fleet sites are router.dispatch and "
+                              "router.probe, plus http.handler on the "
+                              "front itself")
+    p_fleet.add_argument("--threadsan", action="store_true",
+                         help="record runtime thread-interaction traces "
+                              "for the router/prober threads "
+                              "(analysis/threadsan.py)")
+    p_fleet.add_argument("--telemetry", default=None, metavar="DIR",
+                         help="append a final router/registry snapshot to "
+                              "DIR/fleet.jsonl on shutdown (read by "
+                              "`frcnn telemetry`)")
+    p_fleet.set_defaults(fn=cmd_fleet)
 
     p_chaos = sub.add_parser(
         "chaos",

@@ -686,6 +686,18 @@ class ServingConfig:
     # deadline passes while it waits in the queue is dropped at flush
     # time (never dispatched). 0 disables deadlines (unbounded waits).
     request_timeout_s: float = 0.0
+    # SLO-driven micro-batch deadlines (serving/slo.py): when enabled,
+    # each bucket's max_delay_ms self-tunes from the observed queue-wait
+    # p99 — one bounded multiplicative step (x/÷ adaptive_delay_step) per
+    # adaptation, clamped to [delay_floor_ms, delay_ceiling_ms]. Wait p99
+    # near adaptive_slo_ms shortens the deadline (stop holding requests
+    # the SLO can't afford); a comfortably-met SLO with partial flushes
+    # lengthens it (wait for batch-mates, amortize dispatch).
+    adaptive_delay: bool = False
+    adaptive_slo_ms: float = 100.0  # target queue-wait p99 per request
+    delay_floor_ms: float = 1.0
+    delay_ceiling_ms: float = 100.0
+    adaptive_delay_step: float = 1.25
 
     def __post_init__(self):
         object.__setattr__(
@@ -731,6 +743,22 @@ class ServingConfig:
                 "serving.request_timeout_s must be >= 0 (0 = no deadline), "
                 f"got {self.request_timeout_s}"
             )
+        if self.adaptive_slo_ms <= 0:
+            raise ValueError(
+                "serving.adaptive_slo_ms must be > 0, got "
+                f"{self.adaptive_slo_ms}"
+            )
+        if not 0 < self.delay_floor_ms <= self.delay_ceiling_ms:
+            raise ValueError(
+                "serving delay bounds need 0 < delay_floor_ms <= "
+                f"delay_ceiling_ms, got floor={self.delay_floor_ms} "
+                f"ceiling={self.delay_ceiling_ms}"
+            )
+        if self.adaptive_delay_step <= 1.0:
+            raise ValueError(
+                "serving.adaptive_delay_step is multiplicative and must be "
+                f"> 1.0, got {self.adaptive_delay_step}"
+            )
 
     def bucket_resolutions(
         self, image_size: Tuple[int, int]
@@ -742,6 +770,120 @@ class ServingConfig:
             h, w = image_size
             res = {(max(1, h // 2), max(1, w // 2)), (h, w)}
         return tuple(sorted(res, key=lambda r: (r[0] * r[1], r)))
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Multi-replica serving fleet (serving/fleet/, `frcnn fleet`).
+
+    A front router owns a health-checked replica registry (periodic
+    ``/healthz`` probes with lease-style staleness, the PR 11 heartbeat
+    discipline applied to serving), dispatches by consistent hash over
+    (content-hash, bucket), and self-heals: per-replica circuit breakers,
+    failover re-dispatch, hedged retries after a p99-derived delay, and
+    probe-driven drain/rejoin so a restarted replica re-enters rotation
+    without dropped traffic.
+    """
+
+    # ---- registry / prober
+    probe_interval_s: float = 0.5  # /healthz probe cadence per replica
+    # a replica whose last successful probe is older than this is DEAD
+    # (lease staleness — missing probes age the lease out, exactly like
+    # elastic.lease_timeout_s ages out training heartbeats)
+    lease_timeout_s: float = 3.0
+    # consecutive successful probes a DEAD/JOINING replica needs before
+    # it re-enters rotation (a flapping replica can't bounce in and out)
+    rejoin_probes: int = 2
+    # ---- circuit breaker (per replica)
+    breaker_threshold: int = 3  # consecutive dispatch failures to open
+    breaker_cooldown_s: float = 1.0  # open -> half-open probe delay
+    # ---- dispatch
+    max_attempts: int = 3  # primary + failover re-dispatches per request
+    request_timeout_s: float = 30.0  # per-attempt replica call deadline
+    vnodes: int = 64  # consistent-hash ring points per replica
+    # content-hash result cache entries (duplicate images are answered
+    # from the router without touching a replica; 0 disables)
+    cache_entries: int = 256
+    # ---- hedging: after hedge_multiplier x observed p99 (clamped to
+    # [hedge_floor_ms, hedge_ceiling_ms]) with no primary response, a
+    # second copy goes to the next ring replica; first result wins
+    hedge: bool = True
+    hedge_multiplier: float = 1.5
+    hedge_floor_ms: float = 5.0
+    hedge_ceiling_ms: float = 2000.0
+    latency_window: int = 128  # per-router latency samples for the p99
+    # ---- canary / shadow
+    # fraction of requests routed to the canary replica first (decided
+    # by content hash, so the split is deterministic per image)
+    canary_fraction: float = 0.05
+    # ---- replica-side drain: how long a SIGTERMed `frcnn serve
+    # --replica-id` advertises draining=true in /healthz (so the router
+    # stops routing to it) before it stops accepting connections
+    drain_grace_s: float = 1.0
+
+    def __post_init__(self):
+        if self.probe_interval_s <= 0:
+            raise ValueError(
+                f"fleet.probe_interval_s must be > 0, got {self.probe_interval_s}"
+            )
+        if self.lease_timeout_s <= self.probe_interval_s:
+            raise ValueError(
+                "fleet.lease_timeout_s must exceed probe_interval_s "
+                f"({self.probe_interval_s}), got {self.lease_timeout_s}"
+            )
+        if self.rejoin_probes < 1:
+            raise ValueError(
+                f"fleet.rejoin_probes must be >= 1, got {self.rejoin_probes}"
+            )
+        if self.breaker_threshold < 1:
+            raise ValueError(
+                "fleet.breaker_threshold must be >= 1, got "
+                f"{self.breaker_threshold}"
+            )
+        if self.breaker_cooldown_s <= 0:
+            raise ValueError(
+                "fleet.breaker_cooldown_s must be > 0, got "
+                f"{self.breaker_cooldown_s}"
+            )
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"fleet.max_attempts must be >= 1, got {self.max_attempts}"
+            )
+        if self.request_timeout_s <= 0:
+            raise ValueError(
+                "fleet.request_timeout_s must be > 0, got "
+                f"{self.request_timeout_s}"
+            )
+        if self.vnodes < 1:
+            raise ValueError(f"fleet.vnodes must be >= 1, got {self.vnodes}")
+        if self.cache_entries < 0:
+            raise ValueError(
+                f"fleet.cache_entries must be >= 0, got {self.cache_entries}"
+            )
+        if self.hedge_multiplier <= 0:
+            raise ValueError(
+                "fleet.hedge_multiplier must be > 0, got "
+                f"{self.hedge_multiplier}"
+            )
+        if not 0 < self.hedge_floor_ms <= self.hedge_ceiling_ms:
+            raise ValueError(
+                "fleet hedge bounds need 0 < hedge_floor_ms <= "
+                f"hedge_ceiling_ms, got floor={self.hedge_floor_ms} "
+                f"ceiling={self.hedge_ceiling_ms}"
+            )
+        if self.latency_window < 1:
+            raise ValueError(
+                f"fleet.latency_window must be >= 1, got {self.latency_window}"
+            )
+        if not 0.0 <= self.canary_fraction <= 1.0:
+            raise ValueError(
+                "fleet.canary_fraction must be in [0, 1], got "
+                f"{self.canary_fraction}"
+            )
+        if self.drain_grace_s < 0:
+            raise ValueError(
+                f"fleet.drain_grace_s must be >= 0, got {self.drain_grace_s}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -788,6 +930,7 @@ class FasterRCNNConfig:
     debug: DebugConfig = dataclasses.field(default_factory=DebugConfig)
     analysis: AnalysisConfig = dataclasses.field(default_factory=AnalysisConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     elastic: ElasticConfig = dataclasses.field(default_factory=ElasticConfig)
     ops: OpsConfig = dataclasses.field(default_factory=OpsConfig)
 

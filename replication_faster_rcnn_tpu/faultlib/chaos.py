@@ -29,7 +29,16 @@ Legs:
    variant of the same loss.  Same machinery as the real
    ``frcnn train --elastic`` path (parallel/elastic.py), minus the
    process boundaries.
-5. **determinism** — legs 1–4 run twice under the same seed; the two
+5. **fleet_router** — a 3-replica serving fleet driven single-threaded
+   (fake clock, manual probes, hedging off): a seeded ``router.probe``
+   IOError delays one replica's admission by exactly one probe round, a
+   seeded ``router.dispatch`` drop kills the selected replica
+   mid-request through the router's kill hook — failover must answer
+   the request anyway — then the dead replica's lease ages out
+   (DEAD, out of rotation), it revives, and rejoins after
+   ``rejoin_probes`` clean probes.  Same machinery as the real
+   ``frcnn fleet`` path (serving/fleet/), minus the processes.
+6. **determinism** — all legs run twice under the same seed; the two
    injected-event logs must match exactly.
 """
 
@@ -76,6 +85,19 @@ def smoke_rules(seed: int) -> List[failpoints.Rule]:
         failpoints.Rule(
             "collective.init", "drop", 1.0, seed + 4,
             arg=1, max_fires=1, after=1,
+        ),
+        # fleet_router leg: dispatch attempts hit in request order —
+        # requests a, b pass (hits 0, 1), the drop lands on request c's
+        # first attempt (hit 2) and the router's kill hook makes the
+        # selected replica actually die; the failover attempt is hit 3
+        failpoints.Rule(
+            "router.dispatch", "drop", 1.0, seed + 5, max_fires=1, after=2
+        ),
+        # probes hit per replica in registration order (r0, r1, r2 per
+        # round): after=4 fails exactly r1's probe in round 2, delaying
+        # its admission to rotation by one round — transient, max_fires=1
+        failpoints.Rule(
+            "router.probe", "ioerror", 1.0, seed + 6, max_fires=1, after=4
         ),
     ]
 
@@ -322,6 +344,121 @@ def _fleet_leg(workdir: str, seed: int) -> Dict[str, Any]:
     }
 
 
+def _fleet_router_leg(seed: int) -> Dict[str, Any]:
+    from replication_faster_rcnn_tpu.config import FleetConfig
+    from replication_faster_rcnn_tpu.serving import fleet as fleet_mod
+
+    # hedging off + fake clock + manual probes: every failpoint hit index
+    # is a pure function of this leg's call sequence, so the seeded
+    # schedule replays identically (the determinism pin)
+    cfg = FleetConfig(
+        hedge=False,
+        probe_interval_s=0.5,
+        lease_timeout_s=1.2,
+        rejoin_probes=2,
+        breaker_threshold=2,
+        breaker_cooldown_s=1.0,
+        cache_entries=8,
+        canary_fraction=0.0,
+    )
+    now = [0.0]
+    clients = {
+        rid: fleet_mod.LocalReplicaClient(rid, lambda p: p * 2)
+        for rid in ("r0", "r1", "r2")
+    }
+    registry = fleet_mod.ReplicaRegistry(cfg, clock=lambda: now[0])
+    for rid, client in clients.items():
+        registry.add(rid, client)
+
+    def _probe_round() -> None:
+        registry.probe_once()
+        now[0] += 0.5
+
+    # round 1: everyone's 1st ok probe; round 2: the seeded router.probe
+    # IOError (after=4) fails exactly r1's probe, so r0/r2 reach the
+    # rejoin_probes=2 gate and r1 is held back one round
+    _probe_round()
+    _probe_round()
+    _check(
+        registry.in_rotation() == ["r0", "r2"],
+        f"fleet_router leg: rotation after the faulted probe round is "
+        f"{registry.in_rotation()}, want ['r0', 'r2']",
+    )
+    _probe_round()
+    _probe_round()
+    _check(
+        registry.in_rotation() == ["r0", "r1", "r2"],
+        f"fleet_router leg: r1 did not rejoin after the transient probe "
+        f"fault: {registry.in_rotation()}",
+    )
+
+    router = fleet_mod.FleetRouter(
+        registry,
+        cfg,
+        clock=lambda: now[0],
+        kill_hook=lambda rid: clients[rid].kill(),
+    )
+    # requests a, b dispatch clean (router.dispatch hits 0, 1)
+    _check(
+        router.dispatch(3, content_hash="img-a") == 6,
+        "fleet_router leg: request a returned the wrong result",
+    )
+    _check(
+        router.dispatch(4, content_hash="img-b") == 8,
+        "fleet_router leg: request b returned the wrong result",
+    )
+    # request c: the seeded drop (hit 2) kills its selected replica
+    # mid-request; failover (hit 3) must answer anyway
+    victim = router.candidates("img-c")[0]
+    _check(
+        router.dispatch(5, content_hash="img-c") == 10,
+        "fleet_router leg: failover did not absorb the replica kill",
+    )
+    _check(
+        clients[victim].killed,
+        f"fleet_router leg: kill hook did not kill {victim!r}",
+    )
+    _check(
+        router.stats["failovers"] == 1,
+        f"fleet_router leg: failovers={router.stats['failovers']}, want 1",
+    )
+    # the dead replica stops answering probes; its lease (1.2s) ages out
+    # within three 0.5s rounds and the registry declares it DEAD
+    for _ in range(3):
+        _probe_round()
+    _check(
+        registry.state_of(victim) == "dead",
+        f"fleet_router leg: victim state is {registry.state_of(victim)!r}, "
+        "want 'dead' after lease timeout",
+    )
+    _check(
+        victim not in registry.in_rotation(),
+        "fleet_router leg: dead replica still in rotation",
+    )
+    # drain/rejoin: the replica restarts and re-enters rotation after
+    # rejoin_probes clean probes — no operator action
+    clients[victim].revive()
+    _probe_round()
+    _probe_round()
+    _check(
+        registry.state_of(victim) == "healthy",
+        f"fleet_router leg: revived replica is "
+        f"{registry.state_of(victim)!r}, want 'healthy'",
+    )
+    # duplicate image: answered from the content-hash cache, no dispatch
+    _check(
+        router.dispatch(3, content_hash="img-a") == 6
+        and router.stats["cache_hits"] == 1,
+        "fleet_router leg: duplicate content was not served from cache",
+    )
+    return {
+        "victim": victim,
+        "failovers": router.stats["failovers"],
+        "cache_hits": router.stats["cache_hits"],
+        "rejoined": True,
+    }
+
+
 def _one_pass(workdir: str, seed: int) -> Dict[str, Any]:
     failpoints.configure(smoke_rules(seed))
     try:
@@ -330,6 +467,7 @@ def _one_pass(workdir: str, seed: int) -> Dict[str, Any]:
             "checkpoint": _checkpoint_leg(workdir, seed),
             "batcher": _batcher_leg(),
             "fleet": _fleet_leg(workdir, seed),
+            "fleet_router": _fleet_router_leg(seed),
         }
         events = failpoints.event_log()
     finally:

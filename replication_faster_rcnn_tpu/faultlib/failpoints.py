@@ -42,6 +42,15 @@ Sites (see the README failpoint table):
                        rank's index kills the rank (seeded rank loss —
                        ``prob=1.0, after=k, max_fires=1`` lands it on
                        exactly the k-th beat)
+  router.dispatch      serving/fleet/router.py::FleetRouter, per replica
+                       attempt; ``drop`` kills the selected replica
+                       mid-request (the router's kill hook takes it out
+                       of the fleet, then the attempt fails with a
+                       dropped connection — failover/hedging must absorb
+                       it), ``ioerror``/``delay`` fault just the attempt
+  router.probe         serving/fleet/registry.py per /healthz probe;
+                       ``ioerror`` fails the probe (lease keeps aging),
+                       ``delay`` stalls it
 
 Kinds:
   ioerror      raise ChaosError (an OSError) at the site
@@ -95,6 +104,8 @@ SITES = (
     "collective.init",
     "http.handler",
     "heartbeat.beat",
+    "router.dispatch",
+    "router.probe",
 )
 
 KINDS = ("ioerror", "torn_write", "crc_corrupt", "nan", "delay", "drop")

@@ -15,6 +15,10 @@ requests OR when its oldest request has waited ``max_delay_s`` — the
 classic continuous-batching tradeoff knob between per-request latency
 and per-dispatch amortization. ``max_delay_s=0`` degrades to greedy
 batching: flush whatever has accumulated the moment the queue idles.
+``max_delay_s`` may be a ``key -> seconds`` callable, re-read at every
+deadline decision — the seam the SLO-driven deadline controller
+(serving/slo.py) tunes per-bucket deadlines through while the worker
+runs.
 """
 
 from __future__ import annotations
@@ -58,6 +62,7 @@ class MicroBatcher:
         poll_hook: Optional[Callable[[], None]] = None,
         on_expired: Optional[Callable[[int], None]] = None,
         on_flush_result: Optional[Callable[[bool], None]] = None,
+        on_flush_stats: Optional[Callable[[Any, List[float]], None]] = None,
     ) -> None:
         """``clock``, ``start`` and ``poll_hook`` are test seams:
         ``clock`` replaces ``time.monotonic`` for deadline math (inject
@@ -69,29 +74,42 @@ class MicroBatcher:
         ``on_expired(n)`` is called on the worker thread each time a
         flush drops ``n`` deadline-expired entries; ``on_flush_result(ok)``
         after every processed flush — the engine's hooks for its shed /
-        degraded-health accounting (both must be cheap and non-raising)."""
+        degraded-health accounting (both must be cheap and non-raising).
+        ``on_flush_stats(key, waits_s)`` fires before each dispatched
+        flush with every live entry's queue-wait seconds — the gauge feed
+        for the SLO deadline controller and /healthz depth reporting."""
         if not callable(max_batch):
             if max_batch < 1:
                 raise ValueError(f"max_batch must be >= 1, got {max_batch}")
             _n = int(max_batch)
             max_batch = lambda key: _n  # noqa: E731
-        if max_delay_s < 0:
-            raise ValueError(f"max_delay_s must be >= 0, got {max_delay_s}")
+        if not callable(max_delay_s):
+            if max_delay_s < 0:
+                raise ValueError(
+                    f"max_delay_s must be >= 0, got {max_delay_s}"
+                )
+            _d = float(max_delay_s)
+            max_delay_s = lambda key: _d  # noqa: E731
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self._process = process
         self._max_batch = max_batch
-        self._max_delay_s = float(max_delay_s)
+        self._max_delay_s = max_delay_s
         self._clock = clock
         self._poll_hook = poll_hook
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._closed = False
         self._on_expired = on_expired
         self._on_flush_result = on_flush_result
+        self._on_flush_stats = on_flush_stats
         # worker appends while flush_log snapshots from other threads
         self._log_lock = threading.Lock()
         self._flushes: List[Tuple[Any, int]] = []  # (key, size) history
         self._expired_total = 0  # deadline-dropped entries, ever
+        # submitted-but-not-yet-flushed entries per key: incremented by
+        # submitter threads, decremented by the worker's flush — both
+        # under _log_lock (the /healthz per-bucket depth gauge)
+        self._key_depth: Dict[Any, int] = {}
         # worker-loop state; touched by the controlling thread only in
         # the threadless (start=False) test mode.
         # entries: (item, future, submit_time, absolute_deadline|None)
@@ -128,6 +146,8 @@ class MicroBatcher:
         now = self._clock()
         deadline = None if deadline_s is None else now + deadline_s
         self._queue.put((key, item, fut, now, deadline), timeout=timeout)
+        with self._log_lock:
+            self._key_depth[key] = self._key_depth.get(key, 0) + 1
         return fut
 
     def close(self, join_timeout: float = 60.0) -> None:
@@ -169,6 +189,10 @@ class MicroBatcher:
                 entry[2].set_exception(
                     RuntimeError("MicroBatcher closed before processing")
                 )
+                with self._log_lock:
+                    self._key_depth[entry[0]] = (
+                        self._key_depth.get(entry[0], 0) - 1
+                    )
 
     def __enter__(self) -> "MicroBatcher":
         return self
@@ -184,6 +208,17 @@ class MicroBatcher:
 
     def queue_depth(self) -> int:
         return self._queue.qsize()
+
+    def key_depths(self) -> Dict[Any, int]:
+        """Submitted-but-unflushed entry count per key (queued + grouped
+        on the worker) — the per-bucket depth gauge /healthz reports."""
+        with self._log_lock:
+            return {k: n for k, n in self._key_depth.items() if n > 0}
+
+    def delay_s(self, key: Any) -> float:
+        """The currently-effective flush deadline for ``key`` (resolves
+        the callable form — introspection for /stats and tests)."""
+        return self._max_delay_s(key)
 
     @property
     def expired_total(self) -> int:
@@ -216,8 +251,11 @@ class MicroBatcher:
         pending = self._pending
         timeout = None
         if pending:
-            oldest = min(group[0][2] for group in pending.values())
-            timeout = max(0.0, oldest + self._max_delay_s - self._clock())
+            nearest = min(
+                group[0][2] + self._max_delay_s(key)
+                for key, group in pending.items()
+            )
+            timeout = max(0.0, nearest - self._clock())
         try:
             if block:
                 entry = self._queue.get(timeout=timeout)
@@ -238,7 +276,7 @@ class MicroBatcher:
         now = self._clock()
         for key in list(pending):
             group = pending[key]
-            if group and now >= group[0][2] + self._max_delay_s:
+            if group and now >= group[0][2] + self._max_delay_s(key):
                 self._flush(key, pending)
         return True
 
@@ -248,6 +286,8 @@ class MicroBatcher:
         pending: Dict[Any, List[Tuple[Any, Future, float, Optional[float]]]],
     ) -> None:
         group = pending.pop(key)
+        with self._log_lock:
+            self._key_depth[key] = self._key_depth.get(key, 0) - len(group)
         # deadline-expired entries are dropped HERE, before any compute:
         # the waiter that owned the request has already timed out, so
         # dispatching its slot would burn accelerator time on abandoned
@@ -275,6 +315,8 @@ class MicroBatcher:
             return
         with self._log_lock:
             self._flushes.append((key, len(live)))
+        if self._on_flush_stats is not None:
+            self._on_flush_stats(key, [now - t0 for _, _, t0, _ in live])
         try:
             failpoints.fire("batcher.flush", key=str(key), n=len(live))
             results = self._process(key, [item for item, _, _, _ in live])

@@ -31,6 +31,7 @@ from __future__ import annotations
 import contextlib
 import queue as queue_mod
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -40,6 +41,7 @@ import numpy as np
 from replication_faster_rcnn_tpu.config import FasterRCNNConfig
 from replication_faster_rcnn_tpu.eval.evaluator import Evaluator
 from replication_faster_rcnn_tpu.serving.batcher import MicroBatcher
+from replication_faster_rcnn_tpu.serving.slo import DeadlineController
 from replication_faster_rcnn_tpu.telemetry import spans as tspans
 
 # consecutive flush failures before /healthz reports degraded; one
@@ -163,18 +165,37 @@ class InferenceEngine:
             "flush_errors": 0,  # failed micro-batch dispatches
         }
         self._consecutive_flush_errors = 0
+        self._last_flush_error: Optional[str] = None
+        self._start_time = time.monotonic()
         if warmup:
             for h, w in self.buckets:
                 for n in self.batch_sizes:
                     self._program(serve_program_name(h, w, n))
+        # SLO-driven deadlines (serving.adaptive_delay): the controller
+        # owns per-bucket max_delay and learns from the batcher's flush
+        # wait stats; otherwise the static max_delay_ms knob applies.
+        self.deadline_controller: Optional[DeadlineController] = None
+        if config.serving.adaptive_delay:
+            self.deadline_controller = DeadlineController.from_config(
+                config.serving, max_batch=lambda key: self.batch_sizes[-1]
+            )
         self._batcher = MicroBatcher(
             self._process_bucket,
             max_batch=lambda key: self.batch_sizes[-1],
-            max_delay_s=config.serving.max_delay_ms / 1000.0,
+            max_delay_s=(
+                self.deadline_controller.delay_s
+                if self.deadline_controller is not None
+                else config.serving.max_delay_ms / 1000.0
+            ),
             depth=config.serving.queue_depth,
             name="serving-micro-batcher",
             on_expired=self._note_expired,
             on_flush_result=self._note_flush,
+            on_flush_stats=(
+                self.deadline_controller.on_flush
+                if self.deadline_controller is not None
+                else None
+            ),
         )
 
     # ---------------------------------------------------- overload accounting
@@ -202,6 +223,17 @@ class InferenceEngine:
         /stats must not reach into the engine's internals)."""
         return self._batcher.queue_depth()
 
+    def bucket_queue_depths(self) -> Dict[str, int]:
+        """``"HxW" -> submitted-but-unflushed requests`` per bucket (the
+        /healthz per-bucket depth gauge)."""
+        return {
+            f"{k[0]}x{k[1]}": n for k, n in self._batcher.key_depths().items()
+        }
+
+    def uptime_s(self) -> float:
+        """Seconds since engine construction (surfaced in /healthz)."""
+        return time.monotonic() - self._start_time
+
     @property
     def degraded(self) -> bool:
         """True after :data:`DEGRADED_AFTER` consecutive flush failures;
@@ -209,6 +241,19 @@ class InferenceEngine:
         balancers can route around a sick replica without killing it."""
         with self._stats_lock:
             return self._consecutive_flush_errors >= DEGRADED_AFTER
+
+    @property
+    def degraded_reason(self) -> Optional[str]:
+        """Human-readable cause while degraded, ``None`` when healthy —
+        what an operator paging on /healthz sees first."""
+        with self._stats_lock:
+            n = self._consecutive_flush_errors
+            if n < DEGRADED_AFTER:
+                return None
+            reason = f"{n} consecutive micro-batch flush failures"
+            if self._last_flush_error:
+                reason += f" (last: {self._last_flush_error})"
+            return reason
 
     # ------------------------------------------------------------ programs
 
@@ -345,6 +390,16 @@ class InferenceEngine:
     def _process_bucket(self, bucket, items):
         """One micro-batch: pad to the smallest compiled batch size,
         dispatch the bucket's AOT program, un-pad, de-normalize boxes."""
+        try:
+            return self._process_bucket_inner(bucket, items)
+        except BaseException as e:  # noqa: BLE001 - recorded, then relayed
+            # capture the cause for degraded_reason before the batcher
+            # relays the exception through the flush's futures
+            with self._stats_lock:
+                self._last_flush_error = f"{type(e).__name__}: {e}"
+            raise
+
+    def _process_bucket_inner(self, bucket, items):
         h, w = bucket
         n = len(items)
         bn = next((b for b in self.batch_sizes if b >= n), self.batch_sizes[-1])

@@ -1,0 +1,464 @@
+"""The fleet dispatcher: consistent hashing, result cache, breakers,
+failover, hedging, canary and shadow traffic.
+
+One request's path through :meth:`FleetRouter.dispatch`:
+
+1. **Cache** — the content hash answers exact-duplicate images from the
+   router's LRU without touching a replica.
+2. **Placement** — the request's ring key ``content_hash:bucket`` walks
+   the consistent-hash ring (``fleet.vnodes`` points per replica) over
+   the replicas currently in rotation; the ordered walk IS the failover
+   order, so retries of the same image hit the same replicas in the
+   same order while membership is stable, and membership changes move
+   only ~1/N of the keyspace.  A deterministic ``canary_fraction``
+   slice of the hash space tries the canary replica first.
+3. **Dispatch** — attempts run against the walk order, skipping
+   replicas whose circuit breaker refuses.  Every attempt consults the
+   ``router.dispatch`` failpoint: an injected ``drop`` invokes the
+   router's kill hook (the chaos/benchmark seam that makes the selected
+   replica actually die) and then fails the attempt as a dropped
+   connection — which the machinery below must absorb.
+4. **Failover** — a failed attempt records into that replica's breaker
+   and re-dispatches to the next replica in the walk, up to
+   ``fleet.max_attempts``.
+5. **Hedging** — with ``fleet.hedge``, if the primary attempt has not
+   resolved after ``hedge_multiplier x observed p99`` (clamped to the
+   configured floor/ceiling), a second copy goes to the next replica
+   and the first result wins — tail tolerance against a slow-but-alive
+   replica, which failover alone cannot see.
+6. **Shadow** — successful responses are mirrored to shadow replicas
+   and diffed (counters only; the client's response is already gone).
+
+Hedging needs real concurrency, so it runs attempts on a thread pool;
+with ``hedge=False`` (or no pool) dispatch is strictly sequential and
+single-threaded — the mode the chaos leg replays deterministically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from bisect import bisect_right
+from collections import OrderedDict, deque
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import wait as futures_wait
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from replication_faster_rcnn_tpu.config import FleetConfig
+from replication_faster_rcnn_tpu.faultlib import failpoints
+from replication_faster_rcnn_tpu.serving.fleet.breaker import CircuitBreaker
+from replication_faster_rcnn_tpu.serving.fleet.client import ReplicaDown
+from replication_faster_rcnn_tpu.serving.fleet.registry import (
+    CANARY,
+    SHADOW,
+    ReplicaRegistry,
+)
+
+__all__ = ["FleetRouter", "FleetUnavailable", "HashRing", "content_key"]
+
+
+class FleetUnavailable(ConnectionError):
+    """Every eligible replica refused or failed the request."""
+
+
+def _hash64(s: str) -> int:
+    return int.from_bytes(hashlib.sha256(s.encode()).digest()[:8], "big")
+
+
+def content_key(data: bytes) -> str:
+    """Stable content hash for a request payload (cache + ring key)."""
+    return hashlib.sha256(data).hexdigest()
+
+
+class HashRing:
+    """Consistent hash ring with virtual nodes.
+
+    ``ordered(key)`` walks clockwise from the key's position and returns
+    every distinct node once — position 0 is the owner, the rest are the
+    failover/hedge order.  Stateless w.r.t. membership: build one per
+    membership set (cheap — ``vnodes x N`` hashes) and cache by set.
+    """
+
+    def __init__(self, nodes: List[str], vnodes: int = 64) -> None:
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        points: List[Tuple[int, str]] = []
+        for node in nodes:
+            for v in range(vnodes):
+                points.append((_hash64(f"{node}#{v}"), node))
+        points.sort()
+        self._points = points
+        self._hashes = [h for h, _ in points]
+        self._n_nodes = len(set(nodes))
+
+    def ordered(self, key: str) -> List[str]:
+        if not self._points:
+            return []
+        start = bisect_right(self._hashes, _hash64(key))
+        seen: Set[str] = set()
+        out: List[str] = []
+        for i in range(len(self._points)):
+            _, node = self._points[(start + i) % len(self._points)]
+            if node not in seen:
+                seen.add(node)
+                out.append(node)
+                if len(out) == self._n_nodes:
+                    break
+        return out
+
+
+class FleetRouter:
+    """Self-healing dispatcher over a :class:`ReplicaRegistry`.
+
+    ``kill_hook(replica_id)`` is called when a ``router.dispatch`` drop
+    fault selects a replica — the chaos leg and fleet_profile benchmark
+    wire it to ``LocalReplicaClient.kill`` so the injected death is
+    real for every subsequent attempt and probe.
+    """
+
+    def __init__(
+        self,
+        registry: ReplicaRegistry,
+        config: FleetConfig,
+        clock: Callable[[], float] = time.monotonic,
+        kill_hook: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        self._registry = registry
+        self._config = config
+        self._clock = clock
+        self._kill_hook = kill_hook
+        # guards stats, cache, latency window, breakers table, ring cache
+        # — written from dispatch callers (HTTP handler threads) AND the
+        # hedge pool's attempt/shadow tasks
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._cache: "OrderedDict[str, Any]" = OrderedDict()
+        self._latency_s: deque = deque(maxlen=config.latency_window)
+        self._ring_cache: Tuple[Tuple[str, ...], Optional[HashRing]] = ((), None)
+        self._replica_stats: Dict[str, Dict[str, int]] = {}
+        self.stats: Dict[str, int] = {
+            "requests": 0,
+            "cache_hits": 0,
+            "attempts": 0,
+            "failed_attempts": 0,
+            "failovers": 0,
+            "hedges": 0,
+            "hedge_wins": 0,
+            "canary_requests": 0,
+            "shadow_requests": 0,
+            "shadow_diffs": 0,
+            "unavailable": 0,
+        }
+        # hedging needs attempts in flight concurrently; sequential mode
+        # (hedge=False) never touches the pool
+        self._pool: Optional[ThreadPoolExecutor] = None
+        if config.hedge:
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(4, 2 * config.max_attempts),
+                thread_name_prefix="fleet-hedge",
+            )
+
+    # ---------------------------------------------------------------- reads
+
+    def breaker(self, replica_id: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(replica_id)
+            if b is None:
+                b = CircuitBreaker(
+                    threshold=self._config.breaker_threshold,
+                    cooldown_s=self._config.breaker_cooldown_s,
+                    clock=self._clock,
+                )
+                self._breakers[replica_id] = b
+            return b
+
+    def hedge_delay_s(self) -> float:
+        """``hedge_multiplier x observed p99`` clamped to the configured
+        floor/ceiling; before any samples exist, the ceiling (hedge
+        conservatively until there is evidence of the tail)."""
+        cfg = self._config
+        with self._lock:
+            samples = sorted(self._latency_s)
+        if not samples:
+            return cfg.hedge_ceiling_ms / 1000.0
+        idx = min(len(samples) - 1, int(0.99 * (len(samples) - 1) + 0.5))
+        raw = samples[idx] * cfg.hedge_multiplier
+        return min(
+            max(raw, cfg.hedge_floor_ms / 1000.0),
+            cfg.hedge_ceiling_ms / 1000.0,
+        )
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Router + per-replica gauges for /stats and telemetry."""
+        with self._lock:
+            stats = dict(self.stats)
+            per_replica = {
+                rid: dict(c) for rid, c in self._replica_stats.items()
+            }
+            breakers = list(self._breakers.items())
+            cache_size = len(self._cache)
+        for rid, b in breakers:
+            per_replica.setdefault(rid, {"ok": 0, "fail": 0})["breaker"] = (
+                b.snapshot()
+            )
+        return {
+            "router": {
+                **stats,
+                "cache_size": cache_size,
+                "hedge_delay_ms": round(self.hedge_delay_s() * 1e3, 3),
+            },
+            "replicas": per_replica,
+            "registry": self._registry.snapshot(),
+        }
+
+    # ------------------------------------------------------------ placement
+
+    def _ring(self) -> HashRing:
+        members = tuple(self._registry.in_rotation())
+        with self._lock:
+            cached_members, ring = self._ring_cache
+            if ring is not None and cached_members == members:
+                return ring
+        ring = HashRing(list(members), vnodes=self._config.vnodes)
+        with self._lock:
+            self._ring_cache = (members, ring)
+        return ring
+
+    def _canary_first(self, content_hash: str) -> List[str]:
+        """The canary replicas this request should try first: a stable
+        ``canary_fraction`` slice of the content-hash space (the same
+        image always lands on the same side of the split)."""
+        cfg = self._config
+        if cfg.canary_fraction <= 0:
+            return []
+        canaries = self._registry.in_rotation(role=CANARY)
+        if not canaries:
+            return []
+        slot = _hash64(f"{content_hash}:canary") / float(1 << 64)
+        if slot >= cfg.canary_fraction:
+            return []
+        return [canaries[_hash64(content_hash) % len(canaries)]]
+
+    def candidates(self, content_hash: str, bucket: str = "") -> List[str]:
+        """Dispatch order for a request: optional canary first, then the
+        consistent-hash walk over the serving rotation."""
+        order = self._canary_first(content_hash)
+        for rid in self._ring().ordered(f"{content_hash}:{bucket}"):
+            if rid not in order:
+                order.append(rid)
+        return order
+
+    # ------------------------------------------------------------- dispatch
+
+    def dispatch(
+        self, payload: Any, content_hash: str, bucket: str = ""
+    ) -> Any:
+        """Route one request through cache -> canary/ring -> breakers ->
+        failover/hedging.  Raises :class:`FleetUnavailable` when no
+        replica could serve it."""
+        cfg = self._config
+        with self._lock:
+            self.stats["requests"] += 1
+            if cfg.cache_entries > 0 and content_hash in self._cache:
+                self._cache.move_to_end(content_hash)
+                self.stats["cache_hits"] += 1
+                return self._cache[content_hash]
+        order = self.candidates(content_hash, bucket)
+        if not order:
+            with self._lock:
+                self.stats["unavailable"] += 1
+            raise FleetUnavailable("no replicas in rotation")
+        if order[0] in self._registry.in_rotation(role=CANARY):
+            with self._lock:
+                self.stats["canary_requests"] += 1
+        if self._pool is not None and cfg.hedge:
+            result = self._dispatch_hedged(payload, order)
+        else:
+            result = self._dispatch_sequential(payload, order)
+        with self._lock:
+            if cfg.cache_entries > 0:
+                self._cache[content_hash] = result
+                self._cache.move_to_end(content_hash)
+                while len(self._cache) > cfg.cache_entries:
+                    self._cache.popitem(last=False)
+        self._mirror_to_shadows(payload, result)
+        return result
+
+    def _next_allowed(
+        self, order: List[str], tried: Set[str]
+    ) -> Optional[str]:
+        for rid in order:
+            if rid not in tried and self.breaker(rid).allow():
+                return rid
+        return None
+
+    def _attempt(self, replica_id: str, payload: Any) -> Any:
+        """One replica call: failpoint consult, predict, accounting.
+        Runs on the caller thread (sequential mode) or a hedge-pool
+        thread — every shared write below is lock-guarded."""
+        with self._lock:
+            self.stats["attempts"] += 1
+        t0 = self._clock()
+        try:
+            inj = failpoints.fire("router.dispatch", replica=replica_id)
+            if inj is not None and inj.kind == "drop":
+                # the selected replica dies mid-request: make it real
+                # through the kill hook, then fail this attempt the way
+                # a dropped TCP connection would
+                if self._kill_hook is not None:
+                    self._kill_hook(replica_id)
+                raise ReplicaDown(
+                    f"injected replica kill mid-request on {replica_id!r}"
+                )
+            client = self._registry.client_of(replica_id)
+            result = client.predict(
+                payload, timeout_s=self._config.request_timeout_s
+            )
+        except BaseException:
+            self.breaker(replica_id).record_failure()
+            with self._lock:
+                self.stats["failed_attempts"] += 1
+                self._replica_stats.setdefault(
+                    replica_id, {"ok": 0, "fail": 0}
+                )["fail"] += 1
+            raise
+        self.breaker(replica_id).record_success()
+        dt = self._clock() - t0
+        with self._lock:
+            self._latency_s.append(dt)
+            self._replica_stats.setdefault(
+                replica_id, {"ok": 0, "fail": 0}
+            )["ok"] += 1
+        return result
+
+    def _dispatch_sequential(self, payload: Any, order: List[str]) -> Any:
+        """Deterministic failover walk — the chaos-replayable mode."""
+        errors: List[str] = []
+        tried: Set[str] = set()
+        for _ in range(self._config.max_attempts):
+            rid = self._next_allowed(order, tried)
+            if rid is None:
+                break
+            tried.add(rid)
+            try:
+                result = self._attempt(rid, payload)
+            except Exception as e:  # noqa: BLE001 - absorbed by failover
+                errors.append(f"{rid}: {type(e).__name__}: {e}")
+                with self._lock:
+                    self.stats["failovers"] += 1
+                continue
+            return result
+        with self._lock:
+            self.stats["unavailable"] += 1
+        raise FleetUnavailable(
+            f"all attempts failed ({len(errors)}): {'; '.join(errors) or 'no eligible replica'}"
+        )
+
+    def _dispatch_hedged(self, payload: Any, order: List[str]) -> Any:
+        """Concurrent mode: primary attempt, a hedge copy after the
+        p99-derived delay, failover relaunch on failures; first success
+        wins.  Late losers still resolve on the pool and record into
+        their own breakers/stats (all lock-guarded)."""
+        cfg = self._config
+        errors: List[str] = []
+        tried: Set[str] = set()
+        inflight: Dict[Any, str] = {}
+        hedge_futs: Set[Any] = set()
+
+        def _launch(is_hedge: bool) -> bool:
+            rid = self._next_allowed(order, tried)
+            if rid is None or len(tried) >= cfg.max_attempts:
+                return False
+            tried.add(rid)
+            fut = self._pool.submit(self._attempt, rid, payload)
+            inflight[fut] = rid
+            if is_hedge:
+                hedge_futs.add(fut)
+            return True
+
+        if not _launch(is_hedge=False):
+            with self._lock:
+                self.stats["unavailable"] += 1
+            raise FleetUnavailable("no eligible replica (breakers open)")
+        deadline = self._clock() + cfg.request_timeout_s
+        hedge_at = self._clock() + self.hedge_delay_s()
+        hedged = False
+        while inflight:
+            now = self._clock()
+            if now >= deadline:
+                break
+            timeout = (deadline if hedged else min(hedge_at, deadline)) - now
+            done, _ = futures_wait(
+                set(inflight), timeout=max(0.0, timeout),
+                return_when=FIRST_COMPLETED,
+            )
+            if not done:
+                if not hedged and self._clock() >= hedge_at:
+                    hedged = True
+                    if _launch(is_hedge=True):
+                        with self._lock:
+                            self.stats["hedges"] += 1
+                continue
+            for fut in done:
+                rid = inflight.pop(fut)
+                exc = fut.exception()
+                if exc is None:
+                    if fut in hedge_futs:
+                        with self._lock:
+                            self.stats["hedge_wins"] += 1
+                    return fut.result()
+                errors.append(f"{rid}: {type(exc).__name__}: {exc}")
+                with self._lock:
+                    self.stats["failovers"] += 1
+                _launch(is_hedge=False)
+        with self._lock:
+            self.stats["unavailable"] += 1
+        raise FleetUnavailable(
+            f"all attempts failed ({len(errors)}): {'; '.join(errors) or 'request deadline exceeded'}"
+        )
+
+    # --------------------------------------------------------------- shadow
+
+    def _mirror_to_shadows(self, payload: Any, primary_result: Any) -> None:
+        """Mirror a served request to every shadow replica and diff the
+        responses — counters only, the client response is unaffected.
+        Async on the hedge pool when present, inline otherwise."""
+        shadows = self._registry.in_rotation(role=SHADOW)
+        for rid in shadows:
+            if self._pool is not None:
+                self._pool.submit(self._shadow_probe, rid, payload, primary_result)
+            else:
+                self._shadow_probe(rid, payload, primary_result)
+
+    def _shadow_probe(
+        self, replica_id: str, payload: Any, primary_result: Any
+    ) -> None:
+        with self._lock:
+            self.stats["shadow_requests"] += 1
+        try:
+            client = self._registry.client_of(replica_id)
+            shadow_result = client.predict(
+                payload, timeout_s=self._config.request_timeout_s
+            )
+            same = json.dumps(shadow_result, sort_keys=True, default=str) == (
+                json.dumps(primary_result, sort_keys=True, default=str)
+            )
+        except Exception:  # noqa: BLE001 - a failing shadow is a diff
+            same = False
+        if not same:
+            with self._lock:
+                self.stats["shadow_diffs"] += 1
+
+    # ------------------------------------------------------------ lifecycle
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

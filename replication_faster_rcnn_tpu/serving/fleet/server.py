@@ -1,0 +1,147 @@
+"""Stdlib HTTP front for the fleet router (`frcnn fleet`).
+
+The same minimal surface as serving/server.py, one level up: handler
+threads hash the request content and hand it to the
+:class:`~replication_faster_rcnn_tpu.serving.fleet.router.FleetRouter`,
+which owns placement, failover, hedging and caching.  Per-path
+isolation matches the replica server: one failing path costs that one
+entry, the rest of the request still returns detections.
+
+Endpoints:
+  POST /predict  {"paths": ["a.jpg", ...]} or {"path": "a.jpg"} —
+                 per-path detections routed across the fleet; a fleet-
+                 wide inability to serve a path returns 503 with a
+                 Retry-After derived from the breaker cooldown
+  GET  /healthz  fleet liveness: ok while any replica is in rotation,
+                 plus the per-replica registry snapshot
+  GET  /stats    router + per-replica + registry gauges
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import socket
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from replication_faster_rcnn_tpu.faultlib import failpoints
+from replication_faster_rcnn_tpu.serving.fleet.router import (
+    FleetRouter,
+    FleetUnavailable,
+    content_key,
+)
+
+__all__ = ["make_fleet_server"]
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    # the router hangs off the server instance (make_fleet_server)
+
+    def _reply(self, code: int, payload: dict, headers: Optional[dict] = None) -> None:
+        body = json.dumps(payload, indent=2).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *fmt_args):  # quiet: one line per request
+        pass  # noqa: D401 - stdlib signature
+
+    def do_GET(self) -> None:  # noqa: N802 - stdlib casing
+        router: FleetRouter = self.server.router
+        if self.path == "/healthz":
+            snap = router.snapshot()
+            in_rotation = [
+                rid
+                for rid, r in snap["registry"].items()
+                if r["state"] == "healthy"
+            ]
+            self._reply(
+                200,
+                {
+                    "ok": bool(in_rotation),
+                    "draining": bool(getattr(self.server, "draining", False)),
+                    "in_rotation": sorted(in_rotation),
+                    "replicas": snap["registry"],
+                },
+            )
+        elif self.path == "/stats":
+            self._reply(200, router.snapshot())
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 - stdlib casing
+        if self.path != "/predict":
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        # the front shares the replica tier's handler failpoint site, so
+        # one chaos spec can fault either layer of the serving stack
+        try:
+            inj = failpoints.fire("http.handler", path=self.path, tier="fleet")
+        except failpoints.ChaosError as e:
+            self._reply(500, {"error": str(e)})
+            return
+        if inj is not None and inj.kind == "drop":
+            with contextlib.suppress(OSError):
+                self.connection.shutdown(socket.SHUT_RDWR)
+            return
+        router: FleetRouter = self.server.router
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            paths = req.get("paths") or ([req["path"]] if "path" in req else [])
+            if not paths:
+                raise ValueError('need "path" or non-empty "paths"')
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        results, errors = {}, {}
+        unavailable = bad_input = 0
+        for p in paths:
+            try:
+                with open(p, "rb") as fh:  # content hash = file bytes
+                    digest = content_key(fh.read())
+            except OSError as e:
+                bad_input += 1
+                errors[p] = f"{type(e).__name__}: {e}"
+                continue
+            try:
+                results[p] = router.dispatch(p, content_hash=digest)
+            except FleetUnavailable as e:
+                unavailable += 1
+                errors[p] = str(e)
+            except Exception as e:  # noqa: BLE001 - surfaced per path
+                errors[p] = f"{type(e).__name__}: {e}"
+        if results:
+            payload = {"detections": results}
+            if errors:
+                payload["errors"] = errors
+            self._reply(200, payload)
+            return
+        if unavailable:
+            cooldown = self.server.router._config.breaker_cooldown_s
+            self._reply(
+                503,
+                {"error": "fleet unavailable", "errors": errors},
+                headers={"Retry-After": max(1, math.ceil(cooldown))},
+            )
+        elif bad_input == len(paths):
+            self._reply(400, {"error": "; ".join(errors.values())})
+        else:
+            self._reply(500, {"error": "all paths failed", "errors": errors})
+
+
+def make_fleet_server(
+    router: FleetRouter, host: str = "127.0.0.1", port: int = 8010
+) -> ThreadingHTTPServer:
+    """A ready-to-``serve_forever`` front server bound to ``router``.
+    ``port=0`` binds a free port (read ``server.server_address``)."""
+    server = ThreadingHTTPServer((host, port), _FleetHandler)
+    server.router = router
+    server.draining = False
+    return server

@@ -41,7 +41,12 @@ from replication_faster_rcnn_tpu.serving.overload import (
     backoff_delays,
 )
 
-__all__ = ["percentile_ms", "run_closed_loop", "run_open_loop"]
+__all__ = [
+    "percentile_ms",
+    "run_closed_loop",
+    "run_fleet_loop",
+    "run_open_loop",
+]
 
 # generous per-request result deadline: far above any sane serving
 # latency, small enough that a wedged engine fails the run in minutes
@@ -185,6 +190,71 @@ def run_closed_loop(
         counters.latencies, wall, n_requests, mode="closed",
         **_extra(counters, n_requests),
     )
+
+
+def run_fleet_loop(
+    dispatch,
+    requests: Sequence,
+    concurrency: int = 4,
+    timeout_s: float = DEFAULT_TIMEOUT_S,
+) -> Dict[str, Any]:
+    """Closed-loop load over a fleet router: ``concurrency`` client
+    threads each walk their static share of ``requests`` (worker ``k``
+    takes indices ``k, k+K, ...`` — deterministic partition, no shared
+    iterator) calling ``dispatch(payload, content_hash)`` synchronously.
+
+    The headline number is **availability** — the fraction of requests
+    that returned a result, which is what the fleet's failover/hedging
+    machinery is supposed to hold through a replica kill; throughput and
+    latency percentiles ride along.  ``timeout_s`` bounds each worker
+    join, so a wedged fleet costs the run a bounded wait (workers still
+    stuck at the deadline are counted as hung and their remaining
+    requests as failures).
+    """
+    if concurrency < 1:
+        raise ValueError(f"concurrency must be >= 1, got {concurrency}")
+    counters = _Counters()
+    n = len(requests)
+
+    def _worker(start: int) -> None:
+        for i in range(start, n, concurrency):
+            payload, content_hash = requests[i]
+            t0 = time.monotonic()
+            try:
+                dispatch(payload, content_hash)
+            except Exception:  # noqa: BLE001 - tallied as unavailability
+                with counters.lock:
+                    counters.errors += 1
+                continue
+            dt = time.monotonic() - t0
+            with counters.lock:
+                counters.latencies.append(dt)
+
+    threads = [
+        threading.Thread(
+            target=_worker, args=(k,), name=f"fleet-loadgen-{k}"
+        )
+        for k in range(concurrency)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    hung = 0
+    for t in threads:
+        t.join(timeout=timeout_s)
+        if t.is_alive():
+            hung += 1
+    wall = time.monotonic() - t0
+    with counters.lock:
+        ok = len(counters.latencies)
+        errors = counters.errors
+    summary = _summarize(
+        list(counters.latencies), wall, n, mode="fleet",
+        concurrency=concurrency, errors=errors, hung_workers=hung,
+    )
+    summary["ok"] = ok
+    summary["availability"] = round(ok / n, 6) if n else 0.0
+    return summary
 
 
 def run_open_loop(

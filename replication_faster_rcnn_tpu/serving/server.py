@@ -92,19 +92,27 @@ class _Handler(BaseHTTPRequestHandler):
                 {
                     "ok": True,
                     "degraded": engine.degraded,
+                    "degraded_reason": engine.degraded_reason,
+                    "draining": bool(getattr(self.server, "draining", False)),
+                    "replica_id": getattr(self.server, "replica_id", None),
+                    "uptime_s": round(engine.uptime_s(), 3),
+                    "bucket_queue_depths": engine.bucket_queue_depths(),
                     "buckets": [list(b) for b in engine.buckets],
                     "batch_sizes": list(engine.batch_sizes),
                 },
             )
         elif self.path == "/stats":
-            self._reply(
-                200,
-                {
-                    "stats": dict(engine.stats),
-                    "queue_depth": engine.queue_depth(),
-                    "compile_seconds": dict(engine.compile_seconds),
-                },
-            )
+            payload = {
+                "stats": dict(engine.stats),
+                "queue_depth": engine.queue_depth(),
+                "bucket_queue_depths": engine.bucket_queue_depths(),
+                "compile_seconds": dict(engine.compile_seconds),
+            }
+            if engine.deadline_controller is not None:
+                payload["adaptive_delay_ms"] = (
+                    engine.deadline_controller.delays_ms()
+                )
+            self._reply(200, payload)
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -188,7 +196,18 @@ class _Handler(BaseHTTPRequestHandler):
                 },
             )
         elif timed_out:
-            self._reply(504, {"error": "request deadline exceeded", "errors": errors})
+            # 504 carries Retry-After too: a deadline miss means the
+            # replica is saturated right now, same as a shed — tell the
+            # client when the queue should have turned over
+            self._reply(
+                504,
+                {"error": "request deadline exceeded", "errors": errors},
+                headers={
+                    "Retry-After": retry_after_s(
+                        engine.config.serving.max_delay_ms
+                    )
+                },
+            )
         elif bad_input == len(paths):
             self._reply(400, {"error": "; ".join(errors.values())})
         else:
@@ -200,11 +219,18 @@ def make_server(
     host: str = "127.0.0.1",
     port: int = 8008,
     score_thresh: Optional[float] = None,
+    replica_id: Optional[str] = None,
 ) -> ThreadingHTTPServer:
     """A ready-to-``serve_forever`` HTTP server bound to ``engine``.
-    ``port=0`` binds a free port (read ``server.server_address``)."""
+    ``port=0`` binds a free port (read ``server.server_address``).
+    ``replica_id`` names this replica in /healthz for fleet membership;
+    setting ``server.draining = True`` (the SIGTERM grace window) makes
+    /healthz advertise it so the fleet router stops routing here before
+    the listener closes."""
     server = ThreadingHTTPServer((host, port), _Handler)
     server.engine = engine
+    server.replica_id = replica_id
+    server.draining = False
     server.score_thresh = (
         engine.config.eval.score_thresh if score_thresh is None else score_thresh
     )

@@ -3,7 +3,9 @@
 Input is whatever a telemetry-enabled run left behind:
 ``trace.json`` (Chrome-trace spans), ``metrics.jsonl`` (MetricLogger
 rows, now including the health scalars), ``watchdog.jsonl`` (stall
-incidents), ``progress.json`` (last heartbeat). All optional — the
+incidents), ``progress.json`` (last heartbeat), ``fleet.jsonl``
+(fleet-router snapshots from ``frcnn fleet --telemetry``). All
+optional — the
 report covers what exists. Pure stdlib on purpose: the ``telemetry``
 CLI subcommand must work on a laptop holding only the artifacts,
 without importing jax.
@@ -21,6 +23,7 @@ TRACE_FILE = "trace.json"
 METRICS_FILE = "metrics.jsonl"
 WATCHDOG_FILE = "watchdog.jsonl"
 PROGRESS_FILE = "progress.json"
+FLEET_FILE = "fleet.jsonl"
 
 # Multi-process runs write the coordinator's artifacts under the plain
 # names above and every other rank's under ``<stem>.rank<N>.<ext>``
@@ -233,6 +236,14 @@ def summarize_run(run_dir: str) -> Dict[str, Any]:
             "events": incidents,
         }
 
+    fleet_path = os.path.join(run_dir, FLEET_FILE)
+    if os.path.exists(fleet_path):
+        snaps = load_jsonl(fleet_path)
+        if snaps:
+            summary["artifacts"].append(FLEET_FILE)
+            # snapshots append over restarts; the last one is current
+            summary["fleet"] = snaps[-1]
+
     progress_files = rank_variants(run_dir, PROGRESS_FILE)
     if progress_files:
         by_rank: Dict[int, Dict[str, Any]] = {}
@@ -335,6 +346,34 @@ def format_report(summary: Dict[str, Any]) -> str:
                 f"  stall at step={ev.get('last_step')} phase={ev.get('last_phase')} "
                 f"after {ev.get('elapsed_since_progress_s')}s "
                 f"(last span: {span.get('name') if isinstance(span, dict) else span})"
+            )
+
+    fleet = summary.get("fleet")
+    if fleet is not None:
+        router = fleet.get("router", {})
+        lines.append("")
+        n = router.get("requests", 0)
+        lines.append(
+            f"fleet router (from {FLEET_FILE}): {n} request(s), "
+            f"{router.get('cache_hits', 0)} cache hit(s), "
+            f"{router.get('failovers', 0)} failover(s), "
+            f"{router.get('hedges', 0)} hedge(s) "
+            f"({router.get('hedge_wins', 0)} won), "
+            f"{router.get('unavailable', 0)} unavailable"
+        )
+        for rid, rep in sorted(fleet.get("registry", {}).items()):
+            per = fleet.get("replicas", {}).get(rid, {})
+            breaker = per.get("breaker", {})
+            lines.append(
+                f"  {rid:<14} {rep.get('state', '?'):<9} "
+                f"role={rep.get('role', '?'):<8} "
+                f"ok={per.get('ok', 0):<6} fail={per.get('fail', 0):<5} "
+                f"breaker={breaker.get('state', '?')}"
+                + (
+                    f" ({breaker.get('opens')} open(s))"
+                    if breaker.get("opens")
+                    else ""
+                )
             )
 
     progress = summary.get("progress")

@@ -1,0 +1,735 @@
+"""Serving fleet (ISSUE 14 tentpole): health-checked router with
+failover, hedging, circuit breakers, and chaos-drilled availability.
+
+Everything here is pure host code — no JAX compiles.  Injected clocks
+drive the breaker cooldowns and registry leases deterministically;
+LocalReplicaClients stand in for replica processes (their ``kill()``
+switch is the process death the self-healing machinery must absorb).
+The HTTP layer runs the real fleet front (serving/fleet/server.py) over
+local clients, and the fleet_profile gate arithmetic + banked record are
+checked the same way the serving_profile gate is.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from replication_faster_rcnn_tpu.config import FleetConfig
+from replication_faster_rcnn_tpu.faultlib import failpoints
+from replication_faster_rcnn_tpu.serving.fleet import (
+    CircuitBreaker,
+    FleetRouter,
+    FleetUnavailable,
+    HashRing,
+    LocalReplicaClient,
+    Prober,
+    ReplicaDown,
+    ReplicaRegistry,
+    make_fleet_server,
+)
+from replication_faster_rcnn_tpu.serving.fleet.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+)
+from replication_faster_rcnn_tpu.serving.fleet.registry import (
+    CANARY,
+    DEAD,
+    DRAINING,
+    HEALTHY,
+    JOINING,
+    SHADOW,
+)
+from replication_faster_rcnn_tpu.serving.fleet.router import content_key
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(**kw):
+    kw.setdefault("probe_interval_s", 0.5)
+    kw.setdefault("lease_timeout_s", 1.2)
+    kw.setdefault("rejoin_probes", 2)
+    kw.setdefault("hedge", False)  # sequential dispatch: deterministic
+    kw.setdefault("canary_fraction", 0.0)
+    return FleetConfig(**kw)
+
+
+# --------------------------------------------------------- circuit breaker
+
+
+class TestCircuitBreaker:
+    def _cb(self, **kw):
+        now = [0.0]
+        kw.setdefault("threshold", 3)
+        kw.setdefault("cooldown_s", 1.0)
+        return CircuitBreaker(clock=lambda: now[0], **kw), now
+
+    def test_opens_after_consecutive_failures_only(self):
+        cb, _ = self._cb()
+        cb.record_failure()
+        cb.record_failure()
+        cb.record_success()  # streak broken: 2 + success must not open
+        cb.record_failure()
+        cb.record_failure()
+        assert cb.state == CLOSED and cb.allow()
+        cb.record_failure()  # third consecutive
+        assert cb.state == OPEN and not cb.allow()
+        assert cb.snapshot()["opens"] == 1
+
+    def test_half_open_hands_out_single_trial_slot(self):
+        cb, now = self._cb()
+        for _ in range(3):
+            cb.record_failure()
+        assert not cb.allow()
+        now[0] = 1.0  # cooldown elapsed: lazy decay to HALF_OPEN
+        assert cb.state == HALF_OPEN
+        assert cb.allow() is True  # first caller claims the trial
+        assert cb.allow() is False  # concurrent caller refused
+        cb.record_success()
+        assert cb.state == CLOSED and cb.allow()
+
+    def test_failed_trial_reopens_and_restarts_cooldown(self):
+        cb, now = self._cb()
+        for _ in range(3):
+            cb.record_failure()
+        now[0] = 1.0
+        assert cb.allow()
+        cb.record_failure()  # trial failed
+        assert cb.state == OPEN and not cb.allow()
+        now[0] = 1.9  # cooldown restarted at t=1.0: not yet
+        assert not cb.allow()
+        now[0] = 2.0
+        assert cb.allow()
+        assert cb.snapshot()["opens"] == 2
+
+    def test_open_failures_do_not_deepen_outage(self):
+        cb, now = self._cb()
+        for _ in range(5):
+            cb.record_failure()  # extra failures while OPEN: no-ops
+        now[0] = 1.0
+        assert cb.state == HALF_OPEN  # one cooldown, not several
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError, match="threshold"):
+            CircuitBreaker(threshold=0)
+        with pytest.raises(ValueError, match="cooldown_s"):
+            CircuitBreaker(cooldown_s=0)
+
+
+# -------------------------------------------------------------- hash ring
+
+
+class TestHashRing:
+    def test_ordered_walk_covers_each_node_once(self):
+        ring = HashRing(["a", "b", "c"], vnodes=16)
+        order = ring.ordered("some-key")
+        assert sorted(order) == ["a", "b", "c"]
+        assert len(order) == len(set(order))
+
+    def test_placement_is_deterministic(self):
+        r1 = HashRing(["a", "b", "c"])
+        r2 = HashRing(["c", "b", "a"])  # membership order must not matter
+        for i in range(32):
+            assert r1.ordered(f"k{i}") == r2.ordered(f"k{i}")
+
+    def test_node_removal_moves_only_its_keys(self):
+        before = HashRing(["a", "b", "c"])
+        after = HashRing(["a", "b"])
+        keys = [f"key-{i}" for i in range(200)]
+        for k in keys:
+            owner = before.ordered(k)[0]
+            if owner != "c":
+                # consistent hashing's contract: survivors keep their keys
+                assert after.ordered(k)[0] == owner
+
+    def test_failover_order_is_the_walk(self):
+        ring = HashRing(["a", "b", "c"])
+        for i in range(16):
+            order = ring.ordered(f"k{i}")
+            # the walk past the owner is the failover order — stable and
+            # distinct, so retries never revisit the failed owner
+            assert order[0] not in order[1:]
+
+    def test_empty_ring_and_validation(self):
+        assert HashRing([]).ordered("k") == []
+        with pytest.raises(ValueError, match="vnodes"):
+            HashRing(["a"], vnodes=0)
+
+
+# --------------------------------------------------------------- registry
+
+
+def _registry(clients, clock, **cfg_kw):
+    reg = ReplicaRegistry(_cfg(**cfg_kw), clock=clock)
+    for rid, c in clients.items():
+        reg.add(rid, c)
+    return reg
+
+
+class TestReplicaRegistry:
+    def test_join_requires_consecutive_ok_probes(self):
+        now = [0.0]
+        reg = _registry({"r0": LocalReplicaClient("r0", lambda p: p)},
+                        lambda: now[0])
+        assert reg.state_of("r0") == JOINING
+        reg.probe_once()
+        assert reg.state_of("r0") == JOINING  # 1 of 2
+        assert reg.in_rotation() == []
+        reg.probe_once()
+        assert reg.state_of("r0") == HEALTHY
+        assert reg.in_rotation() == ["r0"]
+        assert any(e["event"] == "replica_joined" for e in reg.events())
+
+    def test_lease_expires_without_successful_probes(self):
+        now = [0.0]
+        client = LocalReplicaClient("r0", lambda p: p)
+        reg = _registry({"r0": client}, lambda: now[0])
+        reg.probe_once(), reg.probe_once()
+        client.kill()
+        now[0] = 0.5
+        reg.probe_once()  # failed probe: lease NOT renewed
+        assert reg.state_of("r0") == HEALTHY  # not stale yet
+        now[0] = 1.3  # past lease_timeout_s since last_ok at t=0
+        reg.probe_once()
+        assert reg.state_of("r0") == DEAD
+        assert reg.in_rotation() == []
+        assert any(
+            e["event"] == "replica_lease_expired" for e in reg.events()
+        )
+
+    def test_in_rotation_applies_staleness_without_a_probe(self):
+        """A stalled prober must not keep a dead replica in rotation —
+        the read side ages leases too."""
+        now = [0.0]
+        reg = _registry({"r0": LocalReplicaClient("r0", lambda p: p)},
+                        lambda: now[0])
+        reg.probe_once(), reg.probe_once()
+        assert reg.in_rotation() == ["r0"]
+        now[0] = 5.0  # no probes at all since t=0
+        assert reg.in_rotation() == []
+        assert reg.state_of("r0") == DEAD
+
+    def test_dead_replica_rejoins_after_consecutive_oks(self):
+        now = [0.0]
+        client = LocalReplicaClient("r0", lambda p: p)
+        reg = _registry({"r0": client}, lambda: now[0])
+        reg.probe_once(), reg.probe_once()
+        client.kill()
+        now[0] = 2.0
+        reg.probe_once()
+        assert reg.state_of("r0") == DEAD
+        client.revive()
+        reg.probe_once()
+        assert reg.state_of("r0") == DEAD  # 1 of 2: flap protection
+        reg.probe_once()
+        assert reg.state_of("r0") == HEALTHY
+
+    def test_draining_and_degraded_park_but_renew_lease(self):
+        now = [0.0]
+        health = {"ok": True}
+        reg = _registry(
+            {"r0": LocalReplicaClient("r0", lambda p: p, lambda: dict(health))},
+            lambda: now[0],
+        )
+        reg.probe_once(), reg.probe_once()
+        health["draining"] = True
+        now[0] = 1.0
+        reg.probe_once()
+        assert reg.state_of("r0") == DRAINING
+        assert reg.in_rotation() == []
+        # lease renewed at t=1.0: staying DRAINING, never DEAD
+        now[0] = 2.0
+        reg.probe_once()
+        assert reg.state_of("r0") == DRAINING
+        assert reg.snapshot()["r0"]["detail"] == "draining"
+        # degraded parks the same way, with the reason as detail
+        health.pop("draining")
+        health.update(degraded=True, degraded_reason="flush failures")
+        reg.probe_once()
+        assert reg.snapshot()["r0"]["detail"] == "flush failures"
+        # back to clean: the rejoin gate applies (2 consecutive oks)
+        health.pop("degraded"), health.pop("degraded_reason")
+        reg.probe_once()
+        assert reg.state_of("r0") == DRAINING
+        reg.probe_once()
+        assert reg.state_of("r0") == HEALTHY
+
+    def test_probe_failpoint_is_a_failed_probe(self):
+        now = [0.0]
+        reg = _registry({"r0": LocalReplicaClient("r0", lambda p: p)},
+                        lambda: now[0])
+        failpoints.configure([
+            failpoints.Rule("router.probe", "ioerror", 1.0, 7, max_fires=1)
+        ])
+        try:
+            reg.probe_once()  # injected: counts as failed, lease ages
+            assert reg.snapshot()["r0"]["failed_probes"] == 1
+            assert "ChaosError" in reg.snapshot()["r0"]["detail"]
+            reg.probe_once(), reg.probe_once()
+            assert reg.state_of("r0") == HEALTHY
+        finally:
+            failpoints.disarm()
+
+    def test_add_validates_role_and_duplicates(self):
+        reg = ReplicaRegistry(_cfg())
+        reg.add("r0", LocalReplicaClient("r0", lambda p: p))
+        with pytest.raises(ValueError, match="already registered"):
+            reg.add("r0", LocalReplicaClient("r0", lambda p: p))
+        with pytest.raises(ValueError, match="role"):
+            reg.add("r1", LocalReplicaClient("r1", lambda p: p), role="boss")
+
+    def test_prober_thread_probes_on_cadence_and_stops_clean(self):
+        reg = ReplicaRegistry(_cfg(probe_interval_s=0.01,
+                                   lease_timeout_s=1.0))
+        reg.add("r0", LocalReplicaClient("r0", lambda p: p))
+        with Prober(reg, interval_s=0.01) as prober:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if reg.state_of("r0") == HEALTHY:
+                    break
+                time.sleep(0.005)
+            assert reg.state_of("r0") == HEALTHY
+        assert not prober._thread.is_alive()
+
+
+# ----------------------------------------------------------------- router
+
+
+def _fleet(clients, clock=None, **cfg_kw):
+    cfg = _cfg(**cfg_kw)
+    clock = clock or time.monotonic
+    reg = ReplicaRegistry(cfg, clock=clock)
+    for rid, c in clients.items():
+        role = CANARY if rid.startswith("canary") else (
+            SHADOW if rid.startswith("shadow") else "serving"
+        )
+        reg.add(rid, c, role=role)
+    for _ in range(cfg.rejoin_probes):
+        reg.probe_once()
+    router = FleetRouter(
+        reg, cfg, clock=clock,
+        kill_hook=lambda rid: clients[rid].kill(),
+    )
+    return reg, router
+
+
+class TestFleetRouter:
+    def test_failover_serves_through_a_dead_replica(self):
+        clients = {
+            rid: LocalReplicaClient(rid, lambda p, rid=rid: (rid, p))
+            for rid in ("r0", "r1", "r2")
+        }
+        reg, router = _fleet(clients)
+        primary = router.candidates("img")[0]
+        clients[primary].kill()
+        rid, payload = router.dispatch("x", content_hash="img")
+        assert rid != primary and payload == "x"
+        assert router.stats["failovers"] == 1
+        assert router.snapshot()["replicas"][primary]["fail"] == 1
+
+    def test_breaker_opens_and_skips_dead_replica_without_attempts(self):
+        now = [0.0]
+        clients = {
+            rid: LocalReplicaClient(rid, lambda p, rid=rid: rid)
+            for rid in ("r0", "r1")
+        }
+        reg, router = _fleet(clients, clock=lambda: now[0],
+                             breaker_threshold=2, breaker_cooldown_s=10.0)
+        victims = [c for c in clients.values()]
+        clients["r0"].kill()
+        keys = [f"k{i}" for i in range(8)]
+        r0_keys = [k for k in keys if router.candidates(k)[0] == "r0"]
+        assert r0_keys, "no key hashed to r0 — widen the key set"
+        for k in r0_keys:
+            assert router.dispatch(k, content_hash=k) == "r1"
+        assert router.breaker("r0").state == OPEN
+        attempts_before = router.stats["attempts"]
+        # an open breaker refuses locally: dispatch goes straight to r1
+        router.dispatch("again", content_hash=r0_keys[0] + "x")
+        assert router.stats["attempts"] <= attempts_before + 1
+
+    def test_half_open_probe_readmits_recovered_replica(self):
+        now = [0.0]
+        clients = {
+            rid: LocalReplicaClient(rid, lambda p, rid=rid: rid)
+            for rid in ("r0", "r1")
+        }
+        reg, router = _fleet(clients, clock=lambda: now[0],
+                             breaker_threshold=1, breaker_cooldown_s=1.0,
+                             cache_entries=0, lease_timeout_s=100.0)
+        clients["r0"].kill()
+        k = next(k for k in (f"k{i}" for i in range(32))
+                 if router.candidates(k)[0] == "r0")
+        router.dispatch(k, content_hash=k)  # opens r0's breaker
+        assert router.breaker("r0").state == OPEN
+        clients["r0"].revive()
+        now[0] = 1.5  # cooldown elapsed: half-open trial allowed
+        assert router.dispatch(k + "b", content_hash=k) == "r0"
+        assert router.breaker("r0").state == CLOSED
+
+    def test_cache_hit_short_circuits_and_lru_evicts(self):
+        calls = []
+        clients = {"r0": LocalReplicaClient(
+            "r0", lambda p: calls.append(p) or len(calls))}
+        reg, router = _fleet(clients, cache_entries=2)
+        assert router.dispatch("a", content_hash="ha") == 1
+        assert router.dispatch("a", content_hash="ha") == 1  # cached
+        assert router.stats["cache_hits"] == 1 and len(calls) == 1
+        router.dispatch("b", content_hash="hb")
+        router.dispatch("a", content_hash="ha")  # refresh ha's recency
+        router.dispatch("c", content_hash="hc")  # evicts hb (LRU)
+        assert router.stats["cache_hits"] == 2
+        router.dispatch("b", content_hash="hb")  # must re-dispatch
+        assert calls == ["a", "b", "c", "b"]
+
+    def test_dispatch_failpoint_drop_kills_via_hook_and_fails_over(self):
+        clients = {
+            rid: LocalReplicaClient(rid, lambda p, rid=rid: rid)
+            for rid in ("r0", "r1", "r2")
+        }
+        reg, router = _fleet(clients)
+        victim = router.candidates("img")[0]
+        failpoints.configure([
+            failpoints.Rule("router.dispatch", "drop", 1.0, 3, max_fires=1)
+        ])
+        try:
+            served_by = router.dispatch("x", content_hash="img")
+        finally:
+            failpoints.disarm()
+        assert clients[victim].killed  # the kill hook made the drop real
+        assert served_by != victim
+        assert router.stats["failovers"] == 1
+
+    def test_unavailable_when_every_replica_is_down(self):
+        clients = {
+            rid: LocalReplicaClient(rid, lambda p: p) for rid in ("r0", "r1")
+        }
+        reg, router = _fleet(clients)
+        for c in clients.values():
+            c.kill()
+        with pytest.raises(FleetUnavailable, match="all attempts failed"):
+            router.dispatch("x", content_hash="img")
+        assert router.stats["unavailable"] == 1
+
+    def test_unavailable_when_rotation_is_empty(self):
+        now = [0.0]
+        clients = {"r0": LocalReplicaClient("r0", lambda p: p)}
+        reg, router = _fleet(clients, clock=lambda: now[0])
+        now[0] = 100.0  # lease long stale: rotation empties
+        with pytest.raises(FleetUnavailable, match="no replicas"):
+            router.dispatch("x", content_hash="img")
+
+    def test_canary_takes_a_deterministic_content_slice(self):
+        clients = {
+            "r0": LocalReplicaClient("r0", lambda p: "r0"),
+            "canary0": LocalReplicaClient("canary0", lambda p: "canary0"),
+        }
+        reg, router = _fleet(clients, canary_fraction=0.5)
+        hashes = [content_key(f"img-{i}".encode()) for i in range(64)]
+        first = {h: router.candidates(h)[0] for h in hashes}
+        hit = [h for h, rid in first.items() if rid == "canary0"]
+        # a 50% deterministic split lands strictly between none and all
+        assert 0 < len(hit) < len(hashes)
+        assert {router.candidates(h)[0] for h in hit} == {"canary0"}
+        for h in hit:
+            assert router.dispatch("x", content_hash=h) == "canary0"
+        assert router.stats["canary_requests"] == len(hit)
+
+    def test_canary_fraction_zero_routes_nothing_to_canary(self):
+        clients = {
+            "r0": LocalReplicaClient("r0", lambda p: "r0"),
+            "canary0": LocalReplicaClient("canary0", lambda p: "canary0"),
+        }
+        reg, router = _fleet(clients, canary_fraction=0.0)
+        for i in range(32):
+            h = content_key(f"img-{i}".encode())
+            assert router.dispatch("x", content_hash=h) == "r0"
+        assert router.stats["canary_requests"] == 0
+
+    def test_shadow_mirrors_and_counts_diffs_without_affecting_result(self):
+        clients = {
+            "r0": LocalReplicaClient("r0", lambda p: {"det": p}),
+            "shadow0": LocalReplicaClient("shadow0", lambda p: {"det": p}),
+        }
+        reg, router = _fleet(clients, cache_entries=0)
+        assert router.dispatch("x", content_hash="h1") == {"det": "x"}
+        assert router.stats["shadow_requests"] == 1
+        assert router.stats["shadow_diffs"] == 0
+        # shadow disagrees: counted, client result untouched
+        clients["shadow0"]._predict_fn = lambda p: {"det": "WRONG"}
+        assert router.dispatch("y", content_hash="h2") == {"det": "y"}
+        assert router.stats["shadow_diffs"] == 1
+        # a dead shadow is a diff too, never an error
+        clients["shadow0"].kill()
+        assert router.dispatch("z", content_hash="h3") == {"det": "z"}
+        assert router.stats["shadow_diffs"] == 2
+
+    def test_snapshot_shape(self):
+        clients = {"r0": LocalReplicaClient("r0", lambda p: p)}
+        reg, router = _fleet(clients)
+        router.dispatch("x", content_hash="h")
+        snap = router.snapshot()
+        assert snap["router"]["requests"] == 1
+        assert snap["replicas"]["r0"]["ok"] == 1
+        assert snap["registry"]["r0"]["state"] == HEALTHY
+        assert "hedge_delay_ms" in snap["router"]
+
+
+class TestHedgedDispatch:
+    def test_hedge_fires_after_delay_and_faster_replica_wins(self):
+        release = threading.Event()
+
+        def slow(p):
+            release.wait(10)
+            return "slow"
+
+        clients = {
+            "fast": LocalReplicaClient("fast", lambda p: "fast"),
+            "slow": LocalReplicaClient("slow", slow),
+        }
+        cfg_kw = dict(hedge=True, hedge_floor_ms=20.0, hedge_ceiling_ms=20.0,
+                      request_timeout_s=10.0, cache_entries=0)
+        reg, router = _fleet(clients, **cfg_kw)
+        try:
+            k = next(k for k in (f"k{i}" for i in range(32))
+                     if router.candidates(k)[0] == "slow")
+            assert router.dispatch("x", content_hash=k) == "fast"
+            assert router.stats["hedges"] == 1
+            assert router.stats["hedge_wins"] == 1
+        finally:
+            release.set()
+            router.close()
+
+    def test_hedged_failover_still_serves_on_primary_error(self):
+        clients = {
+            rid: LocalReplicaClient(rid, lambda p, rid=rid: rid)
+            for rid in ("r0", "r1")
+        }
+        cfg_kw = dict(hedge=True, request_timeout_s=10.0, cache_entries=0)
+        reg, router = _fleet(clients, **cfg_kw)
+        try:
+            k = next(k for k in (f"k{i}" for i in range(32))
+                     if router.candidates(k)[0] == "r0")
+            clients["r0"].kill()
+            assert router.dispatch("x", content_hash=k) == "r1"
+            assert router.stats["failovers"] == 1
+        finally:
+            router.close()
+
+    def test_hedge_delay_derives_from_p99_with_clamps(self):
+        clients = {"r0": LocalReplicaClient("r0", lambda p: p)}
+        now = [0.0]
+        reg, router = _fleet(
+            clients, clock=lambda: now[0], hedge=True,
+            hedge_multiplier=2.0, hedge_floor_ms=10.0,
+            hedge_ceiling_ms=1000.0, cache_entries=0,
+        )
+        try:
+            # no samples yet: hedge conservatively at the ceiling
+            assert router.hedge_delay_s() == 1.0
+            with router._lock:
+                router._latency_s.extend([0.05] * 100)
+            # 2.0 x 50ms p99 = 100ms, inside the clamps
+            assert router.hedge_delay_s() == pytest.approx(0.1)
+            with router._lock:
+                router._latency_s.clear()
+                router._latency_s.extend([0.001] * 100)
+            assert router.hedge_delay_s() == pytest.approx(0.01)  # floor
+        finally:
+            router.close()
+
+
+# ------------------------------------------------------------- HTTP front
+
+
+def _fleet_http(clients, tmp_path, **cfg_kw):
+    cfg_kw.setdefault("breaker_cooldown_s", 2.0)
+    reg, router = _fleet(clients, **cfg_kw)
+    server = make_fleet_server(router, port=0)
+    host, port = server.server_address[:2]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, router, f"http://{host}:{port}"
+
+
+def _post(base, payload, timeout=30):
+    req = urllib.request.Request(
+        f"{base}/predict",
+        data=json.dumps(payload).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+class TestFleetHTTP:
+    def test_predict_routes_by_content_hash_with_per_path_isolation(
+        self, tmp_path
+    ):
+        clients = {
+            rid: LocalReplicaClient(rid, lambda p, rid=rid: [rid, str(p)])
+            for rid in ("r0", "r1")
+        }
+        server, router, base = _fleet_http(clients, tmp_path)
+        good = str(tmp_path / "a.bin")
+        with open(good, "wb") as f:
+            f.write(b"image-bytes-a")
+        missing = str(tmp_path / "missing.bin")
+        try:
+            status, body, _ = _post(base, {"paths": [good, missing]})
+            assert status == 200
+            assert body["detections"][good][1] == good
+            assert missing in body["errors"]
+            status, body, _ = _post(base, {"path": good})
+            assert status == 200  # cache or re-dispatch: same answer
+            assert body["detections"][good][1] == good
+        finally:
+            server.shutdown()
+            server.server_close()
+            router.close()
+
+    def test_healthz_reports_rotation_and_stats_report_router(self, tmp_path):
+        clients = {"r0": LocalReplicaClient("r0", lambda p: p)}
+        server, router, base = _fleet_http(clients, tmp_path)
+        try:
+            with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+                health = json.loads(r.read())
+            assert health["ok"] is True
+            assert health["in_rotation"] == ["r0"]
+            assert health["replicas"]["r0"]["state"] == HEALTHY
+            with urllib.request.urlopen(f"{base}/stats", timeout=10) as r:
+                stats = json.loads(r.read())
+            assert "requests" in stats["router"]
+        finally:
+            server.shutdown()
+            server.server_close()
+            router.close()
+
+    def test_all_replicas_down_returns_503_with_retry_after(self, tmp_path):
+        clients = {"r0": LocalReplicaClient("r0", lambda p: p)}
+        server, router, base = _fleet_http(clients, tmp_path)
+        p = str(tmp_path / "a.bin")
+        with open(p, "wb") as f:
+            f.write(b"x")
+        try:
+            clients["r0"].kill()
+            status, body, headers = _post(base, {"path": p})
+            assert status == 503
+            assert int(headers["Retry-After"]) >= 1
+            assert "unavailable" in body["error"]
+        finally:
+            server.shutdown()
+            server.server_close()
+            router.close()
+
+    def test_bad_request_shapes_return_400(self, tmp_path):
+        clients = {"r0": LocalReplicaClient("r0", lambda p: p)}
+        server, router, base = _fleet_http(clients, tmp_path)
+        try:
+            status, body, _ = _post(base, {})
+            assert status == 400
+            missing = str(tmp_path / "nope.bin")
+            status, body, _ = _post(base, {"paths": [missing]})
+            assert status == 400  # unreadable content: client error
+        finally:
+            server.shutdown()
+            server.server_close()
+            router.close()
+
+
+# --------------------------------------------------- fleet_profile gate
+
+
+class TestFleetProfileGate:
+    @pytest.fixture()
+    def fp(self):
+        sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+        try:
+            import fleet_profile
+        finally:
+            sys.path.pop(0)
+        return fleet_profile
+
+    def _record(self, fp, **kw):
+        rec = {
+            "schema": fp.SCHEMA,
+            fp.GATE_KEY: 500.0,
+            "single_images_per_sec": 200.0,
+            "availability": 1.0,
+            "speedup": 2.5,
+            "victim_killed": True,
+            "victim_dead_after_run": True,
+            "victim_rejoined": True,
+            "failovers": 2,
+            "hedge": {"hedges": 3, "hedge_wins": 2},
+            "fleet": {"errors": 0, "n_requests": 240},
+        }
+        rec.update(kw)
+        return rec
+
+    def test_availability_floor_enforced(self, fp):
+        cur = self._record(fp, availability=0.99)
+        failures, _ = fp.check_regression(cur, None)
+        assert any("availability" in f for f in failures)
+
+    def test_speedup_floor_enforced(self, fp):
+        cur = self._record(fp, speedup=1.5)
+        failures, _ = fp.check_regression(cur, None)
+        assert any("speedup" in f for f in failures)
+
+    def test_structural_flags_each_fail_the_gate(self, fp):
+        for key in ("victim_killed", "victim_dead_after_run",
+                    "victim_rejoined"):
+            cur = self._record(fp, **{key: False})
+            failures, _ = fp.check_regression(cur, None)
+            assert any(key in f for f in failures), key
+        failures, _ = fp.check_regression(self._record(fp, failovers=0), None)
+        assert any("failover" in f for f in failures)
+        cur = self._record(fp, hedge={"hedges": 3, "hedge_wins": 0})
+        failures, _ = fp.check_regression(cur, None)
+        assert any("hedge" in f for f in failures)
+
+    def test_regression_beyond_tol_fails_and_slip_warns(self, fp):
+        banked = self._record(fp)
+        cur = self._record(fp, **{fp.GATE_KEY: 500.0 * 0.70})
+        failures, _ = fp.check_regression(cur, banked, tol=0.25)
+        assert any("regressed" in f for f in failures)
+        cur = self._record(fp, **{fp.GATE_KEY: 500.0 * 0.85})
+        failures, warnings = fp.check_regression(cur, banked, tol=0.25)
+        assert not failures and any("slipping" in w for w in warnings)
+
+    def test_schema_mismatch_skips_comparison(self, fp):
+        banked = self._record(fp, schema="fleet_profile/v0")
+        cur = self._record(fp, **{fp.GATE_KEY: 1.0})
+        failures, warnings = fp.check_regression(cur, banked)
+        assert not failures and any("schema" in w for w in warnings)
+
+    def test_clean_run_passes(self, fp):
+        failures, warnings = fp.check_regression(
+            self._record(fp), self._record(fp)
+        )
+        assert failures == [] and warnings == []
+
+    def test_banked_record_meets_acceptance(self, fp):
+        path = fp.record_path(fp.record_key("sim3r240s4"))
+        assert os.path.exists(path), (
+            "fleet_profile record not banked — run "
+            "`python benchmarks/fleet_profile.py --update`"
+        )
+        banked = fp.load_record(path)
+        assert banked["schema"] == fp.SCHEMA
+        failures, _ = fp.check_regression(banked, None)
+        assert failures == []
+        assert banked["availability"] >= fp.DEFAULT_MIN_AVAILABILITY
+        assert banked["speedup"] >= fp.DEFAULT_MIN_SPEEDUP

@@ -236,6 +236,63 @@ class TestEngineOverload:
         finally:
             engine.close()
 
+    def test_sub_threshold_errors_with_success_never_latch(self, parts):
+        """ISSUE 14 satellite edge case: the 3-strike counter counts
+        CONSECUTIVE failures — (threshold - 1) errors followed by a
+        success must reset the streak, and the same dance repeated must
+        never trip the flag."""
+        knobs = _Knobs()
+        engine, _ = _make_engine(parts, knobs)
+        for round_i in range(3):
+            knobs.fail = True
+            for i in range(DEGRADED_AFTER - 1):
+                fut = engine.submit(_image(i))
+                with pytest.raises(RuntimeError):
+                    fut.result(timeout=30)
+            assert engine.degraded is False, f"latched in round {round_i}"
+            knobs.fail = False
+            engine.submit(_image(0)).result(timeout=30)
+            assert engine.degraded is False
+            assert engine.degraded_reason is None
+        assert engine.stats["flush_errors"] == 3 * (DEGRADED_AFTER - 1)
+        engine.close()
+
+    def test_degraded_reason_names_streak_and_last_error(self, parts):
+        knobs = _Knobs()
+        knobs.fail = True
+        engine, _ = _make_engine(parts, knobs)
+        try:
+            assert engine.degraded_reason is None
+            for i in range(DEGRADED_AFTER):
+                with pytest.raises(RuntimeError):
+                    engine.submit(_image(i)).result(timeout=30)
+            reason = engine.degraded_reason
+            assert f"{DEGRADED_AFTER} consecutive" in reason
+            assert "injected dispatch failure" in reason
+            knobs.fail = False
+            engine.submit(_image(0)).result(timeout=30)
+            assert engine.degraded_reason is None
+        finally:
+            engine.close()
+
+    def test_bucket_queue_depths_and_uptime_gauges(self, parts):
+        knobs = _Knobs()
+        knobs.delay_s = 0.3
+        engine, _ = _make_engine(parts, knobs, queue_depth=8)
+        try:
+            assert engine.bucket_queue_depths() == {}
+            futs = [engine.submit(_image(i)) for i in range(3)]
+            depths = engine.bucket_queue_depths()
+            # everything in flight sits under the single 32x32 bucket
+            assert set(depths) <= {"32x32"}
+            assert engine.uptime_s() >= 0.0
+        finally:
+            knobs.delay_s = 0.0
+            for f in futs:
+                f.result(timeout=30)
+            engine.close()
+        assert engine.bucket_queue_depths() == {}
+
 
 # ------------------------------------------------------------- HTTP level
 
@@ -317,7 +374,9 @@ class TestHTTPOverload:
             server.server_close()
             engine.close()
 
-    def test_deadline_exceeded_maps_to_504(self, parts, tmp_path):
+    def test_deadline_exceeded_maps_to_504_with_retry_after(
+        self, parts, tmp_path
+    ):
         knobs = _Knobs()
         knobs.delay_s = 0.5
         engine, _ = _make_engine(
@@ -325,14 +384,69 @@ class TestHTTPOverload:
         )
         server, base = _serve(engine)
         try:
-            status, body, _ = _post(
+            status, body, headers = _post(
                 base, {"path": _png(tmp_path, "img.png")}
             )
             assert status == 504
             assert "deadline" in body["error"]
+            # ISSUE 14 satellite: timeouts carry a retry hint like sheds
+            assert int(headers["Retry-After"]) >= 1
             assert engine.stats["timeouts"] >= 1
         finally:
             knobs.delay_s = 0.0
+            server.shutdown()
+            server.server_close()
+            engine.close()
+
+    def test_healthz_enrichment_fields(self, parts):
+        """ISSUE 14 satellite: /healthz carries the fleet-probe surface —
+        per-bucket queue depth, uptime, replica identity, drain state,
+        and a human-readable degraded_reason."""
+        from replication_faster_rcnn_tpu.serving.server import make_server
+
+        engine, _ = _make_engine(parts)
+        server = make_server(engine, port=0, replica_id="replica-7")
+        host, port = server.server_address[:2]
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        base = f"http://{host}:{port}"
+        try:
+            with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+                health = json.loads(r.read())
+            assert health["ok"] is True
+            assert health["degraded"] is False
+            assert health["degraded_reason"] is None
+            assert health["draining"] is False
+            assert health["replica_id"] == "replica-7"
+            assert health["uptime_s"] >= 0.0
+            assert health["bucket_queue_depths"] == {}
+            # the drain flag the SIGTERM handler raises is probe-visible
+            server.draining = True
+            with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+                assert json.loads(r.read())["draining"] is True
+            with urllib.request.urlopen(f"{base}/stats", timeout=10) as r:
+                stats = json.loads(r.read())
+            assert "bucket_queue_depths" in stats
+        finally:
+            server.shutdown()
+            server.server_close()
+            engine.close()
+
+    def test_healthz_degraded_reason_surfaces_after_streak(self, parts):
+        knobs = _Knobs()
+        knobs.fail = True
+        engine, _ = _make_engine(parts, knobs)
+        server, base = _serve(engine)
+        try:
+            for i in range(DEGRADED_AFTER):
+                with pytest.raises(RuntimeError):
+                    engine.submit(_image(i)).result(timeout=30)
+            with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+                health = json.loads(r.read())
+            assert health["degraded"] is True
+            assert "consecutive" in health["degraded_reason"]
+        finally:
+            knobs.fail = False
             server.shutdown()
             server.server_close()
             engine.close()

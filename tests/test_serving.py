@@ -237,6 +237,237 @@ class TestMicroBatcherDeterministic:
         assert mb.flush_log == [("k", 2)]  # size trigger, clock untouched
         mb.close()
 
+    def test_on_expired_reports_dropped_count_deterministically(self):
+        """ISSUE 14 satellite: the shed-accounting hooks audited under
+        injected time — no live engine, no scheduler in the loop."""
+        now = [0.0]
+        expired_counts = []
+        mb = MicroBatcher(
+            lambda k, items: list(items), max_batch=100, max_delay_s=10.0,
+            clock=lambda: now[0], start=False,
+            on_expired=expired_counts.append,
+        )
+        doomed = [mb.submit("k", i, deadline_s=1.0) for i in range(2)]
+        live = mb.submit("k", "survivor", deadline_s=50.0)
+        for _ in range(3):  # stage all three into the pending group
+            assert mb._service_once(block=False)
+        now[0] = 11.0  # group deadline AND the 1s TTLs are past
+        assert mb._service_once(block=False)
+        assert expired_counts == [2]  # one flush, both expired entries
+        assert mb.expired_total == 2
+        for f in doomed:
+            with pytest.raises(Exception, match="deadline"):
+                f.result(timeout=0)
+        assert live.result(timeout=0) == "survivor"
+        assert mb.flush_log == [("k", 1)]  # only the live entry dispatched
+        mb.close()
+
+    def test_on_flush_result_reports_ok_and_failure_in_order(self):
+        now = [0.0]
+        outcomes = []
+
+        def process(k, items):
+            if "boom" in items:
+                raise ValueError("exploded")
+            return list(items)
+
+        mb = MicroBatcher(
+            process, max_batch=1, max_delay_s=1.0,
+            clock=lambda: now[0], start=False,
+            on_flush_result=outcomes.append,
+        )
+        mb.submit("k", "fine")
+        mb.submit("k", "boom")
+        mb.submit("k", "fine2")
+        for _ in range(3):
+            mb._service_once(block=False)
+        assert outcomes == [True, False, True]
+        mb.close()
+
+    def test_all_expired_flush_skips_process_and_flush_result(self):
+        """A flush whose every entry expired dispatches nothing — so
+        ``on_flush_result`` must not fire (no process outcome to score),
+        while ``on_expired`` still reports the drop."""
+        now = [0.0]
+        outcomes, expired_counts = [], []
+        mb = MicroBatcher(
+            lambda k, items: list(items), max_batch=100, max_delay_s=1.0,
+            clock=lambda: now[0], start=False,
+            on_expired=expired_counts.append,
+            on_flush_result=outcomes.append,
+        )
+        mb.submit("k", "late", deadline_s=0.5)
+        now[0] = 2.0
+        assert mb._service_once(block=False)
+        assert expired_counts == [1] and outcomes == []
+        assert mb.flush_log == []  # nothing reached process
+        mb.close()
+
+    def test_on_flush_stats_reports_per_entry_queue_waits(self):
+        now = [0.0]
+        stats = []
+        mb = MicroBatcher(
+            lambda k, items: list(items), max_batch=2, max_delay_s=10.0,
+            clock=lambda: now[0], start=False,
+            on_flush_stats=lambda k, waits: stats.append((k, waits)),
+        )
+        mb.submit("k", 1)
+        now[0] = 0.3
+        mb.submit("k", 2)
+        now[0] = 0.5
+        mb._service_once(block=False)
+        mb._service_once(block=False)
+        assert stats == [("k", [0.5, 0.2])]  # waits from each submit time
+        mb.close()
+
+    def test_key_depths_gauge_tracks_submit_to_flush(self):
+        now = [0.0]
+        mb = self._mb(lambda: now[0], max_batch=2)
+        mb.submit("a", 1)
+        mb.submit("b", 2)
+        assert mb.key_depths() == {"a": 1, "b": 1}
+        mb.submit("a", 3)
+        assert mb.key_depths()["a"] == 2
+        mb._service_once(block=False)  # a:1 -> pending
+        mb._service_once(block=False)  # b:1 -> pending
+        mb._service_once(block=False)  # a:2 -> size-trigger flush
+        assert mb.key_depths() == {"b": 1}  # a's entries flushed out
+        mb.close()  # drain flushes b
+        assert mb.key_depths() == {}
+
+    def test_per_key_max_delay_callable_sets_independent_deadlines(self):
+        now = [0.0]
+        delays = {"slow": 5.0, "fast": 0.5}
+        mb = MicroBatcher(
+            lambda k, items: list(items), max_batch=100,
+            max_delay_s=lambda k: delays[k],
+            clock=lambda: now[0], start=False,
+        )
+        mb.submit("slow", 1)
+        mb.submit("fast", 2)
+        mb._service_once(block=False)
+        mb._service_once(block=False)
+        assert mb.delay_s("slow") == 5.0 and mb.delay_s("fast") == 0.5
+        now[0] = 0.6  # fast's deadline only
+        mb._service_once(block=False)
+        assert mb.flush_log == [("fast", 1)]
+        now[0] = 5.1
+        mb._service_once(block=False)
+        assert mb.flush_log == [("fast", 1), ("slow", 1)]
+        mb.close()
+
+
+# ------------------------------------------------- SLO deadline controller
+
+
+class TestDeadlineController:
+    """ISSUE 14 satellite: per-bucket max_delay adaptation from observed
+    queue waits — bounded multiplicative steps inside [floor, ceiling]."""
+
+    def _dc(self, **kw):
+        from replication_faster_rcnn_tpu.serving.slo import DeadlineController
+
+        kw.setdefault("slo_ms", 100.0)
+        kw.setdefault("floor_ms", 1.0)
+        kw.setdefault("ceiling_ms", 50.0)
+        kw.setdefault("step", 2.0)
+        kw.setdefault("initial_ms", 10.0)
+        kw.setdefault("window", 4)
+        return DeadlineController(**kw)
+
+    def test_shrinks_when_wait_p99_nears_the_slo(self):
+        dc = self._dc()
+        dc.on_flush("b", [0.090] * 4)  # 90ms > 0.8 x 100ms
+        assert dc.delay_s("b") == pytest.approx(0.005)  # 10 / step
+        assert dc.adaptations == 1
+
+    def test_grows_only_with_slo_headroom_and_partial_flushes(self):
+        dc = self._dc(max_batch=lambda k: 8)
+        dc.on_flush("b", [0.010] * 4)  # partial (4 < 8), p99 well under
+        assert dc.delay_s("b") == pytest.approx(0.020)  # 10 x step
+        # full flushes: a longer deadline buys nothing -> no growth
+        dc2 = self._dc(max_batch=lambda k: 4)
+        dc2.on_flush("b", [0.010] * 4)  # full batch
+        assert dc2.delay_s("b") == pytest.approx(0.010)
+        assert dc2.adaptations == 0
+
+    def test_dead_zone_keeps_deadline_stable(self):
+        dc = self._dc()
+        dc.on_flush("b", [0.060] * 4)  # 0.4 < 0.6 < 0.8 of the SLO
+        assert dc.delay_s("b") == pytest.approx(0.010)
+        assert dc.adaptations == 0
+
+    def test_clamped_to_floor_and_ceiling(self):
+        dc = self._dc(initial_ms=2.0)
+        for _ in range(8):
+            dc.on_flush("b", [0.095] * 4)  # shrink every window
+        assert dc.delay_s("b") == pytest.approx(0.001)  # floor, not 2/2^8
+        dc = self._dc(initial_ms=40.0)
+        for _ in range(8):
+            dc.on_flush("b", [0.001] * 4)
+        assert dc.delay_s("b") == pytest.approx(0.050)  # ceiling
+
+    def test_adapts_once_per_window_not_per_flush(self):
+        dc = self._dc(window=8)
+        dc.on_flush("b", [0.090] * 4)  # 4 of 8 samples
+        assert dc.adaptations == 0
+        dc.on_flush("b", [0.090] * 4)  # window reached
+        assert dc.adaptations == 1
+
+    def test_keys_adapt_independently(self):
+        dc = self._dc()
+        dc.on_flush("hot", [0.090] * 4)
+        dc.on_flush("idle", [0.002] * 4)
+        assert dc.delay_s("hot") == pytest.approx(0.005)
+        assert dc.delay_s("idle") == pytest.approx(0.020)
+        assert set(dc.delays_ms()) == {"hot", "idle"}
+
+    def test_from_config_maps_serving_knobs(self):
+        from replication_faster_rcnn_tpu.serving.slo import DeadlineController
+
+        serving = ServingConfig(
+            max_delay_ms=8.0, adaptive_slo_ms=200.0, delay_floor_ms=2.0,
+            delay_ceiling_ms=32.0, adaptive_delay_step=2.0,
+        )
+        dc = DeadlineController.from_config(serving, window=4)
+        assert dc.delay_s("any") == pytest.approx(0.008)
+        dc.on_flush("b", [0.190] * 4)  # p99 over 0.8 x 200ms
+        assert dc.delay_s("b") == pytest.approx(0.004)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="floor_ms"):
+            self._dc(floor_ms=0.0)
+        with pytest.raises(ValueError, match="step"):
+            self._dc(step=1.0)
+        with pytest.raises(ValueError, match="slo_ms"):
+            self._dc(slo_ms=0.0)
+        with pytest.raises(ValueError, match="window"):
+            self._dc(window=0)
+
+    def test_drives_microbatcher_deadlines_through_the_callable_seam(self):
+        """Controller + batcher closed loop under injected time: a
+        shrink decided at flush N binds the deadline of flush N+1."""
+        now = [0.0]
+        dc = self._dc(window=2)
+        mb = MicroBatcher(
+            lambda k, items: list(items), max_batch=100,
+            max_delay_s=dc.delay_s, clock=lambda: now[0], start=False,
+            on_flush_stats=dc.on_flush,
+        )
+        f1, f2 = mb.submit("b", 1), mb.submit("b", 2)
+        mb._service_once(block=False)
+        mb._service_once(block=False)
+        now[0] = 0.090  # the pair waits 90ms -> deadline flush + shrink
+        mb._service_once(block=False)
+        assert f1.result(timeout=0) == 1 and f2.result(timeout=0) == 2
+        assert mb.delay_s("b") == pytest.approx(0.005)  # adapted live
+        mb.submit("b", 3)
+        mb._service_once(block=False)
+        now[0] = 0.096  # 6ms later: past the NEW 5ms deadline, not 10ms
+        mb._service_once(block=False)
+        assert mb.flush_log == [("b", 2), ("b", 1)]
+        mb.close()
+
 
 # ---------------------------------------------------------- bucket routing
 

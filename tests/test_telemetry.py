@@ -397,6 +397,34 @@ class TestReport:
         text = format_report(summary)
         assert "2 ranks" in text and "rank 1: 1 step rows" in text
 
+    def test_fleet_snapshot_renders_router_and_replicas(self, tmp_path):
+        d = self._run_dir(tmp_path)
+        snap = {
+            "router": {"requests": 12, "cache_hits": 2, "failovers": 1,
+                       "hedges": 3, "hedge_wins": 1, "unavailable": 0},
+            "replicas": {
+                "r0": {"ok": 6, "fail": 0,
+                       "breaker": {"state": "closed", "opens": 0}},
+                "r1": {"ok": 4, "fail": 2,
+                       "breaker": {"state": "open", "opens": 1}},
+            },
+            "registry": {
+                "r0": {"state": "healthy", "role": "serving"},
+                "r1": {"state": "dead", "role": "serving"},
+            },
+        }
+        with open(os.path.join(d, "fleet.jsonl"), "w") as f:
+            f.write(json.dumps({"router": {"requests": 1}}) + "\n")
+            f.write(json.dumps(snap) + "\n")  # later snapshot wins
+        summary = summarize_run(d)
+        assert "fleet.jsonl" in summary["artifacts"]
+        assert summary["fleet"]["router"]["requests"] == 12
+        text = format_report(summary)
+        assert "fleet router" in text
+        assert "1 failover(s)" in text
+        assert "breaker=open (1 open(s))" in text
+        assert "dead" in text
+
     def test_cli_telemetry_subcommand(self, tmp_path, capsys):
         from replication_faster_rcnn_tpu import cli
 
