@@ -70,7 +70,9 @@ PALLAS_CALL_MARKERS = ("tpu_custom_call", "mosaic", "triton")
 # and the serving engine's bucket matrix (audit_config's 2 resolutions ×
 # 2 batch sizes = 4 more) — plus the three ops.backend=pallas twins
 # (train/warmup.py::pallas_twin_base_names: loader k=1, eval, one
-# serving bucket), 22 programs total
+# serving bucket), plus the multi-scale TRAIN bucket programs
+# (audit_config's 2 train_resolutions × the loader/cached feeds × both
+# Ks = 8 more), 30 programs total
 AUDIT_FEEDS = ("loader", "cached", "spmd", "zero", "zero_lamb", "mp", "mp_zero")
 AUDIT_KS = (1, 2)
 AUDIT_BANK_NAME = "ci"
@@ -133,7 +135,15 @@ def audit_config() -> FasterRCNNConfig:
         model=ModelConfig(
             backbone="resnet18", roi_op="align", compute_dtype="float32"
         ),
-        data=DataConfig(dataset="synthetic", image_size=(64, 64), max_boxes=8),
+        data=DataConfig(
+            dataset="synthetic",
+            image_size=(64, 64),
+            max_boxes=8,
+            # multi-scale train buckets: a downsample bucket plus the
+            # identity bucket, so both the resample path and the no-op
+            # path are audited and banked per (feed x K)
+            train_resolutions=((32, 32), (64, 64)),
+        ),
         train=TrainConfig(
             batch_size=2,
             n_epoch=4,
@@ -160,9 +170,11 @@ def expected_program_names(
     config: Optional[FasterRCNNConfig] = None,
 ) -> List[str]:
     """The audited program set; with ``config`` the serving engine's
-    bucket programs (serving.resolutions × batch_sizes) and the
-    ops.backend=pallas twin programs are included."""
+    bucket programs (serving.resolutions × batch_sizes), the multi-scale
+    TRAIN bucket programs (data.train_resolutions × loader/cached × ks)
+    and the ops.backend=pallas twin programs are included."""
     from replication_faster_rcnn_tpu.train.warmup import (
+        bucket_train_program_names,
         pallas_program_name,
         pallas_twin_base_names,
         program_name,
@@ -174,6 +186,7 @@ def expected_program_names(
         names.append("eval_infer")
     if config is not None:
         names.extend(serving_program_names(config))
+        names.extend(bucket_train_program_names(config, feeds=feeds, ks=ks))
         names.extend(
             pallas_program_name(b) for b in pallas_twin_base_names(config)
         )

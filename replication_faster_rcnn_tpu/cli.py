@@ -98,6 +98,18 @@ def _build_config(args):
         data_kw["device_normalize"] = True
     if getattr(args, "prefetch_device", None) is not None:
         data_kw["prefetch_device"] = args.prefetch_device
+    if getattr(args, "train_resolutions", None):
+        try:
+            data_kw["train_resolutions"] = tuple(
+                tuple(int(x) for x in r.split("x"))
+                for r in args.train_resolutions.split(",")
+            )
+        except ValueError:
+            raise SystemExit(
+                "--train-resolutions expects 'HxW,HxW' with positive "
+                f"integers (e.g. 300x300,600x600), got "
+                f"{args.train_resolutions!r}"
+            )
     if data_kw:
         cfg = cfg.replace(data=dataclasses.replace(cfg.data, **data_kw))
     train_kw = {}
@@ -139,6 +151,8 @@ def _build_config(args):
         train_kw["optimizer"] = args.optimizer
     if getattr(args, "checkpoint_every_steps", None) is not None:
         train_kw["checkpoint_every_steps"] = args.checkpoint_every_steps
+    if getattr(args, "sampling_strategy", None):
+        train_kw["sampling_strategy"] = args.sampling_strategy
     if train_kw:
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **train_kw))
     if getattr(args, "compile_cache", None):
@@ -358,6 +372,21 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="run the jitter's image resample on device (host "
                         "transforms boxes only; removes the per-sample "
                         "host resample cost from ingest)")
+    p.add_argument("--train-resolutions", default=None, metavar="HxW,HxW",
+                   help="multi-scale bucketed training, e.g. "
+                        "'300x300,600x600': each dispatch chunk is "
+                        "deterministically hashed to one bucket and "
+                        "trained through that bucket's own compiled "
+                        "program (on-device resize + box rescale; "
+                        "data.train_resolutions)")
+    p.add_argument("--sampling-strategy", default=None,
+                   choices=[None, "random", "topk_iou"],
+                   help="second-stage ROI sampling "
+                        "(train.sampling_strategy): 'random' draws the "
+                        "pos/neg quotas uniformly (reference recipe); "
+                        "'topk_iou' keeps the highest-IoU positives and "
+                        "hardest negatives deterministically "
+                        "(arXiv:1702.02138 biased sampling)")
     p.add_argument("--prefetch-device", type=int, default=None, metavar="N",
                    help="double-buffered DEVICE staging: a producer thread "
                         "collates and starts the next batch's host->device "
@@ -552,10 +581,20 @@ def _cmd_train_impl(args, san=None) -> int:
                         take = k if fused else 1
                         with trainer.tracer.span("data/fetch", cat="data"):
                             batches = [next(it) for _ in range(take)]
+                        # multi-scale buckets: bounded-step runs have no
+                        # epoch loop, so the bucket hash keys off the
+                        # global step (deterministic across restarts)
+                        bucket = (
+                            feed.bucket_of(done)
+                            if trainer.jitted_bucket_steps is not None
+                            else None
+                        )
                         if fused:
-                            metrics = trainer.train_chunk(batches)
+                            metrics = trainer.train_chunk(batches, bucket=bucket)
                         else:
-                            metrics = trainer.train_one_batch(batches[0])
+                            metrics = trainer.train_one_batch(
+                                batches[0], bucket=bucket
+                            )
                         if trainer.watchdog is not None:
                             trainer.watchdog.beat(step=done + take, phase="train")
                         # same cadence as the per-step loop: log the first
@@ -648,6 +687,12 @@ def cmd_eval(args) -> int:
             f"(AP50 {result.get('AP50', float('nan')):.4f}, "
             f"AP75 {result.get('AP75', float('nan')):.4f})"
         )
+        if "AP_small" in result:
+            print(
+                f"  area: small {result['AP_small']:.4f}  "
+                f"medium {result['AP_medium']:.4f}  "
+                f"large {result['AP_large']:.4f}  (-1 = no gt in range)"
+            )
     else:
         print(f"mAP@{cfg.eval.iou_thresh}: {result['mAP']:.4f}")
     if args.per_class and "ap_per_class" in result:

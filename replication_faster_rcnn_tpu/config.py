@@ -270,6 +270,20 @@ class DataConfig:
     # batch in HBM, so keep it small). 0 = off (default): staging happens
     # synchronously between dispatches, the pre-PR-4 behavior.
     prefetch_device: int = 0
+    # multi-scale bucketed training: 2-3 (h, w) resolution buckets. Each
+    # global batch is deterministically assigned one bucket (a splitmix
+    # hash of seed/epoch/dispatch-chunk — data/augment.py::bucket_index,
+    # so `set_epoch(epoch, start_batch=)` resume replays the identical
+    # bucket sequence) and trained through that bucket's own compiled
+    # program: the step resamples the base-resolution batch to the bucket
+    # shape on device and scales the boxes (ops/image.py), composing with
+    # K-step fusion (all K batches of a fused dispatch share a bucket),
+    # the DevicePrefetcher, and the on-chip scale jitter. The bucket
+    # programs register through the warmup ProgramSpec registry, so
+    # `frcnn audit` banks one fingerprint per bucket like the serving
+    # buckets. () = off (default): the single-resolution path, bitwise
+    # identical to before this knob existed.
+    train_resolutions: Tuple[Tuple[int, int], ...] = ()
 
     def __post_init__(self):
         if self.prefetch_device < 0:
@@ -291,6 +305,32 @@ class DataConfig:
             raise ValueError(
                 "augment_scale_device requires augment_scale to be set"
             )
+        if self.train_resolutions:
+            res = tuple(
+                (int(r[0]), int(r[1])) for r in self.train_resolutions
+            )
+            for h, w in res:
+                if h < 1 or w < 1:
+                    raise ValueError(
+                        "data.train_resolutions entries must be positive "
+                        f"(h, w) pairs, got {(h, w)}"
+                    )
+            if len(set(res)) != len(res):
+                raise ValueError(
+                    f"data.train_resolutions has duplicates: {res!r}"
+                )
+            # canonical smallest-area-first order (same rule as
+            # serving.bucket_resolutions): bucket INDEX is part of the
+            # deterministic assignment, so the order must not depend on
+            # how the user happened to spell the list
+            object.__setattr__(
+                self,
+                "train_resolutions",
+                tuple(sorted(res, key=lambda r: (r[0] * r[1], r))),
+            )
+        else:
+            # coerce None/[] (JSON round-trips) to the canonical empty tuple
+            object.__setattr__(self, "train_resolutions", ())
 
 
 @dataclasses.dataclass(frozen=True)
@@ -397,6 +437,16 @@ class TrainConfig:
     # Single-process runtimes only (the writer hands orbax a host-numpy
     # snapshot, which has no multi-host replica story).
     async_checkpoint: bool = False
+    # second-stage region sampling strategy (targets/proposal_targets.py):
+    # "random" (default) draws the positive/negative ROI quotas uniformly
+    # at random among the eligible candidates — the reference recipe,
+    # byte-identical to the pre-knob programs; "topk_iou" ranks the
+    # eligible candidates by their max IoU with ground truth and keeps
+    # the top-K of each quota deterministically — the biased sampling
+    # family of arXiv:1702.02138 ("An Implementation of Faster RCNN with
+    # Study for Region Sampling"): highest-overlap positives plus
+    # hardest (highest-IoU-below-threshold) negatives.
+    sampling_strategy: str = "random"  # random | topk_iou
 
     def __post_init__(self):
         if self.backend not in ("auto", "spmd"):
@@ -450,6 +500,11 @@ class TrainConfig:
         if self.warmup_epochs < 0:
             raise ValueError(
                 f"warmup_epochs must be >= 0, got {self.warmup_epochs}"
+            )
+        if self.sampling_strategy not in ("random", "topk_iou"):
+            raise ValueError(
+                "sampling_strategy must be 'random' or 'topk_iou', got "
+                f"{self.sampling_strategy!r}"
             )
 
 

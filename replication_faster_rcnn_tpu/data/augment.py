@@ -209,6 +209,35 @@ def draw_decisions(seed: int, epoch: int, idx: int, scale_range=None):
     return flip, scale, off_y, off_x
 
 
+def bucket_index(
+    seed: int, epoch: int, batch: int, n_buckets: int, chunk: int = 1
+) -> int:
+    """Deterministic resolution-bucket assignment for one GLOBAL batch.
+
+    Multi-scale bucketed training (data.train_resolutions) keys the
+    bucket on (seed, epoch, batch // chunk) through the same splitmix
+    counter-mix as :func:`draw_decisions` — a pure function of the
+    global batch position, so a `set_epoch(epoch, start_batch=)` resume
+    replays the identical bucket sequence, every process of a multi-host
+    run agrees on each batch's bucket, and all ``chunk`` batches of one
+    fused K-step dispatch (train.steps_per_dispatch) land in the SAME
+    bucket (one fused program per dispatch). A distinct salt keeps the
+    bucket stream independent of the per-sample augmentation draws.
+    """
+    if n_buckets <= 1:
+        return 0
+    z = _splitmix(
+        (
+            seed * 0x9E3779B97F4A7C15
+            + epoch * 0x94D049BB133111EB
+            + (batch // max(1, chunk)) * 0xBF58476D1CE4E5B9
+            + 0xD1B54A32D192ED03  # bucket-stream salt
+        )
+        & 0xFFFFFFFFFFFFFFFF
+    )
+    return int(z % n_buckets)
+
+
 class AugmentedView:
     """Map-style view applying per-sample train augmentations: a 50%
     horizontal flip and/or a scale jitter drawn from ``scale_range``.

@@ -28,9 +28,18 @@ class COCODataset:
 
     Expects the standard layout: {root}/annotations/instances_{split}.json
     and {root}/{split}/ images (split like 'train2017'/'val2017').
+
+    ``keep_empty=True`` keeps images whose every annotation was filtered
+    (crowd-only or degenerate-only) or that have none at all; they come
+    out as valid samples with all -1 padding (every detection on them
+    scores as a false positive). Default False: train on images with at
+    least one target, like py-faster-rcnn.
     """
 
-    def __init__(self, cfg: DataConfig, split: str = "train2017") -> None:
+    def __init__(
+        self, cfg: DataConfig, split: str = "train2017",
+        keep_empty: bool = False,
+    ) -> None:
         self.cfg = cfg
         self.split = split
         ann_path = os.path.join(
@@ -52,8 +61,10 @@ class COCODataset:
             if a.get("iscrowd", 0):
                 continue  # crowd regions are not box targets
             self.anns_by_image.setdefault(a["image_id"], []).append(a)
-        # train on images that have at least one target, like py-faster-rcnn
-        self.ids = [i for i in self.images if self.anns_by_image.get(i)]
+        self.ids = [
+            i for i in self.images
+            if keep_empty or self.anns_by_image.get(i)
+        ]
         self.ids.sort()
 
     def __len__(self) -> int:
@@ -72,15 +83,24 @@ class COCODataset:
         labels = np.full((m,), -1, np.int32)
         boxes = np.full((m, 4), -1.0, np.float32)
         new_h, new_w = self.cfg.image_size
-        for i, a in enumerate(self.anns_by_image[img_id][:m]):
+        n = 0
+        for a in self.anns_by_image.get(img_id, ()):
+            if n == m:
+                break
             x, y, w, h = a["bbox"]  # COCO xywh, column-major
-            boxes[i] = [
-                y * new_h / orig_h,
-                x * new_w / orig_w,
-                (y + h) * new_h / orig_h,
-                (x + w) * new_w / orig_w,
-            ]
-            labels[i] = self.cat_to_label[a["category_id"]]
+            # clamp to the resized canvas (real COCO boxes overhang the
+            # image edge by a pixel or two) and drop what degenerates to
+            # zero extent — a zero-area target would poison the IoU
+            # matching and the regression targets downstream
+            r1 = min(max(y * new_h / orig_h, 0.0), new_h)
+            c1 = min(max(x * new_w / orig_w, 0.0), new_w)
+            r2 = min(max((y + h) * new_h / orig_h, 0.0), new_h)
+            c2 = min(max((x + w) * new_w / orig_w, 0.0), new_w)
+            if r2 - r1 <= 0.0 or c2 - c1 <= 0.0:
+                continue
+            boxes[n] = [r1, c1, r2, c2]
+            labels[n] = self.cat_to_label[a["category_id"]]
+            n += 1
 
         return {
             "image": image.astype(np.float32),

@@ -155,6 +155,8 @@ class CachedSampler:
         drop_last: bool = True,
         process_index: int = 0,
         process_count: int = 1,
+        train_resolutions=(),
+        bucket_chunk: int = 1,
     ):
         if scale_range is not None:
             lo, hi = float(scale_range[0]), float(scale_range[1])
@@ -190,6 +192,13 @@ class CachedSampler:
             )
         self.process_index = int(process_index)
         self.process_count = int(process_count)
+        # multi-scale buckets: same assignment contract as
+        # `DataLoader.bucket_of` — the sel dicts are shape-invariant, the
+        # bucket only selects WHICH compiled program consumes them.
+        self.train_resolutions = tuple(
+            (int(r[0]), int(r[1])) for r in (train_resolutions or ())
+        )
+        self.bucket_chunk = max(1, int(bucket_chunk))
         self.epoch = 0
         self.start_batch = 0  # mid-epoch offset (set_epoch)
 
@@ -203,6 +212,22 @@ class CachedSampler:
             raise ValueError(f"start_batch must be >= 0, got {start_batch}")
         self.epoch = int(epoch)
         self.start_batch = int(start_batch)
+
+    def bucket_of(self, batch_pos: int) -> int:
+        """Resolution-bucket index for the global batch at ``batch_pos``
+        — identical contract to ``DataLoader.bucket_of`` (pure function
+        of seed/epoch/position; 0 when bucketing is off)."""
+        if len(self.train_resolutions) <= 1:
+            return 0
+        from replication_faster_rcnn_tpu.data.augment import bucket_index
+
+        return bucket_index(
+            self.seed,
+            self.epoch,
+            int(batch_pos),
+            len(self.train_resolutions),
+            chunk=self.bucket_chunk,
+        )
 
     def __len__(self) -> int:
         if self.drop_last:

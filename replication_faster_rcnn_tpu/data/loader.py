@@ -174,6 +174,8 @@ class DataLoader:
         sample_skip_budget: int = 8,
         process_index: int = 0,
         process_count: int = 1,
+        train_resolutions=(),
+        bucket_chunk: int = 1,
     ) -> None:
         if worker_mode not in ("thread", "process"):
             raise ValueError(f"worker_mode must be thread|process, got {worker_mode!r}")
@@ -231,6 +233,15 @@ class DataLoader:
         self.sample_skip_budget = int(sample_skip_budget)
         self._epoch_skips = 0
         self._skip_lock = threading.Lock()
+        # multi-scale buckets (data.train_resolutions): the feed only
+        # ASSIGNS each global batch to a bucket (bucket_of); the resample
+        # to the bucket's shape runs on device inside that bucket's
+        # compiled program. bucket_chunk = train.steps_per_dispatch so all
+        # K batches of one fused dispatch share a bucket.
+        self.train_resolutions = tuple(
+            (int(r[0]), int(r[1])) for r in (train_resolutions or ())
+        )
+        self.bucket_chunk = max(1, int(bucket_chunk))
 
     def set_epoch(self, epoch: int, start_batch: int = 0) -> None:
         """Select the epoch — and optionally a mid-epoch offset.
@@ -273,6 +284,26 @@ class DataLoader:
         tspans.current_tracer().instant(
             "data/sample_skipped", cat="data", idx=int(idx),
             skips=skips, error=repr(exc)[:200],
+        )
+
+    def bucket_of(self, batch_pos: int) -> int:
+        """Resolution-bucket index for the GLOBAL batch at ``batch_pos``
+        of the current epoch — a pure function of (seed, epoch,
+        batch_pos // bucket_chunk), so every process agrees, a
+        ``set_epoch(epoch, start_batch=)`` resume replays the identical
+        sequence, and the local row-block sharding keeps each bucket's
+        shards disjoint exactly like the unbucketed feed. Returns 0 when
+        bucketing is off."""
+        if len(self.train_resolutions) <= 1:
+            return 0
+        from replication_faster_rcnn_tpu.data.augment import bucket_index
+
+        return bucket_index(
+            self.seed,
+            self.epoch,
+            int(batch_pos),
+            len(self.train_resolutions),
+            chunk=self.bucket_chunk,
         )
 
     def queue_depth(self) -> Optional[int]:

@@ -1,3 +1,4 @@
+from replication_faster_rcnn_tpu.eval.coco_eval import coco_summary  # noqa: F401
 from replication_faster_rcnn_tpu.eval.detect import batched_decode, decode_detections  # noqa: F401
-from replication_faster_rcnn_tpu.eval.evaluator import Evaluator  # noqa: F401
+from replication_faster_rcnn_tpu.eval.evaluator import Evaluator, summary_scalars  # noqa: F401
 from replication_faster_rcnn_tpu.eval.voc_eval import coco_map, voc_ap  # noqa: F401

@@ -1,9 +1,11 @@
-"""Dataset evaluator: jitted inference sweep -> VOC mAP.
+"""Dataset evaluator: jitted inference sweep -> VOC or COCO mAP.
 
 Completes the reference's missing eval path (`test_eval.py`, 0 bytes):
 runs the combined FasterRCNN forward (test-mode NMS budgets 3000->300,
 reference `nets/rpn.py:41-43`) + fixed-shape decode over a dataset and
-reduces to mAP@EvalConfig.iou_thresh on host. Inference is data-parallel:
+reduces on host to mAP@EvalConfig.iou_thresh (metric="voc") or the full
+COCO summary — mAP@[.50:.95], AP50/AP75 and the small/medium/large
+area breakdown (metric="coco", eval/coco_eval.py). Inference is data-parallel:
 eval batches shard over the mesh's data axis (largest divisor of
 batch_size that fits the devices), the same SPMD layout as training.
 """
@@ -24,7 +26,8 @@ from replication_faster_rcnn_tpu.eval.detect import (
     batched_decode,
     batched_decode_tta,
 )
-from replication_faster_rcnn_tpu.eval.voc_eval import coco_map, voc_ap
+from replication_faster_rcnn_tpu.eval.coco_eval import coco_summary
+from replication_faster_rcnn_tpu.eval.voc_eval import voc_ap
 from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
 from replication_faster_rcnn_tpu.telemetry import spans as tspans
 
@@ -62,6 +65,35 @@ def make_infer_fn(model: FasterRCNN, config: FasterRCNNConfig, image_size=None):
         )
 
     return infer
+
+
+def summary_scalars(
+    result: Dict[str, Any], num_classes: int
+) -> Dict[str, float]:
+    """Flatten an ``evaluate()`` result into the flat float schema the
+    step logger / `frcnn telemetry` consume, identical in shape for the
+    VOC and COCO metrics: every scalar aggregate ('mAP', and for COCO
+    'AP50'/'AP75'/'AP_small'/...) plus one ``AP/<class-name>`` entry per
+    class that has ground truth. Class names resolve from the bundled
+    VOC/COCO vocabularies when ``num_classes`` matches one, class
+    indices otherwise."""
+    from replication_faster_rcnn_tpu.config import COCO_CLASSES, VOC_CLASSES
+
+    names = {
+        len(VOC_CLASSES): VOC_CLASSES,
+        len(COCO_CLASSES): COCO_CLASSES,
+    }.get(num_classes, tuple(str(i) for i in range(num_classes)))
+    out = {
+        k: float(v)
+        for k, v in result.items()
+        if np.isscalar(v) or getattr(v, "ndim", None) == 0
+    }
+    aps = result.get("ap_per_class")
+    if aps is not None:
+        for c in range(1, num_classes):
+            if np.isfinite(aps[c]):
+                out[f"AP/{names[c]}"] = float(aps[c])
+    return out
 
 
 class Evaluator:
@@ -136,7 +168,12 @@ class Evaluator:
         gts: List[Dict[str, np.ndarray]],
     ) -> Dict[str, float]:
         if self.config.eval.metric == "coco":
-            return coco_map(detections, gts, self.config.model.num_classes)
+            return coco_summary(
+                detections,
+                gts,
+                self.config.model.num_classes,
+                max_dets=self.config.eval.max_detections,
+            )
         return voc_ap(
             detections,
             gts,

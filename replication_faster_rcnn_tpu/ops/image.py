@@ -72,3 +72,38 @@ def batched_scale_jitter(images: Array, params: Array) -> Array:
     (the half-pixel map becomes exact passthrough up to float assoc.;
     uint8 rows round back to their original values)."""
     return jax.vmap(scale_jitter_image)(images, params)
+
+
+def resize_batch_with_boxes(
+    images: Array, boxes: Array, out_hw: tuple
+) -> tuple:
+    """Bilinear batch resample to a STATIC output shape, boxes tracked.
+
+    The multi-scale training buckets (data.train_resolutions) resample
+    the base-resolution batch to each bucket's shape ON DEVICE, inside
+    that bucket's compiled program — the feeds keep shipping one canvas
+    shape, and the bucket is baked into the program like the serving
+    buckets. Unlike :func:`scale_jitter_image` (fixed canvas, moving
+    content window) this CHANGES the array shape, so it must run under
+    a per-bucket trace, never under a shape-polymorphic one.
+
+    images [N, H, W, C] (any float dtype or uint8), boxes [N, G, 4] in
+    [r1, c1, r2, c2] pixel coords on the input canvas. Returns (resized
+    [N, h, w, C] images in the input dtype, boxes scaled by (h/H, w/W)).
+    Box padding rows (zeros or negatives) stay padding under the
+    positive per-axis scaling. ``out_hw == (H, W)`` is the identity.
+    """
+    h, w = int(out_hw[0]), int(out_hw[1])
+    n, ih, iw, c = images.shape
+    if (ih, iw) == (h, w):
+        return images, boxes
+    out = jax.image.resize(
+        images.astype(jnp.float32), (n, h, w, c), method="bilinear"
+    )
+    if images.dtype == jnp.uint8:
+        out = jnp.clip(jnp.round(out), 0, 255)
+    out = out.astype(images.dtype)
+    sy = h / ih
+    sx = w / iw
+    scale = jnp.asarray([sy, sx, sy, sx], boxes.dtype)
+    return out, boxes * scale

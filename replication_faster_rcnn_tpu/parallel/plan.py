@@ -157,6 +157,7 @@ class PlanContext:
     batch_size: int = 0
     n_devices: int = 1
     process_count: int = 1
+    train_buckets: int = 0  # len(data.train_resolutions); 0 = off
 
     @property
     def n_model(self) -> int:
@@ -187,6 +188,7 @@ class PlanContext:
             batch_size=config.train.batch_size,
             n_devices=n_devices,
             process_count=process_count,
+            train_buckets=len(config.data.train_resolutions),
         )
 
 
@@ -358,6 +360,28 @@ DECISION_TABLE: Tuple[Cell, ...] = (
             "cache_device currently pairs with the jit auto-"
             "partitioned backend only (train.backend='auto'); the "
             "explicit shard_map backend feeds host batches"
+        ),
+    ),
+    Cell(
+        "buckets_backend",
+        "error",
+        lambda c: c.train_buckets > 0 and c.backend == "spmd",
+        lambda c: (
+            "multi-scale buckets (data.train_resolutions) compile one "
+            "jit auto-partitioned program per bucket; the explicit "
+            "shard_map backend builds its in/out specs for a single "
+            "static canvas — use train.backend='auto' with buckets"
+        ),
+    ),
+    Cell(
+        "buckets_spatial",
+        "error",
+        lambda c: c.train_buckets > 0 and c.spatial,
+        lambda c: (
+            "multi-scale buckets and spatial partitioning both change "
+            "the per-program image rows; the row-divisibility contract "
+            "cannot hold across buckets — drop --spatial or "
+            "data.train_resolutions"
         ),
     ),
     Cell(

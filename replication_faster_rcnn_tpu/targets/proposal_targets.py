@@ -34,6 +34,7 @@ from replication_faster_rcnn_tpu.ops import boxes as box_ops
 from replication_faster_rcnn_tpu.targets.sampling import (
     pack_by_priority,
     random_subset_mask,
+    topk_subset_mask,
 )
 
 Array = jnp.ndarray
@@ -47,12 +48,19 @@ def proposal_targets(
     gt_labels: Array,
     gt_mask: Array,
     cfg: ROITargetConfig,
+    strategy: str = "random",
 ) -> Tuple[Array, Array, Array]:
     """Per-image head targets.
 
     Args:
       rois: [R, 4] proposals (padded); roi_valid: [R] bool.
       gt_boxes: [G, 4]; gt_labels: [G] int (1..C-1, 0/-1 pad); gt_mask: [G].
+      strategy: region-sampling strategy (train.sampling_strategy, a
+        STATIC trace-time choice): "random" draws the quotas uniformly
+        (the reference recipe — this path is byte-identical to the
+        pre-knob programs); "topk_iou" keeps the highest-IoU positives
+        and the hardest (highest-IoU-below-threshold) negatives
+        deterministically (arXiv:1702.02138 biased sampling).
 
     Returns:
       sample_rois [n_sample, 4], reg_targets [n_sample, 4] (normalized),
@@ -89,9 +97,26 @@ def proposal_targets(
     )
 
     rng_pos, rng_neg, rng_pack = jax.random.split(rng, 3)
-    pos_keep = random_subset_mask(rng_pos, is_pos, cfg.n_pos_max, k_max=cfg.n_pos_max)
-    n_pos = jnp.sum(pos_keep)
-    neg_keep = random_subset_mask(rng_neg, is_neg, n_sample - n_pos, k_max=n_sample)
+    if strategy == "topk_iou":
+        # biased sampling: rank by overlap instead of a uniform draw —
+        # highest-IoU positives, hardest negatives. rng_pos/rng_neg stay
+        # split (identical key schedule to the random path) so the pack
+        # tiebreak below consumes the same rng_pack either way.
+        pos_keep = topk_subset_mask(
+            is_pos, max_iou, cfg.n_pos_max, k_max=cfg.n_pos_max
+        )
+        n_pos = jnp.sum(pos_keep)
+        neg_keep = topk_subset_mask(
+            is_neg, max_iou, n_sample - n_pos, k_max=n_sample
+        )
+    else:
+        pos_keep = random_subset_mask(
+            rng_pos, is_pos, cfg.n_pos_max, k_max=cfg.n_pos_max
+        )
+        n_pos = jnp.sum(pos_keep)
+        neg_keep = random_subset_mask(
+            rng_neg, is_neg, n_sample - n_pos, k_max=n_sample
+        )
 
     # Pack kept positives (priority 0), kept negatives (1), filler (2) into
     # exactly n_sample slots.
@@ -123,6 +148,7 @@ def batched_proposal_targets(
     gt_mask: Array,
     cfg: ROITargetConfig,
     positions: Array = None,
+    strategy: str = "random",
 ) -> Tuple[Array, Array, Array]:
     """vmap over the batch: rois [N, R, 4] -> (sample_rois [N, S, 4],
     reg [N, S, 4], labels [N, S]).
@@ -135,5 +161,7 @@ def batched_proposal_targets(
     else:
         keys = jax.vmap(lambda p: jax.random.fold_in(rng, p))(positions)
     return jax.vmap(
-        lambda k, r, v, b, lbl, m: proposal_targets(k, r, v, b, lbl, m, cfg)
+        lambda k, r, v, b, lbl, m: proposal_targets(
+            k, r, v, b, lbl, m, cfg, strategy=strategy
+        )
     )(keys, rois, roi_valid, gt_boxes, gt_labels, gt_mask)

@@ -56,6 +56,37 @@ def random_subset_mask(
     return member & (score >= cut) & (kk > 0)
 
 
+def topk_subset_mask(
+    member: Array, score: Array, k: Array, k_max: int | None = None
+) -> Array:
+    """Deterministically keep the min(k, member.sum()) HIGHEST-scoring
+    elements of a masked set — the biased-sampling counterpart of
+    :func:`random_subset_mask` (arXiv:1702.02138's region-sampling study:
+    rank candidates by overlap instead of drawing uniformly).
+
+    Same cut-point machinery as random_subset_mask with ``score`` in
+    place of the uniform draw, so the two strategies are drop-in
+    exchangeable at every call site. Exact ties at the cut score keep
+    every tied element (the caller's fixed-size packing bounds the
+    final sample, so over-keeping only widens the pool the pack's
+    tiebreak chooses from).
+    """
+    s = jnp.where(member, score, -jnp.inf)
+    n_member = jnp.sum(member)
+    kk = jnp.minimum(jnp.asarray(k, jnp.int32), n_member.astype(jnp.int32))
+    if k_max is not None:
+        if not isinstance(k, jax.core.Tracer) and int(k) > k_max:
+            raise ValueError(f"k={int(k)} exceeds the static bound k_max={k_max}")
+        if k_max <= 0:
+            return jnp.zeros_like(member)
+        kk = jnp.minimum(kk, k_max)
+        top = jax.lax.top_k(s, min(int(k_max), member.shape[-1]))[0]
+    else:
+        top = jnp.sort(s)[::-1]  # descending
+    cut = top[jnp.maximum(kk - 1, 0)]
+    return member & (s >= cut) & (kk > 0)
+
+
 def pack_by_priority(rng: Array, priority: Array, n_out: int) -> Array:
     """Order indices by (priority, random tiebreak) and take the first n_out.
 

@@ -80,6 +80,7 @@ def compute_losses(
     positions: Array = None,
     features_wall: bool = False,
     targets_only: bool = False,
+    train_resolution=None,
 ) -> Tuple[Array, Tuple[Dict[str, Array], Any]]:
     """Forward + 4 losses. Returns (total, (metrics, new_batch_stats)).
 
@@ -100,6 +101,14 @@ def compute_losses(
     metrics) — the bench's `targets_ms` stage prefix, kept inside this
     function so the timed prefix can't drift from the real step.
     Diagnostics only.
+
+    ``train_resolution`` (STATIC ``(h, w)`` or None) is one multi-scale
+    training bucket (data.train_resolutions): the batch arrives at the
+    base canvas shape and is resampled to the bucket's shape on device
+    (`ops/image.py::resize_batch_with_boxes`, boxes tracked) right after
+    the jitter resample — so each bucket is its own compiled program,
+    exactly like a serving bucket. None (the default) leaves the program
+    byte-identical to the pre-bucket trace.
     """
     images = batch["image"]
     if "jitter" in batch:
@@ -112,6 +121,15 @@ def compute_losses(
     gt_boxes = batch["boxes"]
     gt_labels = batch["labels"]
     gt_mask = batch["mask"]
+    if train_resolution is not None:
+        # multi-scale bucket resample (static shape, per-bucket program)
+        from replication_faster_rcnn_tpu.ops.image import (
+            resize_batch_with_boxes,
+        )
+
+        images, gt_boxes = resize_batch_with_boxes(
+            images, gt_boxes, train_resolution
+        )
     img_h, img_w = float(images.shape[1]), float(images.shape[2])
     variables = {"params": params, "batch_stats": batch_stats}
     sigma = config.train.smooth_l1_sigma
@@ -147,6 +165,7 @@ def compute_losses(
     sample_rois, reg_t2, lab_t2 = batched_proposal_targets(
         rng_pt, rois, roi_valid, gt_boxes, gt_labels, gt_mask, config.roi_targets,
         positions,
+        strategy=config.train.sampling_strategy,
     )
     if targets_only:
         probe = (
@@ -217,11 +236,16 @@ def make_train_step(
     model: FasterRCNN,
     config: FasterRCNNConfig,
     tx: optax.GradientTransformation,
+    train_resolution=None,
 ):
     """Build the jittable (state, batch) -> (state, metrics) function.
 
     Jit it with donate_argnums=(0,) and sharded batch inputs; parameters
     stay replicated and gradients allreduce via XLA.
+
+    ``train_resolution`` bakes one multi-scale bucket's static (h, w)
+    into the trace (see ``compute_losses``); None is the single-scale
+    program, byte-identical to the pre-bucket build.
     """
 
     def train_step(state: TrainState, batch: Dict[str, Array]):
@@ -229,7 +253,8 @@ def make_train_step(
 
         def loss_fn(params):
             return compute_losses(
-                model, config, params, state.batch_stats, batch, step_rng, True
+                model, config, params, state.batch_stats, batch, step_rng,
+                True, train_resolution=train_resolution,
             )
 
         (_, (metrics, new_stats)), grads = jax.value_and_grad(
@@ -253,6 +278,7 @@ def make_cached_train_step(
     model: FasterRCNN,
     config: FasterRCNNConfig,
     tx: optax.GradientTransformation,
+    train_resolution=None,
 ):
     """The device-cache variant: (state, cache, sel) -> (state, metrics).
 
@@ -266,7 +292,7 @@ def make_cached_train_step(
 
     Jit with donate_argnums=(0,) ONLY — the cache must NOT be donated.
     """
-    base = make_train_step(model, config, tx)
+    base = make_train_step(model, config, tx, train_resolution=train_resolution)
 
     def cached_step(state, cache: Dict[str, Array], sel: Dict[str, Array]):
         from replication_faster_rcnn_tpu.data.device_cache import (
@@ -323,6 +349,7 @@ def make_cached_multi_step(
     config: FasterRCNNConfig,
     tx: optax.GradientTransformation,
     k: int,
+    train_resolution=None,
 ):
     """Fused device-cache variant: (state, cache, sels) -> (state, metrics)
     where ``sels`` holds k per-step selections stacked to [K, B, ...]
@@ -334,7 +361,7 @@ def make_cached_multi_step(
     """
     if k < 1:
         raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
-    base = make_train_step(model, config, tx)
+    base = make_train_step(model, config, tx, train_resolution=train_resolution)
 
     def fused(state: TrainState, cache: Dict[str, Array], sels: Dict[str, Array]):
         from replication_faster_rcnn_tpu.data.device_cache import (

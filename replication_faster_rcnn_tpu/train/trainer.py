@@ -227,6 +227,8 @@ class Trainer:
                 scale_range=config.data.augment_scale,
                 process_index=self._rank,
                 process_count=self._process_count,
+                train_resolutions=config.data.train_resolutions,
+                bucket_chunk=max(1, config.train.steps_per_dispatch),
             )
             self.loader = None
             steps_per_epoch = max(len(self.sampler), 1)
@@ -248,6 +250,8 @@ class Trainer:
                 cache_ram=config.data.loader_cache_ram,
                 process_index=self._rank,
                 process_count=self._process_count,
+                train_resolutions=config.data.train_resolutions,
+                bucket_chunk=max(1, config.train.steps_per_dispatch),
             )
             steps_per_epoch = max(len(self.loader), 1)
         # n_shards sizes LAMB's psum'd trust-ratio norms to the data axis
@@ -367,6 +371,62 @@ class Trainer:
                 self.jitted_multi_step = scope_jitted(
                     self.jitted_multi_step, config
                 )
+        # multi-scale resolution buckets (data.train_resolutions): one
+        # compiled program per bucket, each baking the bucket's static
+        # (h, w) on-device resample into the trace (compute_losses) under
+        # its own Plan label — the serving-bucket pattern applied to
+        # training, so the strict harness, warmup registry and HLO audit
+        # all see per-bucket programs as first-class citizens. The
+        # unbucketed programs above stay (jit is lazy; they only compile
+        # if dispatched). Feed/backend compatibility was already rejected
+        # by the Plan.validate decision table.
+        self._bucket_resolutions = tuple(config.data.train_resolutions)
+        self.jitted_bucket_steps = None
+        self.jitted_bucket_multi_steps = None
+        if self._bucket_resolutions:
+            from replication_faster_rcnn_tpu.train.train_step import (
+                make_cached_train_step,
+            )
+
+            pallas = ops_pkg.resolve_backend(config) == "pallas"
+            k = self.steps_per_dispatch
+            steps, multis = [], []
+            for bh, bw in self._bucket_resolutions:
+                plan = dataclasses.replace(
+                    self._step_plan, label=f"train_step_{bh}x{bw}"
+                )
+                if config.data.cache_device:
+                    fn = make_cached_train_step(
+                        self.model, config, self.tx, train_resolution=(bh, bw)
+                    )
+                else:
+                    fn = make_train_step(
+                        self.model, config, self.tx, train_resolution=(bh, bw)
+                    )
+                jitted = compile_step_with_plan(fn, plan)
+                steps.append(scope_jitted(jitted, config) if pallas else jitted)
+                if k > 1:
+                    mplan = dataclasses.replace(
+                        self._step_plan, label=f"multi_step_k{k}_{bh}x{bw}"
+                    )
+                    if config.data.cache_device:
+                        mfn = make_cached_multi_step(
+                            self.model, config, self.tx, k,
+                            train_resolution=(bh, bw),
+                        )
+                    else:
+                        mfn = build_multi_step(
+                            make_train_step(
+                                self.model, config, self.tx,
+                                train_resolution=(bh, bw),
+                            ),
+                            k,
+                        )
+                    mj = compile_step_with_plan(mfn, mplan)
+                    multis.append(scope_jitted(mj, config) if pallas else mj)
+            self.jitted_bucket_steps = steps
+            if multis:
+                self.jitted_bucket_multi_steps = multis
         # runtime hygiene gate (debug.strict / --strict): transfer guard +
         # recompile detector around every dispatch, armed after warmup
         self.strict = None
@@ -800,24 +860,34 @@ class Trainer:
         self,
         batch: Optional[Dict[str, np.ndarray]] = None,
         staged: Optional[Dict[str, jax.Array]] = None,
+        bucket: Optional[int] = None,
     ) -> Dict[str, float]:
         """One optimizer step. Callers pass either a host ``batch`` (staged
         here, the synchronous pre-PR-4 path) or an already device-resident
-        ``staged`` batch from the DevicePrefetcher."""
+        ``staged`` batch from the DevicePrefetcher. ``bucket`` selects one
+        multi-scale resolution bucket's compiled program (the feed's
+        ``bucket_of`` assignment); None dispatches the single-scale
+        program."""
         tracer = self.tracer
         if staged is None:
             # in --cache-device mode `batch` is a selection dict (idx/flip/
             # jitter — bytes, not megabytes); the images never leave device
             staged = self._stage_batch(batch)
-        strict = self._strict_dispatch("train_step", self.jitted_step)
+        step_fn = self.jitted_step
+        program = "train_step"
+        if bucket is not None and self.jitted_bucket_steps is not None:
+            bh, bw = self._bucket_resolutions[bucket]
+            step_fn = self.jitted_bucket_steps[bucket]
+            program = f"train_step_{bh}x{bw}"
+        strict = self._strict_dispatch(program, step_fn)
         if self.device_cache is not None:
             with tracer.span("step/dispatch", cat="step"), strict:
-                self.state, metrics = self.jitted_step(
+                self.state, metrics = step_fn(
                     self.state, self.device_cache.arrays, staged
                 )
         else:
             with tracer.span("step/dispatch", cat="step"), strict:
-                self.state, metrics = self.jitted_step(self.state, staged)
+                self.state, metrics = step_fn(self.state, staged)
         self._host_step += 1
         # hand the monitor this step's `skipped` flag as a DEVICE scalar —
         # it syncs only at drain points, preserving dispatch overlap
@@ -828,6 +898,7 @@ class Trainer:
         self,
         batches=None,
         staged: Optional[Dict[str, jax.Array]] = None,
+        bucket: Optional[int] = None,
     ) -> Dict[str, np.ndarray]:
         """Train ``steps_per_dispatch`` steps in ONE fused jitted dispatch.
 
@@ -848,17 +919,21 @@ class Trainer:
                 )
             staged = self._stage_chunk(batches)
         tracer = self.tracer
-        strict = self._strict_dispatch(
-            f"multi_step_k{k}", self.jitted_multi_step
-        )
+        step_fn = self.jitted_multi_step
+        program = f"multi_step_k{k}"
+        if bucket is not None and self.jitted_bucket_multi_steps is not None:
+            bh, bw = self._bucket_resolutions[bucket]
+            step_fn = self.jitted_bucket_multi_steps[bucket]
+            program = f"multi_step_k{k}_{bh}x{bw}"
+        strict = self._strict_dispatch(program, step_fn)
         if self.device_cache is not None:
             with tracer.span("step/dispatch", cat="step", steps=k), strict:
-                self.state, metrics = self.jitted_multi_step(
+                self.state, metrics = step_fn(
                     self.state, self.device_cache.arrays, staged
                 )
         else:
             with tracer.span("step/dispatch", cat="step", steps=k), strict:
-                self.state, metrics = self.jitted_multi_step(
+                self.state, metrics = step_fn(
                     self.state, staged
                 )
         first = self._host_step + 1
@@ -1067,6 +1142,18 @@ class Trainer:
         eval_result: Dict[str, float] = {}
         feed = self.sampler if self.device_cache is not None else self.loader
         tracer = self.tracer
+
+        def cur_bucket() -> Optional[int]:
+            # resolution bucket of the NEXT batch to train: a pure
+            # function of (seed, epoch, position-in-epoch) via the feed's
+            # bucket_of, so resume/replay and every rank agree. `step` and
+            # `epoch` are read at call time (closure over the loop vars);
+            # all K batches of one fused dispatch share a bucket by
+            # construction (bucket_chunk = steps_per_dispatch).
+            if self.jitted_bucket_steps is None:
+                return None
+            return feed.bucket_of(step - epoch * steps_per_epoch)
+
         self._shutdown = fault.GracefulShutdown()
         try:
             with self.telemetry_session(), self.strict_session(), self._shutdown:
@@ -1101,7 +1188,9 @@ class Trainer:
                         try:
                             for item in stager:
                                 if item[0] == STAGED and k > 1:
-                                    metrics = self.train_chunk(staged=item[1])
+                                    metrics = self.train_chunk(
+                                        staged=item[1], bucket=cur_bucket()
+                                    )
                                     first = step + 1
                                     step += k
                                     n_images += item[3]
@@ -1116,7 +1205,7 @@ class Trainer:
                                         last = row
                                 elif item[0] == STAGED:
                                     metrics = self.train_one_batch(
-                                        staged=item[1]
+                                        staged=item[1], bucket=cur_bucket()
                                     )
                                     step += 1
                                     n_images += item[3]
@@ -1133,7 +1222,9 @@ class Trainer:
                                     # HOST item: epoch tail (< K pending
                                     # batches) through the per-step path
                                     batch = item[1]
-                                    metrics = self.train_one_batch(batch)
+                                    metrics = self.train_one_batch(
+                                        batch, bucket=cur_bucket()
+                                    )
                                     step += 1
                                     n_images += batch[
                                         "idx" if "idx" in batch else "image"
@@ -1170,7 +1261,9 @@ class Trainer:
                                 chunk.append(batch)
                                 if len(chunk) < k:
                                     continue
-                                metrics = self.train_chunk(chunk)
+                                metrics = self.train_chunk(
+                                    chunk, bucket=cur_bucket()
+                                )
                                 first = step + 1
                                 step += k
                                 n_images += sum(
@@ -1189,7 +1282,9 @@ class Trainer:
                                 self._check_fleet(step)
                                 self._maybe_step_checkpoint(step)
                                 continue
-                            metrics = self.train_one_batch(batch)
+                            metrics = self.train_one_batch(
+                                batch, bucket=cur_bucket()
+                            )
                             n_images += batch[
                                 "idx" if "idx" in batch else "image"
                             ].shape[0]
@@ -1207,7 +1302,9 @@ class Trainer:
                         # per-step path (its jit compiles lazily, only when
                         # a tail exists)
                         for batch in chunk:
-                            metrics = self.train_one_batch(batch)
+                            metrics = self.train_one_batch(
+                                batch, bucket=cur_bucket()
+                            )
                             n_images += batch[
                                 "idx" if "idx" in batch else "image"
                             ].shape[0]
@@ -1235,7 +1332,15 @@ class Trainer:
                     ) % cfg.eval_every_epochs == 0:
                         if self.watchdog is not None:
                             self.watchdog.beat(phase="eval")
-                        eval_result = {"mAP": float(self.evaluate()["mAP"])}
+                        from replication_faster_rcnn_tpu.eval.evaluator import (
+                            summary_scalars,
+                        )
+
+                        # flat scalar schema shared by the voc and coco
+                        # metrics: aggregates + per-class AP/<name> rows
+                        eval_result = summary_scalars(
+                            self.evaluate(), self.config.model.num_classes
+                        )
                         self.logger.log(step, eval_result)
                     if (epoch + 1) % cfg.checkpoint_every_epochs == 0:
                         if self.watchdog is not None:

@@ -161,6 +161,29 @@ def program_name(feed: str, k: int) -> str:
     return "eval_infer" if feed == "eval" else f"train_{feed}_k{k}"
 
 
+def bucket_train_program_name(feed: str, k: int, h: int, w: int) -> str:
+    """Canonical name of one multi-scale train-bucket program
+    (data.train_resolutions): the base (feed x K) name with the bucket's
+    static resolution appended, mirroring serve_program_name."""
+    return f"{program_name(feed, k)}_{h}x{w}"
+
+
+def bucket_train_program_names(
+    config: FasterRCNNConfig,
+    feeds: Sequence[str] = ("loader", "cached"),
+    ks: Sequence[int] = (1,),
+) -> Tuple[str, ...]:
+    """Every per-bucket train program the config's trainer would compile
+    (empty when data.train_resolutions is unset)."""
+    return tuple(
+        bucket_train_program_name(feed, k, h, w)
+        for feed in feeds
+        if feed in ("loader", "cached")
+        for k in ks
+        for h, w in config.data.train_resolutions
+    )
+
+
 PALLAS_TWIN_SUFFIX = "__pallas"
 
 
@@ -418,20 +441,22 @@ def build_program_specs(
             out_shardings=(shardings, None),
         )
 
-    def _loader(k: int):
-        step_fn = make_train_step(model, config, tx)
+    def _loader(k: int, res: Optional[Tuple[int, int]] = None):
+        step_fn = make_train_step(model, config, tx, train_resolution=res)
         if k == 1:
             fn, args = step_fn, (state_abs, batch_abs)
         else:
             fn, args = build_multi_step(step_fn, k), (state_abs, _chunk_abs(k))
         return compile_step_with_plan(fn, _pjit_plan(state_shardings)), args
 
-    def _cached(k: int):
+    def _cached(k: int, res: Optional[Tuple[int, int]] = None):
         if k == 1:
-            fn = make_cached_train_step(model, config, tx)
+            fn = make_cached_train_step(model, config, tx, train_resolution=res)
             args = (state_abs, cache_abs, _sel_abs(()))
         else:
-            fn = make_cached_multi_step(model, config, tx, k)
+            fn = make_cached_multi_step(
+                model, config, tx, k, train_resolution=res
+            )
             args = (state_abs, cache_abs, _sel_abs((k,)))
         # donate the state ONLY — the cache must survive the dispatch
         # (train/train_step.py::make_cached_train_step)
@@ -603,6 +628,31 @@ def build_program_specs(
                 build=(lambda f=feed, kk=k: builders[f](kk)),
                 meta=dict(mp_meta if feed in ("mp", "mp_zero") else meta),
             )
+    if config.data.train_resolutions:
+        # multi-scale train buckets: one program per (feed x K x bucket)
+        # for the bucketable feeds, each baking the bucket's static
+        # on-device resample into the trace (the Trainer's own per-bucket
+        # jit sites) — registered here so warmup pre-compiles them and
+        # the HLO audit banks them exactly like serving buckets.
+        bucket_builders = {"loader": _loader, "cached": _cached}
+        for feed in feeds:
+            if feed not in bucket_builders:
+                continue
+            for k in ks:
+                for bh, bw in config.data.train_resolutions:
+                    name = bucket_train_program_name(feed, k, bh, bw)
+                    specs[name] = ProgramSpec(
+                        name=name,
+                        feed=feed,
+                        k=k,
+                        arg_roles=roles[feed],
+                        build=(
+                            lambda f=feed, kk=k, hh=bh, ww=bw: bucket_builders[
+                                f
+                            ](kk, res=(hh, ww))
+                        ),
+                        meta={**meta, "bucket": [bh, bw]},
+                    )
     if include_eval:
         specs["eval_infer"] = ProgramSpec(
             name="eval_infer",

@@ -1,0 +1,387 @@
+"""COCO end-to-end subsystem tests: multi-scale bucket assignment
+(data.train_resolutions) through the feeds, the on-device bucket
+resample, the region-sampling config axis (train.sampling_strategy),
+the per-bucket program naming/audit surface, and the coco_overfit mini
+gate machinery (driven on synthetic records — the timed run is manual,
+like benchmarks/step_profile.py)."""
+
+import importlib.util
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from replication_faster_rcnn_tpu.config import (
+    DataConfig,
+    TrainConfig,
+)
+from replication_faster_rcnn_tpu.data import SyntheticDataset
+from replication_faster_rcnn_tpu.data.augment import bucket_index
+from replication_faster_rcnn_tpu.data.loader import DataLoader
+from replication_faster_rcnn_tpu.ops.image import resize_batch_with_boxes
+from replication_faster_rcnn_tpu.targets.sampling import (
+    random_subset_mask,
+    topk_subset_mask,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUCKETS = ((32, 32), (64, 64))
+
+
+def _data_cfg(**kw):
+    return DataConfig(
+        dataset="synthetic", image_size=(64, 64), max_boxes=8, **kw
+    )
+
+
+# ------------------------------------------------------------ config knobs
+
+
+class TestConfigKnobs:
+    def test_train_resolutions_canonical_order(self):
+        # smallest-area-first canonical sort, independent of input order
+        cfg = _data_cfg(train_resolutions=((600, 600), (300, 300)))
+        assert cfg.train_resolutions == ((300, 300), (600, 600))
+
+    def test_sampling_strategy_validated(self):
+        assert TrainConfig(sampling_strategy="topk_iou").sampling_strategy
+        with pytest.raises(ValueError, match="sampling_strategy"):
+            TrainConfig(sampling_strategy="bogus")
+
+
+# -------------------------------------------------------- bucket assignment
+
+
+class TestBucketIndex:
+    def test_pure_function_and_epoch_dependence(self):
+        a = [bucket_index(7, 0, b, 2) for b in range(64)]
+        assert a == [bucket_index(7, 0, b, 2) for b in range(64)]
+        # both buckets occur, and another epoch reshuffles the stream
+        assert set(a) == {0, 1}
+        assert a != [bucket_index(7, 1, b, 2) for b in range(64)]
+
+    def test_chunk_groups_fused_dispatches(self):
+        # all K batches of one fused dispatch share a bucket
+        for b in range(0, 32, 4):
+            ks = {bucket_index(3, 2, b + i, 2, chunk=4) for i in range(4)}
+            assert len(ks) == 1
+
+    def test_single_bucket_is_zero(self):
+        assert bucket_index(3, 5, 17, 1) == 0
+
+
+class TestFeedBucketOf:
+    def _loader(self, **kw):
+        ds = SyntheticDataset(_data_cfg(), length=16)
+        return DataLoader(
+            ds, batch_size=4, prefetch=0, num_workers=0, seed=7,
+            train_resolutions=BUCKETS, **kw,
+        )
+
+    def test_matches_bucket_index(self):
+        ld = self._loader()
+        ld.set_epoch(2)
+        for pos in range(8):
+            assert ld.bucket_of(pos) == bucket_index(7, 2, pos, 2)
+
+    def test_resume_replays_identical_buckets(self):
+        # bucket_of keys on the ABSOLUTE batch position of the epoch, so
+        # a mid-epoch resume (set_epoch(e, start_batch=k)) sees the same
+        # assignment for every remaining batch as an uninterrupted epoch
+        ld = self._loader()
+        ld.set_epoch(3)
+        want = [ld.bucket_of(p) for p in range(8)]
+        ld.set_epoch(3, start_batch=5)
+        assert [ld.bucket_of(p) for p in range(8)] == want
+
+    def test_processes_agree_on_every_bucket(self):
+        # multi-host: each process computes buckets locally; they must
+        # agree batch-for-batch or ranks would dispatch different
+        # programs and deadlock the collectives
+        a = self._loader(process_index=0, process_count=2)
+        b = self._loader(process_index=1, process_count=2)
+        a.set_epoch(1)
+        b.set_epoch(1)
+        assert [a.bucket_of(p) for p in range(16)] == [
+            b.bucket_of(p) for p in range(16)
+        ]
+
+    def test_bucketing_off_is_zero(self):
+        ds = SyntheticDataset(_data_cfg(), length=16)
+        ld = DataLoader(ds, batch_size=4, prefetch=0, num_workers=0)
+        ld.set_epoch(0)
+        assert ld.bucket_of(3) == 0
+
+
+# ------------------------------------------------------ on-device resample
+
+
+class TestResizeBatchWithBoxes:
+    def test_identity_is_passthrough(self):
+        img = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 8, 3)
+        box = jnp.asarray([[[0.0, 0.0, 8.0, 8.0]]] * 2)
+        out, obox = resize_batch_with_boxes(img, box, (8, 8))
+        assert out is img and obox is box
+
+    def test_downsample_scales_boxes(self):
+        img = jnp.ones((1, 64, 64, 3), jnp.float32)
+        box = jnp.asarray([[[8.0, 16.0, 40.0, 64.0], [-1.0] * 4]])
+        out, obox = resize_batch_with_boxes(img, box, (32, 32))
+        assert out.shape == (1, 32, 32, 3)
+        np.testing.assert_allclose(
+            np.asarray(obox[0, 0]), [4.0, 8.0, 20.0, 32.0]
+        )
+        # padding rows stay padding (negative) under the positive scale
+        assert np.all(np.asarray(obox[0, 1]) < 0)
+
+    def test_uint8_rounds_back_to_uint8(self):
+        img = jnp.full((1, 4, 4, 3), 200, jnp.uint8)
+        out, _ = resize_batch_with_boxes(
+            img, jnp.zeros((1, 1, 4)), (2, 2)
+        )
+        assert out.dtype == jnp.uint8
+        assert int(out.max()) <= 255 and int(out.min()) >= 0
+
+
+# ------------------------------------------------------- region sampling
+
+
+class TestTopkSampling:
+    def test_keeps_highest_scoring(self):
+        member = jnp.asarray([True, True, True, True, False])
+        score = jnp.asarray([0.1, 0.9, 0.5, 0.7, 1.0])
+        out = np.asarray(topk_subset_mask(member, score, 2))
+        assert out.tolist() == [False, True, False, True, False]
+
+    def test_ties_at_cut_all_kept(self):
+        member = jnp.asarray([True, True, True])
+        score = jnp.asarray([0.5, 0.5, 0.3])
+        out = np.asarray(topk_subset_mask(member, score, 1))
+        assert out.tolist() == [True, True, False]
+
+    def test_k_zero_keeps_nothing(self):
+        member = jnp.asarray([True, True])
+        score = jnp.asarray([0.2, 0.8])
+        assert not np.asarray(topk_subset_mask(member, score, 0)).any()
+
+    def test_same_count_contract_as_random(self):
+        # drop-in exchangeable with random_subset_mask: both keep
+        # min(k, member.sum()) elements under the same k_max bound
+        import jax
+
+        member = jnp.asarray([True, False, True, True, True])
+        score = jnp.asarray([0.4, 0.9, 0.1, 0.8, 0.6])
+        for k in (0, 2, 4):
+            a = topk_subset_mask(member, score, k, k_max=4)
+            b = random_subset_mask(
+                jax.random.PRNGKey(0), member, k, k_max=4
+            )
+            assert int(a.sum()) == int(b.sum()) == min(k, 4)
+
+
+# ------------------------------------------- program naming / audit surface
+
+
+class TestBucketProgramNames:
+    def test_name_shape(self):
+        from replication_faster_rcnn_tpu.train.warmup import (
+            bucket_train_program_name,
+        )
+
+        assert (
+            bucket_train_program_name("loader", 1, 32, 32)
+            == "train_loader_k1_32x32"
+        )
+        assert (
+            bucket_train_program_name("cached", 2, 64, 64)
+            == "train_cached_k2_64x64"
+        )
+
+    def test_audit_config_expects_all_bucket_programs(self):
+        from replication_faster_rcnn_tpu.analysis.hlolint import (
+            audit_config,
+            expected_program_names,
+        )
+
+        names = expected_program_names(config=audit_config())
+        buckets = [n for n in names if n.endswith(("_32x32", "_64x64"))
+                   and n.startswith("train_")]
+        assert sorted(buckets) == [
+            "train_cached_k1_32x32", "train_cached_k1_64x64",
+            "train_cached_k2_32x32", "train_cached_k2_64x64",
+            "train_loader_k1_32x32", "train_loader_k1_64x64",
+            "train_loader_k2_32x32", "train_loader_k2_64x64",
+        ]
+
+    def test_committed_bank_covers_bucket_programs(self):
+        bank = os.path.join(
+            REPO, "replication_faster_rcnn_tpu", "analysis",
+            "fingerprints", "ci_cpu.json",
+        )
+        with open(bank) as f:
+            programs = set(json.load(f)["programs"])
+        from replication_faster_rcnn_tpu.analysis.hlolint import (
+            AUDIT_FEEDS,
+            AUDIT_KS,
+            audit_config,
+        )
+        from replication_faster_rcnn_tpu.train.warmup import (
+            bucket_train_program_names,
+        )
+
+        missing = set(
+            bucket_train_program_names(
+                audit_config(), feeds=AUDIT_FEEDS, ks=AUDIT_KS
+            )
+        ) - programs
+        assert not missing, f"bucket programs not banked: {sorted(missing)}"
+
+
+# ------------------------------------------------- coco_overfit mini gate
+
+
+def _load_coco_overfit():
+    spec = importlib.util.spec_from_file_location(
+        "coco_overfit", os.path.join(REPO, "benchmarks", "coco_overfit.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def co():
+    return _load_coco_overfit()
+
+
+def _record(co, **over):
+    rec = {
+        "oracle_fails": [],
+        "missing_bucket_programs": [],
+        "legs": {
+            "single": {"train_mAP": 0.5, "images_per_sec": 1.0},
+            "buckets": {"train_mAP": 0.45, "images_per_sec": 0.95},
+            "topk": {"train_mAP": 0.55, "images_per_sec": 1.1},
+        },
+    }
+    rec.update(over)
+    return rec
+
+
+class TestCocoOverfitGate:
+    def test_evaluator_oracles_exact(self, co):
+        assert co.oracle_check() == []
+
+    def test_clean_record_passes(self, co):
+        fails, warns = co.check_gate(_record(co), {"map_floor": 0.2})
+        assert fails == [] and warns == []
+
+    def test_map_floor_fails(self, co):
+        rec = _record(co)
+        rec["legs"]["buckets"]["train_mAP"] = 0.1
+        fails, _ = co.check_gate(rec, {"map_floor": 0.2})
+        assert any("buckets" in s and "floor" in s for s in fails)
+
+    def test_throughput_ratio_fails(self, co):
+        rec = _record(co)
+        rec["legs"]["buckets"]["images_per_sec"] = 0.8  # 0.80x < 0.85
+        fails, _ = co.check_gate(rec, {"map_floor": 0.2})
+        assert any("2-bucket throughput" in s for s in fails)
+
+    def test_missing_bucket_programs_fail(self, co):
+        rec = _record(co, missing_bucket_programs=["train_loader_k1_32x32"])
+        fails, _ = co.check_gate(rec, {"map_floor": 0.2})
+        assert any("train_loader_k1_32x32" in s for s in fails)
+
+    def test_oracle_drift_fails(self, co):
+        rec = _record(co, oracle_fails=["oracle perfect/mAP: got 0.9"])
+        fails, _ = co.check_gate(rec, {"map_floor": 0.2})
+        assert any("oracle" in s for s in fails)
+
+    def test_slow_leg_warns_not_fails(self, co):
+        banked = {
+            "map_floor": 0.2,
+            "legs": {"single": {"images_per_sec": 10.0}},
+        }
+        fails, warns = co.check_gate(_record(co), banked)
+        assert fails == []
+        assert any("single" in s for s in warns)
+
+    def test_curve_throughput_skips_compile_epochs(self, co, tmp_path):
+        p = str(tmp_path / "curve.jsonl")
+        with open(p, "w") as f:
+            for e, r in enumerate([0.1, 0.2, 1.0, 1.2, 0.9]):
+                f.write(json.dumps({"epoch": e, "images_per_sec": r}) + "\n")
+            f.write(json.dumps({"step": 3, "t": 1.0, "loss": 0.5}) + "\n")
+        assert co.curve_throughput(p) == 1.0
+
+    def test_banked_record_shape(self, co):
+        # the committed record the gate compares against
+        with open(co.RECORD_PATH) as f:
+            banked = json.load(f)
+        assert banked["platform"] == "cpu"
+        assert banked["map_floor"] > 0
+        assert set(banked["legs"]) == {"single", "buckets", "topk"}
+        for leg in banked["legs"].values():
+            assert leg["train_mAP"] >= banked["map_floor"]
+            assert leg["images_per_sec"] > 0
+        assert banked["missing_bucket_programs"] == []
+        assert banked["oracle_fails"] == []
+        # the banked run itself satisfied the throughput-ratio gate
+        ratio = (
+            banked["legs"]["buckets"]["images_per_sec"]
+            / banked["legs"]["single"]["images_per_sec"]
+        )
+        assert ratio >= co.THROUGHPUT_RATIO_FLOOR
+
+
+# ------------------------------------------------- bucketed resume parity
+
+
+@pytest.mark.slow
+def test_bucketed_crash_resume_is_exact(tmp_path):
+    """2-bucket counterpart of test_trainer.test_crash_resume_is_exact:
+    a run killed after epoch 1 and resumed must end bitwise-identical to
+    an uninterrupted 2-epoch run — the bucket stream is a pure function
+    of (seed, epoch, batch), so the resumed epoch replays the same
+    program sequence."""
+    import jax
+
+    from replication_faster_rcnn_tpu.config import (
+        FasterRCNNConfig,
+        MeshConfig,
+        ModelConfig,
+    )
+    from replication_faster_rcnn_tpu.train import Trainer
+
+    def cfg(n_epoch):
+        return FasterRCNNConfig(
+            model=ModelConfig(
+                backbone="resnet18", roi_op="align",
+                compute_dtype="float32",
+            ),
+            data=_data_cfg(train_resolutions=BUCKETS),
+            train=TrainConfig(
+                batch_size=8, n_epoch=n_epoch, checkpoint_every_epochs=1
+            ),
+            mesh=MeshConfig(num_data=-1),
+        )
+
+    ds = SyntheticDataset(_data_cfg(), length=16)
+    straight = Trainer(cfg(2), workdir=str(tmp_path / "a"), dataset=ds)
+    straight.train(log_every=100)
+
+    one_epoch = Trainer(cfg(1), workdir=str(tmp_path / "b"), dataset=ds)
+    one_epoch.train(log_every=100)  # saves at epoch end, then "crashes"
+    del one_epoch
+    resumed = Trainer(cfg(2), workdir=str(tmp_path / "b"), dataset=ds)
+    resumed.train(resume=True, log_every=100)
+
+    assert int(straight.state.step) == int(resumed.state.step)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(straight.state.params),
+        jax.tree_util.tree_leaves(resumed.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -780,3 +780,78 @@ class TestDeviceScaleJitter:
 
         with pytest.raises(ValueError, match="augment_scale_device"):
             DataConfig(augment_scale_device=True)
+
+
+class TestCOCOHardening:
+    """data/coco.py edge handling: clamp-to-canvas, degenerate-box drop,
+    and the keep_empty opt-in for zero-annotation images."""
+
+    def _write(self, root):
+        import json
+
+        from PIL import Image
+
+        os.makedirs(os.path.join(root, "annotations"), exist_ok=True)
+        os.makedirs(os.path.join(root, "val2017"), exist_ok=True)
+        for i in (1, 2):
+            Image.new("RGB", (100, 100), (40, 90, 30)).save(
+                os.path.join(root, "val2017", f"{i}.jpg")
+            )
+        ann = {
+            "images": [
+                {"id": 1, "file_name": "1.jpg", "height": 100, "width": 100},
+                {"id": 2, "file_name": "2.jpg", "height": 100, "width": 100},
+            ],
+            "categories": [{"id": 3, "name": "car"}],
+            "annotations": [
+                # overhangs the right/bottom edge (real COCO boxes do by
+                # a pixel or two) -> clamped to the canvas
+                {"id": 1, "image_id": 1, "category_id": 3,
+                 "bbox": [90, 80, 20, 20], "iscrowd": 0},
+                # zero width -> degenerate, dropped
+                {"id": 2, "image_id": 1, "category_id": 3,
+                 "bbox": [10, 10, 0, 5], "iscrowd": 0},
+                # fully outside the canvas -> clamps to zero extent, dropped
+                {"id": 3, "image_id": 1, "category_id": 3,
+                 "bbox": [120, 120, 10, 10], "iscrowd": 0},
+                # image 2 is crowd-only -> all its targets filtered
+                {"id": 4, "image_id": 2, "category_id": 3,
+                 "bbox": [5, 5, 20, 20], "iscrowd": 1},
+            ],
+        }
+        with open(
+            os.path.join(root, "annotations", "instances_val2017.json"), "w"
+        ) as f:
+            json.dump(ann, f)
+
+    def _cfg(self, root):
+        return DataConfig(
+            dataset="coco", root_dir=root, image_size=(50, 50), max_boxes=4
+        )
+
+    def test_clamp_and_degenerate_drop(self, tmp_path):
+        from replication_faster_rcnn_tpu.data.coco import COCODataset
+
+        root = str(tmp_path / "coco")
+        self._write(root)
+        ds = COCODataset(self._cfg(root), "val2017")
+        assert len(ds) == 1  # crowd-only image excluded by default
+        s = ds[0]
+        # only the clamped box survives; 100x100 -> 50x50 halves coords:
+        # xywh [90,80,20,20] clamps to x 90..100, y 80..100
+        assert int(s["mask"].sum()) == 1
+        np.testing.assert_allclose(s["boxes"][0], [40.0, 45.0, 50.0, 50.0])
+        assert np.all(s["boxes"][1:] == -1.0)
+
+    def test_keep_empty_yields_all_padding_sample(self, tmp_path):
+        from replication_faster_rcnn_tpu.data.coco import COCODataset
+
+        root = str(tmp_path / "coco")
+        self._write(root)
+        ds = COCODataset(self._cfg(root), "val2017", keep_empty=True)
+        assert len(ds) == 2
+        s = ds[1]  # the crowd-only image, as valid all-padding sample
+        assert s["image"].shape == (50, 50, 3)
+        assert int(s["mask"].sum()) == 0
+        assert np.all(s["labels"] == -1)
+        assert np.all(s["boxes"] == -1.0)

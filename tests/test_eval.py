@@ -547,3 +547,175 @@ class TestTTADecode:
         ds = SyntheticDataset(cfg.data, "val", length=4)
         res = ev.evaluate(variables, ds, batch_size=2)
         assert np.isfinite(res["mAP"])
+
+
+class TestCocoEval101:
+    """Hand-computed oracles pinning eval/coco_eval.py to the COCO
+    protocol EXACTLY: 101-point interpolated AP, the .50:.05:.95 sweep,
+    COCOeval's greedy matching (an ignored gt is consumed by its match),
+    area-range ignore semantics, and the -1 no-gt convention."""
+
+    @staticmethod
+    def _det(boxes, scores, classes):
+        return {
+            "boxes": np.asarray(boxes, float).reshape(-1, 4),
+            "scores": np.asarray(scores, float),
+            "classes": np.asarray(classes, int),
+        }
+
+    @staticmethod
+    def _gt(boxes, labels, ignore=None):
+        g = {
+            "boxes": np.asarray(boxes, float).reshape(-1, 4),
+            "labels": np.asarray(labels, int),
+        }
+        if ignore is not None:
+            g["ignore"] = np.asarray(ignore, bool)
+        return g
+
+    def _summary(self, *a, **kw):
+        from replication_faster_rcnn_tpu.eval.coco_eval import coco_summary
+
+        return coco_summary(*a, **kw)
+
+    def test_perfect_detections_sweep_and_area_slices(self):
+        # a small gt (area 100) and a medium gt (area 1600), each
+        # matched exactly: 1.0 everywhere except the empty large slice
+        r = self._summary(
+            [self._det([[0, 0, 10, 10]], [0.9], [1]),
+             self._det([[0, 0, 40, 40]], [0.8], [2])],
+            [self._gt([[0, 0, 10, 10]], [1]),
+             self._gt([[0, 0, 40, 40]], [2])],
+            num_classes=3,
+        )
+        for k in ("mAP", "AP50", "AP75", "AP_small", "AP_medium"):
+            assert r[k] == 1.0, k
+        assert r["AP_large"] == -1.0
+        np.testing.assert_array_equal(r["ap_per_class"][1:], [1.0, 1.0])
+        assert np.isnan(r["ap_per_class"][0])  # background never scored
+
+    def test_iou_060_matches_three_thresholds(self):
+        # IoU exactly 60/100: perfect at .50/.55/.60, zero above -> 3/10
+        r = self._summary(
+            [self._det([[0, 0, 10, 6]], [0.9], [1])],
+            [self._gt([[0, 0, 10, 10]], [1])],
+            num_classes=2,
+        )
+        assert r["mAP"] == 3.0 / 10.0
+        assert r["AP50"] == 1.0 and r["AP75"] == 0.0
+
+    def test_101_point_interpolation_exact(self):
+        # TP(.9), FP(.8), TP(.7) over 2 gts: envelope 1.0 through recall
+        # .5 (51 grid points) then 2/3 (50 points) — not the trapezoid
+        # area voc_eval.coco_map would integrate
+        r = self._summary(
+            [self._det(
+                [[0, 0, 10, 10], [50, 50, 60, 60], [20, 20, 30, 30]],
+                [0.9, 0.8, 0.7], [1, 1, 1],
+            )],
+            [self._gt([[0, 0, 10, 10], [20, 20, 30, 30]], [1, 1])],
+            num_classes=2, iou_thresholds=[0.5],
+        )
+        want = (51 * 1.0 + 50 * (2.0 / 3.0)) / 101.0
+        np.testing.assert_allclose(r["mAP"], want, rtol=0, atol=1e-12)
+
+    def test_ignored_gt_absorbs_exactly_one_detection(self):
+        # COCOeval semantics (unlike the VOC-devkit greedy rule): the
+        # second detection on an ignored gt is a plain FP, and the real
+        # gt stays unmatched -> AP 0
+        r = self._summary(
+            [self._det([[0, 0, 10, 10], [0, 0, 10, 10]], [0.9, 0.8],
+                       [1, 1])],
+            [self._gt([[0, 0, 10, 10], [50, 50, 60, 60]], [1, 1],
+                      ignore=[True, False])],
+            num_classes=2,
+        )
+        assert r["mAP"] == 0.0
+
+    def test_base_ignore_composes_with_n_gt(self):
+        # a base-ignored (VOC 'difficult') gt is not counted: one real
+        # gt matched perfectly -> 1.0 despite the ignored neighbor
+        r = self._summary(
+            [self._det([[0, 0, 10, 10]], [0.9], [1])],
+            [self._gt([[0, 0, 10, 10], [30, 30, 40, 40]], [1, 1],
+                      ignore=[False, True])],
+            num_classes=2,
+        )
+        assert r["mAP"] == 1.0
+
+    def test_out_of_range_unmatched_det_excluded_from_slice(self):
+        # a stray small FP outranking the TP halves AP at "all" but is
+        # outside the large slice entirely -> AP_large stays 1.0
+        r = self._summary(
+            [self._det([[0, 0, 100, 100], [0, 0, 4, 4]], [0.9, 0.95],
+                       [1, 1])],
+            [self._gt([[0, 0, 100, 100]], [1])],
+            num_classes=2, iou_thresholds=[0.5],
+        )
+        assert r["AP_large"] == 1.0
+        assert 0.0 < r["mAP"] < 1.0
+
+    def test_max_dets_truncates_by_score(self):
+        # per-image budget keeps the TOP-scoring dets: with max_dets=2
+        # the low-score TP is cut (AP 0); at 3 it survives
+        dets = [self._det(
+            [[50, 50, 60, 60], [70, 70, 80, 80], [0, 0, 10, 10]],
+            [0.9, 0.8, 0.7], [1, 1, 1],
+        )]
+        gts = [self._gt([[0, 0, 10, 10]], [1])]
+        r2 = self._summary(dets, gts, num_classes=2, max_dets=2)
+        r3 = self._summary(dets, gts, num_classes=2, max_dets=3)
+        assert r2["mAP"] == 0.0
+        assert r3["mAP"] > 0.0
+
+    def test_empty_inputs_are_minus_one(self):
+        r = self._summary([], [], num_classes=2)
+        for k in ("mAP", "AP50", "AP75", "AP_small", "AP_medium",
+                  "AP_large"):
+            assert r[k] == -1.0, k
+
+    def test_class_without_gt_is_nan_and_excluded(self):
+        # class 2 has detections but no gt anywhere: NaN per-class, and
+        # the aggregate averages over class 1 only
+        r = self._summary(
+            [self._det([[0, 0, 10, 10], [20, 20, 30, 30]], [0.9, 0.8],
+                       [1, 2])],
+            [self._gt([[0, 0, 10, 10]], [1])],
+            num_classes=3,
+        )
+        assert np.isnan(r["ap_per_class"][2])
+        assert r["mAP"] == 1.0  # the class-1 perfect match alone
+
+
+class TestSummaryScalars:
+    """The flat telemetry schema shared by the VOC and COCO metrics:
+    scalar aggregates + AP/<class-name> rows for finite per-class APs."""
+
+    def _result(self, num_classes):
+        aps = np.full(num_classes, np.nan)
+        aps[1] = 0.5
+        if num_classes > 3:
+            aps[3] = 0.25
+        return {"mAP": 0.375, "AP50": 0.6, "ap_per_class": aps}
+
+    def test_voc_class_names(self):
+        from replication_faster_rcnn_tpu.config import VOC_CLASSES
+        from replication_faster_rcnn_tpu.eval.evaluator import (
+            summary_scalars,
+        )
+
+        out = summary_scalars(self._result(21), 21)
+        assert out["mAP"] == 0.375 and out["AP50"] == 0.6
+        assert out[f"AP/{VOC_CLASSES[1]}"] == 0.5
+        assert out[f"AP/{VOC_CLASSES[3]}"] == 0.25
+        # NaN rows are dropped, the array itself is not in the output
+        assert all(isinstance(v, float) for v in out.values())
+        assert sum(k.startswith("AP/") for k in out) == 2
+
+    def test_numeric_fallback_names(self):
+        from replication_faster_rcnn_tpu.eval.evaluator import (
+            summary_scalars,
+        )
+
+        out = summary_scalars(self._result(5), 5)
+        assert out["AP/1"] == 0.5 and out["AP/3"] == 0.25

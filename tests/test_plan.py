@@ -235,6 +235,18 @@ class TestDecisionTableCells:
         with pytest.raises(ValueError, match="spatial"):
             apply_table(ctx)
 
+    def test_buckets_backend(self):
+        ctx = _ctx(train_buckets=2, backend="spmd")
+        assert "buckets_backend" in _fired(ctx)
+        with pytest.raises(ValueError, match="buckets"):
+            apply_table(ctx)
+
+    def test_buckets_spatial(self):
+        ctx = _ctx(train_buckets=2, spatial=True, num_model=2)
+        assert "buckets_spatial" in _fired(ctx)
+        with pytest.raises(ValueError, match="buckets"):
+            apply_table(ctx)
+
     def test_names_filter_restricts_cells(self):
         ctx = _ctx(optimizer="lamb", lars=True, spatial=True, num_model=1)
         only = check_cells(ctx, names=SPATIAL_CELLS)

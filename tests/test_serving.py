@@ -577,8 +577,22 @@ class TestServingSpecs:
         serving = {n for n in extra if n.startswith("serve_")}
         # 4 bucket-matrix programs + the serve pallas twin (ISSUE 13)
         assert len(serving) == 5 and "serve_64x64_b1__pallas" in serving
-        # the only other config-dependent names are the remaining twins
-        assert extra - serving == {
+        # the only other config-dependent names are the remaining pallas
+        # twins and the per-bucket training programs (ISSUE 15: the audit
+        # config sets data.train_resolutions)
+        from replication_faster_rcnn_tpu.train.warmup import (
+            bucket_train_program_names,
+        )
+
+        buckets = set(
+            bucket_train_program_names(
+                hlolint.audit_config(),
+                feeds=hlolint.AUDIT_FEEDS,
+                ks=hlolint.AUDIT_KS,
+            )
+        )
+        assert buckets <= extra and len(buckets) == 8
+        assert extra - serving - buckets == {
             "train_loader_k1__pallas",
             "eval_infer__pallas",
         }
