@@ -25,7 +25,14 @@ Measured legs:
     makes the selected replica actually die, failover + breakers absorb
     it, and after the run the prober must notice the death (lease
     expiry -> dead) and re-admit the revived replica (rejoin probes) —
-    the full self-healing loop, asserted structurally.
+    the full self-healing loop, asserted structurally.  The leg runs
+    under a SpanTracer, producing the merged Chrome trace the PR 16
+    observability contract requires: at least one failed-over request
+    whose attempt spans touch two distinct replicas under one trace id.
+  * slo      — the router's attempt-level burn-rate tracker (windows
+    shrunk to benchmark scale) must ALARM (burn > 1 on both windows)
+    right after the kill window, and clear (burn < 1) after the victim
+    rejoins and a clean burst ages the errors out.
   * hedge    — a fast/slow replica pair under tight hedge clamps: the
     p99-derived hedge must fire and win at least once (tail tolerance
     failover alone cannot see).
@@ -35,7 +42,9 @@ save, --update to re-bank, --no-check to just measure). The gate fails
 (exit 1) when availability drops below --min-availability (0.999),
 fleet/single speedup falls below --min-speedup (2.0), the self-healing
 structure breaks (no kill, no failover, no death detection, no rejoin,
-no hedge win), or fleet throughput regresses >tol vs the banked record.
+no hedge win), the burn-rate alarm fails to fire through the kill or to
+clear after rejoin, the merged trace lacks cross-replica failover
+evidence, or fleet throughput regresses >tol vs the banked record.
 
 Usage:
   python benchmarks/fleet_profile.py            # measure + gate
@@ -56,7 +65,8 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 RECORDS_DIR = os.path.join(_REPO, "benchmarks", "records")
-SCHEMA = "fleet_profile/v1"
+# v2: adds the slo burn-rate leg + merged-trace failover evidence
+SCHEMA = "fleet_profile/v2"
 DEFAULT_TOL = 0.25  # sleep-paced throughput is steadier than compute,
 #                     but the CI host still jitters thread wakeups
 DEFAULT_MIN_SPEEDUP = 2.0
@@ -162,6 +172,31 @@ def check_regression(
         failures.append(
             "hedge leg recorded no hedge win against the slow replica"
         )
+    # the SLO engine: the burn-rate alarm must FIRE while the kill's
+    # failed attempts sit in both windows, and CLEAR once the victim
+    # rejoined and a clean burst aged them out
+    slo = current.get("slo") or {}
+    if slo:
+        if not slo.get("alarm_during_kill"):
+            failures.append(
+                "slo: burn-rate alarm did not fire during the kill window "
+                f"(burn short={slo.get('burn_during_kill', {}).get('short')} "
+                f"long={slo.get('burn_during_kill', {}).get('long')})"
+            )
+        if not slo.get("cleared_after_rejoin"):
+            failures.append(
+                "slo: burn rate did not drop below 1 after the victim "
+                "rejoined and the clean burst ran "
+                f"(burn short={slo.get('burn_after_rejoin', {}).get('short')} "
+                f"long={slo.get('burn_after_rejoin', {}).get('long')})"
+            )
+    # tracing: the merged Chrome trace must show one failed-over request
+    # whose attempt spans touch >= 2 replicas under a single trace id
+    if current.get("trace_failover_evidence") is False:
+        failures.append(
+            "trace: no request in the merged trace failed on one replica "
+            "and succeeded on another under a single trace id"
+        )
     return failures, warnings
 
 
@@ -215,6 +250,29 @@ def build_fleet(clients, cfg):
     return registry, prober, router
 
 
+def _failover_trace_evidence(events):
+    """The trace id of one failed-over request in the merged Chrome
+    trace: its ``fleet/attempt`` spans touch >= 2 distinct replicas,
+    with at least one failed and one successful attempt — the
+    observability acceptance evidence.  None when no request qualifies."""
+    by_trace = {}
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("name") != "fleet/attempt":
+            continue
+        args = ev.get("args") or {}
+        if args.get("trace_id"):
+            by_trace.setdefault(args["trace_id"], []).append(args)
+    for trace_id, attempts in sorted(by_trace.items()):
+        replicas = {a.get("replica") for a in attempts}
+        if (
+            len(replicas) >= 2
+            and any(a.get("ok") for a in attempts)
+            and any(not a.get("ok") for a in attempts)
+        ):
+            return trace_id
+    return None
+
+
 def _wait_for(predicate, timeout_s: float = 10.0) -> bool:
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
@@ -236,11 +294,14 @@ def profile(
     seed: int = 0,
 ):
     import dataclasses
+    import tempfile
 
     from replication_faster_rcnn_tpu.config import FleetConfig
     from replication_faster_rcnn_tpu.faultlib import failpoints
     from replication_faster_rcnn_tpu.serving import loadgen
     from replication_faster_rcnn_tpu.serving.fleet.router import content_key
+    from replication_faster_rcnn_tpu.telemetry import spans as tspans
+    from replication_faster_rcnn_tpu.telemetry.report import load_trace_events
 
     service_s = service_ms / 1000.0
     cfg = FleetConfig(
@@ -259,6 +320,11 @@ def profile(
         hedge=True,
         hedge_floor_ms=100.0,
         hedge_ceiling_ms=400.0,
+        # shrink the SLO windows to benchmark scale so the burn-rate
+        # alarm can fire during the kill window AND age back out within
+        # one run (production defaults are 5 m / 1 h)
+        slo_short_window_s=0.4,
+        slo_long_window_s=1.2,
     )
     # unique content per request: every dispatch must reach a replica
     requests = [
@@ -277,7 +343,9 @@ def profile(
         prober.stop()
         router.close()
 
-    # -- fleet leg: 3 replicas, seeded kill at ~2/3 of the run
+    # -- fleet leg: 3 replicas, seeded kill at ~2/3 of the run; traced,
+    # so the merged Chrome trace must show a failed-over request's
+    # spans crossing the router and two replicas under one trace id
     clients = {
         rid: make_sim_replica(rid, service_s) for rid in ("r0", "r1", "r2")
     }
@@ -291,10 +359,17 @@ def profile(
             )
         ]
     )
+    trace_dir = tempfile.mkdtemp(prefix="fleet_profile_trace_")
+    trace_path = os.path.join(trace_dir, "trace.json")
+    tracer = tspans.SpanTracer(trace_path)
+    tspans.set_tracer(tracer)
     try:
         fleet = loadgen.run_fleet_loop(
             router.dispatch, requests, concurrency=concurrency
         )
+        # sample the burn rate NOW, while the kill's failed attempts
+        # still sit inside both windows — the alarm must be firing
+        slo_during = router.slo.snapshot()
         victims = [rid for rid, c in clients.items() if c.killed]
         victim = victims[0] if victims else None
         # self-healing, second half: the prober lease-expires the dead
@@ -307,11 +382,23 @@ def profile(
         rejoined = victim is not None and _wait_for(
             lambda: victim in registry.in_rotation()
         )
+        # clean burst + window turnover: with the victim back, the burn
+        # rate must drop below 1 on both windows (the alarm clears)
+        clean = loadgen.run_fleet_loop(
+            router.dispatch, requests, concurrency=concurrency
+        )
+        cleared = _wait_for(
+            lambda: max(router.slo.burn_rates().values()) < 1.0
+        )
+        slo_after = router.slo.snapshot()
         router_stats = router.snapshot()["router"]
     finally:
         failpoints.disarm()
         prober.stop()
         router.close()
+        tracer.flush()
+        tspans.set_tracer(tspans.NULL_TRACER)
+    failover_trace = _failover_trace_evidence(load_trace_events(trace_path))
 
     # -- hedge leg: fast/slow pair, tight clamps — the hedge must win
     hedge_cfg = dataclasses.replace(
@@ -357,6 +444,18 @@ def profile(
         "victim_rejoined": rejoined,
         "failovers": router_stats["failovers"],
         "router_stats": router_stats,
+        "slo": {
+            "short_window_s": cfg.slo_short_window_s,
+            "long_window_s": cfg.slo_long_window_s,
+            "availability_target": cfg.slo_availability_target,
+            "burn_during_kill": slo_during["burn_rates"],
+            "alarm_during_kill": slo_during["alarm"],
+            "burn_after_rejoin": slo_after["burn_rates"],
+            "cleared_after_rejoin": cleared,
+            "clean_burst_availability": clean["availability"],
+        },
+        "trace_failover_evidence": failover_trace is not None,
+        "failover_trace_id": failover_trace,
         "hedge": {
             "p99_ms": hedge_run["p99_ms"],
             "availability": hedge_run["availability"],

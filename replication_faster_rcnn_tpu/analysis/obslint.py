@@ -1,0 +1,242 @@
+"""obslint — AST lint for the unified-metrics contract.
+
+PR 16 replaced every hand-rolled stats dict (engine, batcher, router,
+breakers) with instruments owned by
+:class:`~replication_faster_rcnn_tpu.telemetry.metrics.MetricsRegistry`:
+counters/gauges/histograms carry their own locks, and the ``/stats`` /
+``/metrics`` render paths read them back out of the registry.  The
+contract only holds if nobody quietly grows a new mutable stats dict on
+the side — the exact drift this analyzer gates:
+
+  OB001  mutation of a shared stats mapping (an attribute named
+         ``stats``/``*_stats``/``_counters``) outside ``__init__``:
+         subscript assignment/augmented assignment or a mutating method
+         call (``update``/``setdefault``/``pop``/``clear``/...).
+         Construction in ``__init__`` is pre-publication and exempt;
+         reads are always fine; ``telemetry/metrics.py`` itself (the
+         registry the rule points at) is exempt.
+
+Pure AST, no call graph: the naming convention IS the contract (a
+shared stats surface not named like one is invisible here — threadlint's
+TL001 still covers it as a plain unlocked shared write).  Findings
+resolve against the same ``analysis/baseline.toml`` as jaxlint and
+threadlint and ship through ``frcnn check`` (``--rules OB001``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from replication_faster_rcnn_tpu.analysis.jaxlint import (
+    Baseline,
+    Finding,
+    Waiver,
+    default_baseline_path,
+    iter_package_files,
+    load_baseline,
+    package_root,
+)
+
+RULES: Dict[str, str] = {
+    "OB001": (
+        "shared stats mapping mutated outside MetricsRegistry "
+        "(use registry counters/gauges/histograms)"
+    ),
+}
+
+# attribute names that declare "I am a stats surface"
+_STATS_ATTR_RE = re.compile(r"^_?(stats|counters)$|_stats$")
+
+# method calls that mutate a dict in place
+_DICT_MUTATORS = {
+    "update",
+    "setdefault",
+    "pop",
+    "popitem",
+    "clear",
+    "__setitem__",
+}
+
+_INIT_NAMES = {"__init__", "__post_init__", "__new__"}
+
+# the registry module itself owns its tables
+_EXEMPT_SUFFIXES = (os.path.join("telemetry", "metrics.py"),)
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: List[Tuple[Finding, str]]
+    excluded: List[Finding]
+    stale_waivers: List[Waiver]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rules": RULES,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [
+                {**f.to_dict(), "reason": r} for f, r in self.suppressed
+            ],
+            "excluded_count": len(self.excluded),
+            "stale_waivers": [dataclasses.asdict(w) for w in self.stale_waivers],
+            "ok": not self.findings and not self.stale_waivers,
+        }
+
+
+def _stats_attr(node: ast.AST) -> Optional[str]:
+    """``<expr>.<attr>`` where attr names a stats surface -> dotted-ish
+    label for the message (``self.stats``, ``router.stats``)."""
+    if not isinstance(node, ast.Attribute):
+        return None
+    if not _STATS_ATTR_RE.search(node.attr):
+        return None
+    base = node.value
+    if isinstance(base, ast.Name):
+        return f"{base.id}.{node.attr}"
+    if isinstance(base, ast.Attribute):
+        return f"<expr>.{base.attr}.{node.attr}"
+    return f"<expr>.{node.attr}"
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rel_path: str) -> None:
+        self.rel_path = rel_path
+        self.findings: List[Finding] = []
+        self._func_stack: List[str] = []
+
+    # ------------------------------------------------------- scope tracking
+
+    def _qualname(self) -> str:
+        return ".".join(self._func_stack) if self._func_stack else "<module>"
+
+    def _in_init(self) -> bool:
+        return bool(self._func_stack) and (
+            self._func_stack[-1] in _INIT_NAMES
+        )
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # ------------------------------------------------------------ the rule
+
+    def _emit(self, node: ast.AST, label: str, how: str) -> None:
+        self.findings.append(
+            Finding(
+                rule="OB001",
+                path=self.rel_path,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                func=self._qualname(),
+                message=(
+                    f"{how} on shared stats mapping {label!r} outside "
+                    "MetricsRegistry — register a counter/gauge/histogram "
+                    "instead of mutating a dict"
+                ),
+            )
+        )
+
+    def _check_store_target(self, target: ast.AST, node: ast.AST) -> None:
+        # self.stats["k"] = v  /  self.stats["k"] += 1
+        if isinstance(target, ast.Subscript):
+            label = _stats_attr(target.value)
+            if label is not None and not self._in_init():
+                self._emit(node, label, "subscript write")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_store_target(t, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_store_target(node.target, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._check_store_target(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._check_store_target(t, node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # self.stats.update(...) and friends
+        fn = node.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in _DICT_MUTATORS
+        ):
+            label = _stats_attr(fn.value)
+            if label is not None and not self._in_init():
+                self._emit(node, label, f".{fn.attr}() call")
+        self.generic_visit(node)
+
+
+def _rel(path: str, pkg_root: str) -> str:
+    # repo-relative posix path, matching callgraph.parse_modules so the
+    # shared baseline's waiver paths resolve identically across analyzers
+    repo_root = os.path.dirname(os.path.abspath(pkg_root))
+    ap = os.path.abspath(path)
+    if ap.startswith(repo_root + os.sep):
+        return os.path.relpath(ap, repo_root).replace(os.sep, "/")
+    return os.path.basename(ap)
+
+
+def lint_paths(
+    paths: Sequence[str],
+    baseline: Optional[str] = None,
+    pkg_root: Optional[str] = None,
+) -> LintResult:
+    root = pkg_root or package_root()
+    raw: List[Finding] = []
+    for path in paths:
+        if any(str(path).endswith(sfx) for sfx in _EXEMPT_SUFFIXES):
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=str(path))
+        except (OSError, SyntaxError):
+            continue  # unreadable/unparseable files are other gates' problem
+        visitor = _Visitor(_rel(str(path), root))
+        visitor.visit(tree)
+        raw.extend(visitor.findings)
+    base = (
+        load_baseline(baseline).restricted(RULES) if baseline else Baseline()
+    )
+    findings: List[Finding] = []
+    suppressed: List[Tuple[Finding, str]] = []
+    excluded: List[Finding] = []
+    for f in raw:
+        if base.excluded(f):
+            excluded.append(f)
+            continue
+        w = base.waive(f)
+        if w is not None:
+            suppressed.append((f, w.reason))
+        else:
+            findings.append(f)
+    stale = [w for w in base.waivers if not w.used]
+    return LintResult(findings, suppressed, excluded, stale)
+
+
+def lint_package(baseline: Optional[str] = "default") -> LintResult:
+    if baseline == "default":
+        baseline = default_baseline_path()
+        if not os.path.exists(baseline):
+            baseline = None
+    return lint_paths(iter_package_files(), baseline=baseline)

@@ -766,7 +766,10 @@ def cmd_warmup(args) -> int:
         import os
 
         os.makedirs(args.telemetry, exist_ok=True)
-        tracer = tspans.SpanTracer(os.path.join(args.telemetry, "trace.json"))
+        tracer = tspans.SpanTracer(
+            os.path.join(args.telemetry, "trace.json"),
+            max_events=cfg.telemetry.trace_max_events,
+        )
         tspans.set_tracer(tracer)
     try:
         times = warmup_compile(
@@ -827,6 +830,21 @@ def cmd_serve(args) -> int:
         return _cmd_serve_impl(args)
 
 
+def _replica_trace_rank(replica_id: str) -> int:
+    """Stable nonzero rank for a replica's trace file name. The
+    telemetry report merges DIR/trace.json (the fleet front writes it —
+    rank 0) with every DIR/trace.rankN.json sibling, so replicas
+    sharing the front's DIR need a small stable N >= 1: the digits of
+    the conventional r<K> ids shifted by one, else a crc of the id."""
+    import re as _re
+    import zlib
+
+    m = _re.search(r"(\d+)$", replica_id)
+    if m:
+        return int(m.group(1)) + 1
+    return zlib.crc32(replica_id.encode()) % 9000 + 1000
+
+
 def _cmd_serve_impl(args) -> int:
     _apply_device(args.device)
     import contextlib
@@ -871,6 +889,23 @@ def _cmd_serve_impl(args) -> int:
 
         failpoints.configure(cfg.debug.chaos_spec)
     maybe_enable_compile_cache(cfg)
+    tracer = None
+    if args.telemetry:
+        import os
+
+        from replication_faster_rcnn_tpu.telemetry import spans as tspans
+
+        os.makedirs(args.telemetry, exist_ok=True)
+        rank = (
+            _replica_trace_rank(args.replica_id) if args.replica_id else None
+        )
+        name = f"trace.rank{rank}.json" if rank else "trace.json"
+        tracer = tspans.SpanTracer(
+            os.path.join(args.telemetry, name),
+            rank=rank,
+            max_events=cfg.telemetry.trace_max_events,
+        )
+        tspans.set_tracer(tracer)
     model, variables = load_eval_variables(cfg, args.workdir, args.checkpoint_step)
     engine = InferenceEngine(cfg, model, variables, warmup=True)
     stack = contextlib.ExitStack()
@@ -943,6 +978,8 @@ def _cmd_serve_impl(args) -> int:
             signal.signal(signal.SIGTERM, prev_term)
             server.server_close()
             engine.close()
+            if tracer is not None:
+                tracer.flush()
     return 0
 
 
@@ -989,6 +1026,18 @@ def _cmd_fleet_impl(args) -> int:
         from replication_faster_rcnn_tpu.faultlib import failpoints
 
         failpoints.configure(args.chaos_spec)
+
+    tracer = None
+    if args.telemetry:
+        from replication_faster_rcnn_tpu.config import TelemetryConfig
+        from replication_faster_rcnn_tpu.telemetry import spans as tspans
+
+        os.makedirs(args.telemetry, exist_ok=True)
+        tracer = tspans.SpanTracer(
+            os.path.join(args.telemetry, "trace.json"),
+            max_events=TelemetryConfig().trace_max_events,
+        )
+        tspans.set_tracer(tracer)
 
     registry = fleet_mod.ReplicaRegistry(fleet_cfg)
     for url in args.replica:
@@ -1057,6 +1106,8 @@ def _cmd_fleet_impl(args) -> int:
             with open(path, "a") as fh:
                 fh.write(json.dumps(router.snapshot()) + "\n")
             print(f"fleet telemetry appended to {path}", file=sys.stderr)
+            if tracer is not None:
+                tracer.flush()
     return 0
 
 
@@ -1144,21 +1195,26 @@ def cmd_trace_summary(args) -> int:
 
 def cmd_check(args) -> int:
     """Static lint gate over the package (or explicit paths): jaxlint's
-    jit-hygiene rules JX001-JX007 plus threadlint's host-concurrency
-    rules TL001-TL006, resolved against the shared analysis/baseline.toml.
-    Pure AST work — no jax import, fast enough to gate every PR. Exits
-    nonzero on any unsuppressed finding or stale waiver; --rules narrows
-    to a comma-separated subset (an analyzer with no selected rule is
+    jit-hygiene rules JX001-JX007, threadlint's host-concurrency rules
+    TL001-TL006, and obslint's unified-metrics contract OB001, resolved
+    against the shared analysis/baseline.toml. Pure AST work — no jax
+    import, fast enough to gate every PR. Exits nonzero on any
+    unsuppressed finding or stale waiver; --rules narrows to a
+    comma-separated subset (an analyzer with no selected rule is
     skipped entirely)."""
     import json
 
-    from replication_faster_rcnn_tpu.analysis import jaxlint, threadlint
+    from replication_faster_rcnn_tpu.analysis import jaxlint, obslint, threadlint
 
-    analyzers = [("jaxlint", jaxlint), ("threadlint", threadlint)]
+    analyzers = [
+        ("jaxlint", jaxlint),
+        ("threadlint", threadlint),
+        ("obslint", obslint),
+    ]
     selected = None
     if getattr(args, "rules", None):
         selected = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
-        known = set(jaxlint.RULES) | set(threadlint.RULES)
+        known = set(jaxlint.RULES) | set(threadlint.RULES) | set(obslint.RULES)
         unknown = selected - known
         if unknown:
             print(
@@ -1297,13 +1353,39 @@ def cmd_audit(args) -> int:
 def cmd_telemetry(args) -> int:
     """Phase-time + train-health report from a --telemetry run dir. Pure
     host-side parsing (telemetry/report.py) — no jax import, safe with a
-    dead TPU tunnel, runnable on a laptop holding only the artifacts."""
+    dead TPU tunnel, runnable on a laptop holding only the artifacts.
+    --trace-id narrows to one request's cross-process hop timeline from
+    the merged trace (router + replica spans under one trace id)."""
     import json
 
     from replication_faster_rcnn_tpu.telemetry.report import (
+        TRACE_FILE,
         format_report,
+        format_trace_timeline,
+        load_trace_events,
+        rank_variants,
         summarize_run,
+        trace_timeline,
     )
+
+    if getattr(args, "trace_id", None):
+        events = []
+        for _rank, path in rank_variants(args.run_dir, TRACE_FILE):
+            events.extend(load_trace_events(path))
+        timeline = trace_timeline(events, args.trace_id)
+        if timeline is None:
+            print(
+                f"no spans for trace id {args.trace_id!r} under "
+                f"{args.run_dir}",
+                file=sys.stderr,
+            )
+            return 1
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(timeline, f, indent=2)
+            print(f"timeline written to {args.json}")
+        print(format_trace_timeline(timeline))
+        return 0
 
     summary = summarize_run(args.run_dir)
     if args.json:
@@ -1466,6 +1548,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                               "advertise draining, keep serving, then stop "
                               "accepting) so the fleet router rotates the "
                               "replica out without dropped traffic")
+    p_serve.add_argument("--telemetry", default=None, metavar="DIR",
+                         help="write request hop spans (serve/request, "
+                              "serve/queue_wait, serve/dispatch) to a "
+                              "Chrome-trace file in DIR: trace.json, or "
+                              "trace.rankN.json when --replica-id is set "
+                              "so replicas can share the fleet front's DIR "
+                              "and `frcnn telemetry DIR --trace-id X` "
+                              "merges them into one timeline")
     p_serve.set_defaults(fn=cmd_serve)
 
     p_fleet = sub.add_parser(
@@ -1528,7 +1618,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_fleet.add_argument("--telemetry", default=None, metavar="DIR",
                          help="append a final router/registry snapshot to "
                               "DIR/fleet.jsonl on shutdown (read by "
-                              "`frcnn telemetry`)")
+                              "`frcnn telemetry`) and write the router's "
+                              "request/attempt spans to DIR/trace.json — "
+                              "point replicas' `serve --telemetry` at the "
+                              "same DIR for the merged cross-process "
+                              "`--trace-id` timeline")
     p_fleet.set_defaults(fn=cmd_fleet)
 
     p_chaos = sub.add_parser(
@@ -1583,12 +1677,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tel.add_argument("run_dir")
     p_tel.add_argument("--json", default=None, metavar="PATH",
                        help="also write the summary as JSON")
+    p_tel.add_argument("--trace-id", default=None, metavar="HEX32",
+                       help="print one request's hop timeline (queue-wait/"
+                            "compute/network per hop) from the merged trace "
+                            "instead of the full report")
     p_tel.set_defaults(fn=cmd_telemetry)
 
     p_check = sub.add_parser(
         "check",
         help="static lint gate: jit-hygiene (jaxlint JX001-JX007) + "
-             "host-concurrency contracts (threadlint TL001-TL006) against "
+             "host-concurrency contracts (threadlint TL001-TL006) + "
+             "unified-metrics contract (obslint OB001) against "
              "the committed suppression baseline; exits nonzero on any "
              "unsuppressed finding",
     )

@@ -875,6 +875,16 @@ class FleetConfig:
     # --replica-id` advertises draining=true in /healthz (so the router
     # stops routing to it) before it stops accepting connections
     drain_grace_s: float = 1.0
+    # ---- SLO error-budget burn-rate (telemetry/slo_burn.py): every
+    # dispatch ATTEMPT outcome (not just final request outcomes — with
+    # failover a dying replica barely dents request availability, but
+    # its failed attempts are the leading indicator) feeds multi-window
+    # burn accounting; the alarm (burn > 1 on BOTH windows) surfaces in
+    # /stats and auto-demotes an alarming canary back to serving role
+    slo_availability_target: float = 0.999  # error budget = 1 - target
+    slo_latency_target_ms: float = 0.0  # 0 = availability-only budget
+    slo_short_window_s: float = 300.0  # alarm-clearing window (5 m)
+    slo_long_window_s: float = 3600.0  # alarm-meaning window (1 h)
 
     def __post_init__(self):
         if self.probe_interval_s <= 0:
@@ -939,6 +949,22 @@ class FleetConfig:
             raise ValueError(
                 f"fleet.drain_grace_s must be >= 0, got {self.drain_grace_s}"
             )
+        if not 0.0 < self.slo_availability_target < 1.0:
+            raise ValueError(
+                "fleet.slo_availability_target must be in (0, 1), got "
+                f"{self.slo_availability_target}"
+            )
+        if self.slo_latency_target_ms < 0:
+            raise ValueError(
+                "fleet.slo_latency_target_ms must be >= 0, got "
+                f"{self.slo_latency_target_ms}"
+            )
+        if not 0 < self.slo_short_window_s < self.slo_long_window_s:
+            raise ValueError(
+                "fleet SLO windows need 0 < slo_short_window_s < "
+                f"slo_long_window_s, got short={self.slo_short_window_s} "
+                f"long={self.slo_long_window_s}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -971,6 +997,47 @@ class OpsConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Observability layer knobs (telemetry/).
+
+    The serving tiers are instrumented unconditionally through
+    ``current_tracer()`` / ``MetricsRegistry`` — these knobs govern the
+    cross-process pieces: whether trace context crosses HTTP hops, how
+    large a per-process trace buffer may grow, and the latency
+    histogram bucket grid both tiers register with.
+    """
+
+    # inject/extract the W3C traceparent header across fleet HTTP hops;
+    # off = spans still record locally but requests don't correlate
+    trace_propagation: bool = True
+    # SpanTracer in-memory event bound for serving-tier tracers
+    # (overflow drops events and counts them, never grows)
+    trace_max_events: int = 200_000
+    # latency histogram upper bounds in ms; () = the built-in
+    # log-spaced 1 ms .. 60 s grid (telemetry/metrics.py)
+    latency_buckets_ms: Tuple[float, ...] = ()
+
+    def __post_init__(self):
+        if self.trace_max_events < 1:
+            raise ValueError(
+                "telemetry.trace_max_events must be >= 1, got "
+                f"{self.trace_max_events}"
+            )
+        b = list(self.latency_buckets_ms)
+        if b and (sorted(b) != b or b[0] <= 0):
+            raise ValueError(
+                "telemetry.latency_buckets_ms must be ascending and "
+                f"positive, got {self.latency_buckets_ms}"
+            )
+
+    def buckets_s(self) -> Optional[Tuple[float, ...]]:
+        """The configured grid in seconds, or ``None`` for the default."""
+        if not self.latency_buckets_ms:
+            return None
+        return tuple(ms / 1000.0 for ms in self.latency_buckets_ms)
+
+
+@dataclasses.dataclass(frozen=True)
 class FasterRCNNConfig:
     anchors: AnchorConfig = dataclasses.field(default_factory=AnchorConfig)
     proposals: ProposalConfig = dataclasses.field(default_factory=ProposalConfig)
@@ -988,6 +1055,9 @@ class FasterRCNNConfig:
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     elastic: ElasticConfig = dataclasses.field(default_factory=ElasticConfig)
     ops: OpsConfig = dataclasses.field(default_factory=OpsConfig)
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig
+    )
 
     def feature_size(self, image_size: Optional[Tuple[int, int]] = None) -> Tuple[int, int]:
         """Spatial size of the stride-16 feature map for a given image size.

@@ -78,6 +78,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from replication_faster_rcnn_tpu.telemetry import tracecontext
+
 __all__ = [
     "SITES",
     "KINDS",
@@ -357,15 +359,19 @@ def fire(site: str, **ctx: Any) -> Optional[Fault]:
     sink = _sink
     if sink is not None:
         try:
-            sink(
-                {
-                    "site": fault.site,
-                    "seq": fault.seq,
-                    "kind": fault.kind,
-                    "arg": fault.arg,
-                    **ctx,
-                }
-            )
+            event = {
+                "site": fault.site,
+                "seq": fault.seq,
+                "kind": fault.kind,
+                "arg": fault.arg,
+                **ctx,
+            }
+            # a request-scoped fault carries its trace id, so the
+            # chaos_injected incident joins the merged request timeline
+            trace = tracecontext.current_trace()
+            if trace is not None:
+                event.setdefault("trace_id", trace.trace_id)
+            sink(event)
         except Exception:  # noqa: BLE001 - observer must not alter the fault
             pass
     if fault.kind == "delay":

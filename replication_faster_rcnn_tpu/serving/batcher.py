@@ -30,6 +30,8 @@ from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from replication_faster_rcnn_tpu.faultlib import failpoints
+from replication_faster_rcnn_tpu.telemetry import spans as tspans
+from replication_faster_rcnn_tpu.telemetry import tracecontext
 
 __all__ = ["DeadlineExceeded", "MicroBatcher"]
 
@@ -112,8 +114,9 @@ class MicroBatcher:
         self._key_depth: Dict[Any, int] = {}
         # worker-loop state; touched by the controlling thread only in
         # the threadless (start=False) test mode.
-        # entries: (item, future, submit_time, absolute_deadline|None)
-        self._pending: Dict[Any, List[Tuple[Any, Future, float, Optional[float]]]] = {}
+        # entries: (item, future, submit_time, absolute_deadline|None,
+        #           trace_context|None)
+        self._pending: Dict[Any, List[Tuple[Any, Future, float, Optional[float], Any]]] = {}
         self._thread: Optional[threading.Thread] = None
         if start:
             self._thread = threading.Thread(
@@ -145,7 +148,10 @@ class MicroBatcher:
         fut: Future = Future()
         now = self._clock()
         deadline = None if deadline_s is None else now + deadline_s
-        self._queue.put((key, item, fut, now, deadline), timeout=timeout)
+        # the submitter's trace context rides the entry so the worker can
+        # attribute the queue-wait hop to the request that paid it
+        trace = tracecontext.current_trace()
+        self._queue.put((key, item, fut, now, deadline, trace), timeout=timeout)
         with self._log_lock:
             self._key_depth[key] = self._key_depth.get(key, 0) + 1
         return fut
@@ -268,9 +274,9 @@ class MicroBatcher:
                 self._flush(key, pending)
             return False
         if entry is not None:
-            key, item, fut, t0, deadline = entry
+            key, item, fut, t0, deadline, trace = entry
             group = pending.setdefault(key, [])
-            group.append((item, fut, t0, deadline))
+            group.append((item, fut, t0, deadline, trace))
             if len(group) >= self._max_batch(key):
                 self._flush(key, pending)
         now = self._clock()
@@ -283,7 +289,7 @@ class MicroBatcher:
     def _flush(
         self,
         key: Any,
-        pending: Dict[Any, List[Tuple[Any, Future, float, Optional[float]]]],
+        pending: Dict[Any, List[Tuple[Any, Future, float, Optional[float], Any]]],
     ) -> None:
         group = pending.pop(key)
         with self._log_lock:
@@ -295,7 +301,7 @@ class MicroBatcher:
         now = self._clock()
         live = []
         expired = 0
-        for item, fut, t0, deadline in group:
+        for item, fut, t0, deadline, trace in group:
             if deadline is not None and now > deadline:
                 expired += 1
                 fut.set_exception(
@@ -305,7 +311,7 @@ class MicroBatcher:
                     )
                 )
             else:
-                live.append((item, fut, t0, deadline))
+                live.append((item, fut, t0, deadline, trace))
         if expired:
             with self._log_lock:
                 self._expired_total += expired
@@ -316,22 +322,39 @@ class MicroBatcher:
         with self._log_lock:
             self._flushes.append((key, len(live)))
         if self._on_flush_stats is not None:
-            self._on_flush_stats(key, [now - t0 for _, _, t0, _ in live])
+            self._on_flush_stats(key, [now - t0 for _, _, t0, _, _ in live])
+        # queue-wait hop spans: the wait started on the submitter's
+        # thread and ended here, so the event is emitted retroactively
+        # (ts = flush time - wait) with the request's trace identity
+        tracer = tspans.current_tracer()
+        if tracer.enabled:
+            end_us = tracer.now_us()
+            for _, _, t0, _, trace in live:
+                if trace is not None:
+                    dur_us = max(0.0, (now - t0) * 1e6)
+                    tracer.complete(
+                        "serve/queue_wait",
+                        end_us - dur_us,
+                        dur_us,
+                        cat="serve",
+                        key=str(key),
+                        **trace.span_args(),
+                    )
         try:
             failpoints.fire("batcher.flush", key=str(key), n=len(live))
-            results = self._process(key, [item for item, _, _, _ in live])
+            results = self._process(key, [item for item, _, _, _, _ in live])
             if len(results) != len(live):
                 raise RuntimeError(
                     f"process returned {len(results)} results for "
                     f"{len(live)} items (key={key!r})"
                 )
         except BaseException as e:  # noqa: BLE001 - relayed through futures
-            for _, fut, _, _ in live:
+            for _, fut, _, _, _ in live:
                 fut.set_exception(e)
             if self._on_flush_result is not None:
                 self._on_flush_result(False)
             return
         if self._on_flush_result is not None:
             self._on_flush_result(True)
-        for (_, fut, _, _), res in zip(live, results):
+        for (_, fut, _, _, _), res in zip(live, results):
             fut.set_result(res)

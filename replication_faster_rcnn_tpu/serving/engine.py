@@ -43,10 +43,33 @@ from replication_faster_rcnn_tpu.eval.evaluator import Evaluator
 from replication_faster_rcnn_tpu.serving.batcher import MicroBatcher
 from replication_faster_rcnn_tpu.serving.slo import DeadlineController
 from replication_faster_rcnn_tpu.telemetry import spans as tspans
+from replication_faster_rcnn_tpu.telemetry import tracecontext
+from replication_faster_rcnn_tpu.telemetry.metrics import (
+    DEFAULT_LATENCY_BUCKETS_S,
+    MetricsRegistry,
+)
+from replication_faster_rcnn_tpu.telemetry.slo_burn import BurnRateTracker
 
 # consecutive flush failures before /healthz reports degraded; one
 # successful flush resets the streak (self-healing, not latched)
 DEGRADED_AFTER = 3
+
+# burn-rate alarms need statistics: below this many outcomes in the
+# long window the SLO alarm stays quiet (a 3-sample "100% error rate"
+# is noise, not an incident) and only the flush-streak path can degrade
+SLO_MIN_SAMPLES = 100
+
+# the engine's serving counters, in /stats order; each is a registry
+# counter named serve_<key>_total
+_STAT_KEYS = (
+    "requests",
+    "flushes",
+    "padded_slots",
+    "shed",  # admission-control rejections (queue full)
+    "deadline_expired",  # dropped at flush time, never computed
+    "timeouts",  # handler-side waits that hit 504
+    "flush_errors",  # failed micro-batch dispatches
+)
 
 __all__ = [
     "InferenceEngine",
@@ -152,18 +175,37 @@ class InferenceEngine:
         # Evaluator: when set, every flush dispatch runs under its
         # per-program warmup/recompile check
         self.strict = None
-        # written from handler threads (shed/timeouts), the flush worker
-        # (requests/flushes/...), and read by /stats — one lock covers all
-        self._stats_lock = threading.Lock()
-        self.stats = {
-            "requests": 0,
-            "flushes": 0,
-            "padded_slots": 0,
-            "shed": 0,  # admission-control rejections (queue full)
-            "deadline_expired": 0,  # dropped at flush time, never computed
-            "timeouts": 0,  # handler-side waits that hit 504
-            "flush_errors": 0,  # failed micro-batch dispatches
+        # unified metrics core: every serving counter/gauge/histogram
+        # lives in the registry; /stats and /metrics render the same
+        # instruments so the numbers cannot disagree
+        self.metrics = MetricsRegistry()
+        self._counters = {
+            key: self.metrics.counter(f"serve_{key}_total", help=f"serving {key}")
+            for key in _STAT_KEYS
         }
+        buckets = config.telemetry.buckets_s() or DEFAULT_LATENCY_BUCKETS_S
+        self._queue_wait_hist = self.metrics.histogram(
+            "serve_queue_wait_seconds",
+            help="micro-batch queue wait per request",
+            buckets=buckets,
+        )
+        self._flush_hist = self.metrics.histogram(
+            "serve_flush_seconds",
+            help="micro-batch dispatch latency per flush",
+            buckets=buckets,
+        )
+        self.metrics.register_collector(self._collect_gauges)
+        # SLO burn-rate over request outcomes (telemetry/slo_burn.py):
+        # the alarm is a second path into `degraded`, statistically gated
+        self.slo = BurnRateTracker(
+            availability_target=config.fleet.slo_availability_target,
+            latency_target_s=config.fleet.slo_latency_target_ms / 1000.0,
+            short_window_s=config.fleet.slo_short_window_s,
+            long_window_s=config.fleet.slo_long_window_s,
+        )
+        # degraded-streak state, written by the flush worker and handler
+        # threads, read by /healthz — one lock covers it
+        self._stats_lock = threading.Lock()
         self._consecutive_flush_errors = 0
         self._last_flush_error: Optional[str] = None
         self._start_time = time.monotonic()
@@ -191,32 +233,74 @@ class InferenceEngine:
             name="serving-micro-batcher",
             on_expired=self._note_expired,
             on_flush_result=self._note_flush,
-            on_flush_stats=(
-                self.deadline_controller.on_flush
-                if self.deadline_controller is not None
-                else None
-            ),
+            on_flush_stats=self._note_flush_stats,
         )
 
     # ---------------------------------------------------- overload accounting
 
     def _note_expired(self, n: int) -> None:
-        with self._stats_lock:
-            self.stats["deadline_expired"] += n
+        self._counters["deadline_expired"].inc(n)
+        for _ in range(n):
+            self.slo.record(False)
 
     def _note_flush(self, ok: bool) -> None:
+        if not ok:
+            self._counters["flush_errors"].inc()
         with self._stats_lock:
             if ok:
                 self._consecutive_flush_errors = 0
             else:
-                self.stats["flush_errors"] += 1
                 self._consecutive_flush_errors += 1
+
+    def _note_flush_stats(self, key, waits_s) -> None:
+        for w in waits_s:
+            self._queue_wait_hist.observe(w)
+        if self.deadline_controller is not None:
+            self.deadline_controller.on_flush(key, waits_s)
+
+    def _collect_gauges(self) -> None:
+        self.metrics.gauge(
+            "serve_queue_depth", help="requests waiting in the batch queue"
+        ).set(self.queue_depth())
+        self.metrics.gauge(
+            "serve_uptime_seconds", help="seconds since engine construction"
+        ).set(self.uptime_s())
+        for bucket, n in self.bucket_queue_depths().items():
+            self.metrics.gauge(
+                "serve_bucket_queue_depth",
+                help="submitted-but-unflushed requests per bucket",
+                bucket=bucket,
+            ).set(n)
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        """The serving counters as a plain dict (the historical ``/stats``
+        ``stats`` block) — a registry snapshot, not mutable state."""
+        out = {k: 0 for k in _STAT_KEYS}
+        for name, v in self.metrics.counters_flat().items():
+            if (
+                name.startswith("serve_")
+                and name.endswith("_total")
+                and "{" not in name
+            ):
+                out[name[len("serve_"): -len("_total")]] = int(v)
+        return out
 
     def incr_stat(self, key: str, n: int = 1) -> None:
         """Bump a serving counter (handler threads record their
-        504/shed outcomes here; all writes share the stats lock)."""
-        with self._stats_lock:
-            self.stats[key] = self.stats.get(key, 0) + n
+        504/shed outcomes here; writes land in the metrics registry).
+        A handler timeout is an SLO miss, so it burns error budget."""
+        counter = self._counters.get(key)
+        if counter is None:
+            # get-or-create is the registry's (locked) concern; unknown
+            # keys become serve_<key>_total like the built-ins
+            counter = self.metrics.counter(
+                f"serve_{key}_total", help=f"serving {key}"
+            )
+        counter.inc(n)
+        if key == "timeouts":
+            for _ in range(n):
+                self.slo.record(False)
 
     def queue_depth(self) -> int:
         """Requests waiting in the micro-batch queue (public accessor —
@@ -234,13 +318,24 @@ class InferenceEngine:
         """Seconds since engine construction (surfaced in /healthz)."""
         return time.monotonic() - self._start_time
 
+    def _slo_alarm(self) -> bool:
+        """The burn-rate alarm, statistically gated: below
+        :data:`SLO_MIN_SAMPLES` outcomes in the long window the alarm
+        stays quiet regardless of rate."""
+        snap = self.slo.snapshot()
+        return bool(snap["alarm"]) and snap["samples"]["long"] >= SLO_MIN_SAMPLES
+
     @property
     def degraded(self) -> bool:
-        """True after :data:`DEGRADED_AFTER` consecutive flush failures;
-        one successful flush resets it. Surfaced in ``/healthz`` so load
-        balancers can route around a sick replica without killing it."""
+        """True after :data:`DEGRADED_AFTER` consecutive flush failures
+        (one successful flush resets it) OR while the SLO burn-rate
+        alarm fires on a statistically meaningful window. Surfaced in
+        ``/healthz`` so load balancers can route around a sick replica
+        without killing it."""
         with self._stats_lock:
-            return self._consecutive_flush_errors >= DEGRADED_AFTER
+            if self._consecutive_flush_errors >= DEGRADED_AFTER:
+                return True
+        return self._slo_alarm()
 
     @property
     def degraded_reason(self) -> Optional[str]:
@@ -248,12 +343,19 @@ class InferenceEngine:
         what an operator paging on /healthz sees first."""
         with self._stats_lock:
             n = self._consecutive_flush_errors
-            if n < DEGRADED_AFTER:
-                return None
+            last = self._last_flush_error
+        if n >= DEGRADED_AFTER:
             reason = f"{n} consecutive micro-batch flush failures"
-            if self._last_flush_error:
-                reason += f" (last: {self._last_flush_error})"
+            if last:
+                reason += f" (last: {last})"
             return reason
+        if self._slo_alarm():
+            rates = self.slo.burn_rates()
+            return (
+                "SLO burn-rate alarm: burning error budget at "
+                f"{rates['short']:.1f}x (5m) / {rates['long']:.1f}x (1h)"
+            )
+        return None
 
     # ------------------------------------------------------------ programs
 
@@ -339,7 +441,12 @@ class InferenceEngine:
             orig_h, orig_w = orig_size if orig_size else bucket
         return self._submit(
             bucket,
-            (np.asarray(image, np.float32), int(orig_h), int(orig_w)),
+            (
+                np.asarray(image, np.float32),
+                int(orig_h),
+                int(orig_w),
+                tracecontext.current_trace(),
+            ),
             timeout,
         )
 
@@ -359,7 +466,11 @@ class InferenceEngine:
         image, orig_h, orig_w = _load_image(
             path, bucket, self.config.data.pixel_mean, self.config.data.pixel_std
         )
-        return self._submit(bucket, (image, int(orig_h), int(orig_w)), timeout)
+        return self._submit(
+            bucket,
+            (image, int(orig_h), int(orig_w), tracecontext.current_trace()),
+            timeout,
+        )
 
     def _submit(self, bucket, entry, timeout: Optional[float]) -> Future:
         """Queue one request: ``serving.request_timeout_s`` becomes the
@@ -376,8 +487,8 @@ class InferenceEngine:
                 deadline_s=ttl if ttl > 0 else None,
             )
         except queue_mod.Full:
-            with self._stats_lock:
-                self.stats["shed"] += 1
+            self._counters["shed"].inc()
+            self.slo.record(False)
             raise
 
     def predict_paths(self, paths: Sequence[str]) -> List[Dict[str, np.ndarray]]:
@@ -391,36 +502,61 @@ class InferenceEngine:
         """One micro-batch: pad to the smallest compiled batch size,
         dispatch the bucket's AOT program, un-pad, de-normalize boxes."""
         try:
-            return self._process_bucket_inner(bucket, items)
+            out = self._process_bucket_inner(bucket, items)
+            for _ in items:
+                self.slo.record(True)
+            return out
         except BaseException as e:  # noqa: BLE001 - recorded, then relayed
             # capture the cause for degraded_reason before the batcher
             # relays the exception through the flush's futures
             with self._stats_lock:
                 self._last_flush_error = f"{type(e).__name__}: {e}"
+            for _ in items:
+                self.slo.record(False)
             raise
 
     def _process_bucket_inner(self, bucket, items):
+        # entries are (image, orig_h, orig_w[, trace]); the trace slot is
+        # optional so callers that build items by hand keep working
         h, w = bucket
         n = len(items)
         bn = next((b for b in self.batch_sizes if b >= n), self.batch_sizes[-1])
         batch = np.zeros((bn, h, w, 3), np.float32)
-        for i, (image, _, _) in enumerate(items):
-            batch[i] = image
+        for i, entry in enumerate(items):
+            batch[i] = entry[0]
         name = self._serve_name(h, w, bn)
         program = self._program(name)
         tracer = tspans.current_tracer()
+        t_dispatch = tracer.now_us()
+        t_wall = time.perf_counter()
         with tracer.span(
             "serve/flush", cat="serve", program=name, n=n, padded=bn - n
         ):
             with self._strict_dispatch(name):
                 out = program(self._variables, jax.device_put(batch))
             out = jax.device_get(out)
-        with self._stats_lock:
-            self.stats["requests"] += n
-            self.stats["flushes"] += 1
-            self.stats["padded_slots"] += bn - n
+        flush_s = time.perf_counter() - t_wall
+        dur_dispatch = flush_s * 1e6
+        self._flush_hist.observe(flush_s)
+        self._counters["requests"].inc(n)
+        self._counters["flushes"].inc()
+        self._counters["padded_slots"].inc(bn - n)
         results = []
-        for i, (_, orig_h, orig_w) in enumerate(items):
+        for i, entry in enumerate(items):
+            orig_h, orig_w = entry[1], entry[2]
+            trace = entry[3] if len(entry) > 3 else None
+            if trace is not None and tracer.enabled:
+                # the per-request view of this flush: same wall interval,
+                # tagged with the request's trace identity so the merged
+                # timeline shows WHICH requests shared the dispatch
+                tracer.complete(
+                    "serve/dispatch",
+                    t_dispatch,
+                    dur_dispatch,
+                    cat="serve",
+                    program=name,
+                    **trace.span_args(),
+                )
             back = np.asarray(
                 [orig_h / h, orig_w / w, orig_h / h, orig_w / w], np.float32
             )

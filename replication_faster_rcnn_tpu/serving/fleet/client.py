@@ -29,6 +29,8 @@ import urllib.error
 import urllib.request
 from typing import Any, Callable, Dict, Optional
 
+from replication_faster_rcnn_tpu.telemetry import tracecontext
+
 __all__ = [
     "HTTPReplicaClient",
     "LocalReplicaClient",
@@ -125,10 +127,17 @@ class HTTPReplicaClient:
 
     def predict(self, payload: Any, timeout_s: float) -> Any:
         body = json.dumps({"paths": [str(payload)]}).encode()
+        headers = {"Content-Type": "application/json"}
+        # the router binds the attempt's trace context on this thread
+        # before calling predict; inject it as the W3C traceparent header
+        # so the replica's hop spans join the same trace
+        trace = tracecontext.current_trace()
+        if trace is not None:
+            headers[tracecontext.TRACEPARENT_HEADER] = trace.to_traceparent()
         req = urllib.request.Request(
             f"{self.base_url}/predict",
             data=body,
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=timeout_s) as resp:

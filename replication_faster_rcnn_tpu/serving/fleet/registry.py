@@ -236,6 +236,33 @@ class ReplicaRegistry:
         with self._lock:
             return self._replicas[replica_id].state
 
+    def role_of(self, replica_id: str) -> str:
+        with self._lock:
+            return self._replicas[replica_id].role
+
+    def set_role(
+        self, replica_id: str, role: str, reason: Optional[str] = None
+    ) -> None:
+        """Re-role a replica in place (the canary auto-demote hook:
+        an alarming canary drops back to plain serving traffic without
+        leaving rotation). Records a ``replica_role_changed`` event."""
+        if role not in (SERVING, CANARY, SHADOW):
+            raise ValueError(f"unknown replica role {role!r}")
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None or rep.role == role:
+                return
+            self._events.append(
+                {
+                    "event": "replica_role_changed",
+                    "replica": replica_id,
+                    "from": rep.role,
+                    "to": role,
+                    "reason": reason,
+                }
+            )
+            rep.role = role
+
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:
             return list(self._events)

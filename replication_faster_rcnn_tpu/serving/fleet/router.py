@@ -41,7 +41,7 @@ import json
 import threading
 import time
 from bisect import bisect_right
-from collections import OrderedDict, deque
+from collections import OrderedDict
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
 from concurrent.futures import wait as futures_wait
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
@@ -52,15 +52,48 @@ from replication_faster_rcnn_tpu.serving.fleet.breaker import CircuitBreaker
 from replication_faster_rcnn_tpu.serving.fleet.client import ReplicaDown
 from replication_faster_rcnn_tpu.serving.fleet.registry import (
     CANARY,
+    SERVING,
     SHADOW,
     ReplicaRegistry,
 )
+from replication_faster_rcnn_tpu.telemetry import spans as tspans
+from replication_faster_rcnn_tpu.telemetry import tracecontext
+from replication_faster_rcnn_tpu.telemetry.metrics import (
+    DEFAULT_LATENCY_BUCKETS_S,
+    MetricsRegistry,
+)
+from replication_faster_rcnn_tpu.telemetry.slo_burn import BurnRateTracker
 
 __all__ = ["FleetRouter", "FleetUnavailable", "HashRing", "content_key"]
+
+# a canary's own burn-rate tracker must see at least this many attempt
+# outcomes in the long window before its alarm can demote it — a canary
+# judged on three requests is an unfair trial
+CANARY_SLO_MIN_SAMPLES = 20
+
+# the router's request/attempt counters, in /stats order; each is a
+# registry counter named fleet_<key>_total
+_STAT_KEYS = (
+    "requests",
+    "cache_hits",
+    "attempts",
+    "failed_attempts",
+    "failovers",
+    "hedges",
+    "hedge_wins",
+    "canary_requests",
+    "canary_demotions",
+    "shadow_requests",
+    "shadow_diffs",
+    "unavailable",
+)
 
 
 class FleetUnavailable(ConnectionError):
     """Every eligible replica refused or failed the request."""
+
+
+_CACHE_MISS = object()
 
 
 def _hash64(s: str) -> int:
@@ -129,28 +162,42 @@ class FleetRouter:
         self._config = config
         self._clock = clock
         self._kill_hook = kill_hook
-        # guards stats, cache, latency window, breakers table, ring cache
+        # guards cache, breakers table, ring cache, canary-tracker table
         # — written from dispatch callers (HTTP handler threads) AND the
-        # hedge pool's attempt/shadow tasks
+        # hedge pool's attempt/shadow tasks; counters/histograms carry
+        # their own registry-internal locks
         self._lock = threading.Lock()
         self._breakers: Dict[str, CircuitBreaker] = {}
         self._cache: "OrderedDict[str, Any]" = OrderedDict()
-        self._latency_s: deque = deque(maxlen=config.latency_window)
         self._ring_cache: Tuple[Tuple[str, ...], Optional[HashRing]] = ((), None)
-        self._replica_stats: Dict[str, Dict[str, int]] = {}
-        self.stats: Dict[str, int] = {
-            "requests": 0,
-            "cache_hits": 0,
-            "attempts": 0,
-            "failed_attempts": 0,
-            "failovers": 0,
-            "hedges": 0,
-            "hedge_wins": 0,
-            "canary_requests": 0,
-            "shadow_requests": 0,
-            "shadow_diffs": 0,
-            "unavailable": 0,
+        # unified metrics core: one registry renders /stats JSON,
+        # /metrics Prometheus text, and fleet.jsonl snapshots
+        self.metrics = MetricsRegistry()
+        self._counters = {
+            key: self.metrics.counter(f"fleet_{key}_total", help=f"fleet {key}")
+            for key in _STAT_KEYS
         }
+        # attempt latency histogram: bounded memory under sustained load
+        # (the raw-latency deque it replaces kept every sample) AND the
+        # p99 source for the hedge delay
+        self._attempt_hist = self.metrics.histogram(
+            "fleet_attempt_seconds",
+            help="replica attempt latency (successful attempts)",
+            buckets=DEFAULT_LATENCY_BUCKETS_S,
+        )
+        self.metrics.register_collector(self._collect_gauges)
+        # SLO burn-rate over ATTEMPT outcomes: with failover absorbing
+        # most failures before clients see them, attempts — not final
+        # request results — are where a dying replica shows up first
+        self.slo = BurnRateTracker(
+            availability_target=config.slo_availability_target,
+            latency_target_s=config.slo_latency_target_ms / 1000.0,
+            short_window_s=config.slo_short_window_s,
+            long_window_s=config.slo_long_window_s,
+            clock=clock,
+        )
+        # per-canary trackers driving the auto-demote hook
+        self._canary_slo: Dict[str, BurnRateTracker] = {}
         # hedging needs attempts in flight concurrently; sequential mode
         # (hedge=False) never touches the pool
         self._pool: Optional[ThreadPoolExecutor] = None
@@ -159,6 +206,12 @@ class FleetRouter:
                 max_workers=max(4, 2 * config.max_attempts),
                 thread_name_prefix="fleet-hedge",
             )
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        """The router counters as a plain dict (the historical shape) —
+        a registry snapshot, not mutable state."""
+        return {k: int(c.value) for k, c in self._counters.items()}
 
     # ---------------------------------------------------------------- reads
 
@@ -177,41 +230,81 @@ class FleetRouter:
     def hedge_delay_s(self) -> float:
         """``hedge_multiplier x observed p99`` clamped to the configured
         floor/ceiling; before any samples exist, the ceiling (hedge
-        conservatively until there is evidence of the tail)."""
+        conservatively until there is evidence of the tail).  The p99
+        comes from the attempt histogram — O(buckets) memory however
+        long the router runs, unlike the raw-sample list it replaced."""
         cfg = self._config
-        with self._lock:
-            samples = sorted(self._latency_s)
-        if not samples:
+        if self._attempt_hist.count == 0:
             return cfg.hedge_ceiling_ms / 1000.0
-        idx = min(len(samples) - 1, int(0.99 * (len(samples) - 1) + 0.5))
-        raw = samples[idx] * cfg.hedge_multiplier
+        raw = self._attempt_hist.percentile(99) * cfg.hedge_multiplier
         return min(
             max(raw, cfg.hedge_floor_ms / 1000.0),
             cfg.hedge_ceiling_ms / 1000.0,
         )
 
-    def snapshot(self) -> Dict[str, Any]:
-        """Router + per-replica gauges for /stats and telemetry."""
+    def _collect_gauges(self) -> None:
         with self._lock:
-            stats = dict(self.stats)
-            per_replica = {
-                rid: dict(c) for rid, c in self._replica_stats.items()
-            }
             breakers = list(self._breakers.items())
             cache_size = len(self._cache)
+        self.metrics.gauge(
+            "fleet_cache_size", help="content-hash result cache entries"
+        ).set(cache_size)
+        self.metrics.gauge(
+            "fleet_hedge_delay_seconds", help="current hedge trigger delay"
+        ).set(self.hedge_delay_s())
+        state_code = {"closed": 0, "half_open": 1, "open": 2}
+        for rid, b in breakers:
+            snap = b.snapshot()
+            self.metrics.gauge(
+                "fleet_breaker_state",
+                help="circuit breaker state (0 closed, 1 half-open, 2 open)",
+                replica=rid,
+            ).set(state_code.get(snap["state"], -1))
+        for rates_name, burn in self.slo.burn_rates().items():
+            self.metrics.gauge(
+                "fleet_slo_burn_rate",
+                help="error-budget burn rate per window",
+                window=rates_name,
+            ).set(burn)
+
+    def _replica_counter(self, replica_id: str, outcome: str):
+        return self.metrics.counter(
+            "fleet_replica_attempts_total",
+            help="per-replica attempt outcomes",
+            replica=replica_id,
+            outcome=outcome,
+        )
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Router + per-replica gauges for /stats and telemetry — every
+        number is read back out of the metrics registry, so this JSON
+        view and the Prometheus /metrics text cannot disagree."""
+        per_replica: Dict[str, Dict[str, Any]] = {}
+        for c in self.metrics.find("fleet_replica_attempts_total"):
+            entry = per_replica.setdefault(
+                c.labels["replica"], {"ok": 0, "fail": 0}
+            )
+            entry[c.labels["outcome"]] = int(c.value)
+        with self._lock:
+            breakers = list(self._breakers.items())
         for rid, b in breakers:
             per_replica.setdefault(rid, {"ok": 0, "fail": 0})["breaker"] = (
                 b.snapshot()
             )
         return {
             "router": {
-                **stats,
-                "cache_size": cache_size,
+                **self.stats,
+                "cache_size": self._cache_size(),
                 "hedge_delay_ms": round(self.hedge_delay_s() * 1e3, 3),
             },
             "replicas": per_replica,
             "registry": self._registry.snapshot(),
+            "slo": self.slo.snapshot(),
         }
+
+    def _cache_size(self) -> int:
+        with self._lock:
+            return len(self._cache)
 
     # ------------------------------------------------------------ placement
 
@@ -257,26 +350,50 @@ class FleetRouter:
     ) -> Any:
         """Route one request through cache -> canary/ring -> breakers ->
         failover/hedging.  Raises :class:`FleetUnavailable` when no
-        replica could serve it."""
+        replica could serve it.
+
+        The request's trace context is the one already bound on this
+        thread (the fleet HTTP front door extracts the caller's
+        ``traceparent``) or a fresh root; every attempt below runs as a
+        child span of it, so the whole failover/hedge fan-out shares one
+        trace id in the merged timeline."""
         cfg = self._config
+        trace = tracecontext.current_trace() or tracecontext.new_trace_context()
+        tracer = tspans.current_tracer()
+        self._counters["requests"].inc()
+        hit = _CACHE_MISS
         with self._lock:
-            self.stats["requests"] += 1
             if cfg.cache_entries > 0 and content_hash in self._cache:
                 self._cache.move_to_end(content_hash)
-                self.stats["cache_hits"] += 1
-                return self._cache[content_hash]
+                hit = self._cache[content_hash]
+        if hit is not _CACHE_MISS:
+            self._counters["cache_hits"].inc()
+            return hit
         order = self.candidates(content_hash, bucket)
         if not order:
-            with self._lock:
-                self.stats["unavailable"] += 1
-            raise FleetUnavailable("no replicas in rotation")
+            self._counters["unavailable"].inc()
+            raise FleetUnavailable(
+                f"no replicas in rotation (trace {trace.trace_id})"
+            )
         if order[0] in self._registry.in_rotation(role=CANARY):
-            with self._lock:
-                self.stats["canary_requests"] += 1
-        if self._pool is not None and cfg.hedge:
-            result = self._dispatch_hedged(payload, order)
-        else:
-            result = self._dispatch_sequential(payload, order)
+            self._counters["canary_requests"].inc()
+        t_req = tracer.now_us()
+        try:
+            with tracecontext.bind(trace):
+                if self._pool is not None and cfg.hedge:
+                    result = self._dispatch_hedged(payload, order, trace)
+                else:
+                    result = self._dispatch_sequential(payload, order, trace)
+        finally:
+            if tracer.enabled:
+                tracer.complete(
+                    "fleet/request",
+                    t_req,
+                    tracer.now_us() - t_req,
+                    cat="fleet",
+                    content_hash=content_hash[:16],
+                    **trace.span_args(),
+                )
         with self._lock:
             if cfg.cache_entries > 0:
                 self._cache[content_hash] = result
@@ -294,47 +411,121 @@ class FleetRouter:
                 return rid
         return None
 
-    def _attempt(self, replica_id: str, payload: Any) -> Any:
+    def _attempt(
+        self,
+        replica_id: str,
+        payload: Any,
+        ctx: Optional[tracecontext.TraceContext] = None,
+        hedge: bool = False,
+    ) -> Any:
         """One replica call: failpoint consult, predict, accounting.
         Runs on the caller thread (sequential mode) or a hedge-pool
-        thread — every shared write below is lock-guarded."""
-        with self._lock:
-            self.stats["attempts"] += 1
+        thread — every shared write below is lock-guarded.
+
+        ``ctx`` is this attempt's span: bound to the executing thread so
+        the transport (HTTP traceparent header / in-process thread-local)
+        carries it into the replica, and stamped on the attempt's span
+        event.  Hedged/failover attempts arrive as siblings — same trace
+        id and parent, distinct span ids."""
+        self._counters["attempts"].inc()
+        tracer = tspans.current_tracer()
+        t_us = tracer.now_us()
         t0 = self._clock()
+        ok = False
         try:
-            inj = failpoints.fire("router.dispatch", replica=replica_id)
-            if inj is not None and inj.kind == "drop":
-                # the selected replica dies mid-request: make it real
-                # through the kill hook, then fail this attempt the way
-                # a dropped TCP connection would
-                if self._kill_hook is not None:
-                    self._kill_hook(replica_id)
-                raise ReplicaDown(
-                    f"injected replica kill mid-request on {replica_id!r}"
+            with tracecontext.bind(ctx):
+                inj = failpoints.fire("router.dispatch", replica=replica_id)
+                if inj is not None and inj.kind == "drop":
+                    # the selected replica dies mid-request: make it real
+                    # through the kill hook, then fail this attempt the way
+                    # a dropped TCP connection would
+                    if self._kill_hook is not None:
+                        self._kill_hook(replica_id)
+                    raise ReplicaDown(
+                        f"injected replica kill mid-request on {replica_id!r}"
+                    )
+                client = self._registry.client_of(replica_id)
+                result = client.predict(
+                    payload, timeout_s=self._config.request_timeout_s
                 )
-            client = self._registry.client_of(replica_id)
-            result = client.predict(
-                payload, timeout_s=self._config.request_timeout_s
-            )
+            ok = True
         except BaseException:
             self.breaker(replica_id).record_failure()
-            with self._lock:
-                self.stats["failed_attempts"] += 1
-                self._replica_stats.setdefault(
-                    replica_id, {"ok": 0, "fail": 0}
-                )["fail"] += 1
+            self._counters["failed_attempts"].inc()
+            self._replica_counter(replica_id, "fail").inc()
             raise
+        finally:
+            dt = self._clock() - t0
+            self.slo.record(ok, dt)
+            self._note_canary_outcome(replica_id, ok, dt)
+            if tracer.enabled and ctx is not None:
+                tracer.complete(
+                    "fleet/attempt",
+                    t_us,
+                    tracer.now_us() - t_us,
+                    cat="fleet",
+                    replica=replica_id,
+                    hedge=hedge,
+                    ok=ok,
+                    **ctx.span_args(),
+                )
         self.breaker(replica_id).record_success()
-        dt = self._clock() - t0
-        with self._lock:
-            self._latency_s.append(dt)
-            self._replica_stats.setdefault(
-                replica_id, {"ok": 0, "fail": 0}
-            )["ok"] += 1
+        self._attempt_hist.observe(dt)
+        self._replica_counter(replica_id, "ok").inc()
         return result
 
-    def _dispatch_sequential(self, payload: Any, order: List[str]) -> Any:
-        """Deterministic failover walk — the chaos-replayable mode."""
+    def _note_canary_outcome(
+        self, replica_id: str, ok: bool, latency_s: float
+    ) -> None:
+        """Feed a canary's private burn tracker; an alarming canary is
+        demoted back to plain serving traffic (the auto-demote hook —
+        a bad rollout stops taking its deterministic slice without an
+        operator in the loop)."""
+        try:
+            if self._registry.role_of(replica_id) != CANARY:
+                return
+        except KeyError:
+            return
+        cfg = self._config
+        with self._lock:
+            tracker = self._canary_slo.get(replica_id)
+            if tracker is None:
+                tracker = BurnRateTracker(
+                    availability_target=cfg.slo_availability_target,
+                    latency_target_s=cfg.slo_latency_target_ms / 1000.0,
+                    short_window_s=cfg.slo_short_window_s,
+                    long_window_s=cfg.slo_long_window_s,
+                    clock=self._clock,
+                )
+                self._canary_slo[replica_id] = tracker
+        tracker.record(ok, latency_s)
+        snap = tracker.snapshot()
+        if (
+            snap["alarm"]
+            and snap["samples"]["long"] >= CANARY_SLO_MIN_SAMPLES
+        ):
+            self._registry.set_role(
+                replica_id,
+                SERVING,
+                reason=(
+                    "slo burn-rate alarm: short="
+                    f"{snap['burn_rates']['short']:.1f}x long="
+                    f"{snap['burn_rates']['long']:.1f}x"
+                ),
+            )
+            self._counters["canary_demotions"].inc()
+            tspans.current_tracer().instant(
+                "fleet/canary_demoted", cat="fleet", replica=replica_id
+            )
+
+    def _dispatch_sequential(
+        self,
+        payload: Any,
+        order: List[str],
+        trace: tracecontext.TraceContext,
+    ) -> Any:
+        """Deterministic failover walk — the chaos-replayable mode.
+        Every attempt is a sibling child span of the request."""
         errors: List[str] = []
         tried: Set[str] = set()
         for _ in range(self._config.max_attempts):
@@ -343,24 +534,31 @@ class FleetRouter:
                 break
             tried.add(rid)
             try:
-                result = self._attempt(rid, payload)
+                result = self._attempt(rid, payload, ctx=trace.child())
             except Exception as e:  # noqa: BLE001 - absorbed by failover
                 errors.append(f"{rid}: {type(e).__name__}: {e}")
-                with self._lock:
-                    self.stats["failovers"] += 1
+                self._counters["failovers"].inc()
                 continue
             return result
-        with self._lock:
-            self.stats["unavailable"] += 1
+        self._counters["unavailable"].inc()
         raise FleetUnavailable(
-            f"all attempts failed ({len(errors)}): {'; '.join(errors) or 'no eligible replica'}"
+            f"all attempts failed ({len(errors)}): "
+            f"{'; '.join(errors) or 'no eligible replica'} "
+            f"(trace {trace.trace_id})"
         )
 
-    def _dispatch_hedged(self, payload: Any, order: List[str]) -> Any:
+    def _dispatch_hedged(
+        self,
+        payload: Any,
+        order: List[str],
+        trace: tracecontext.TraceContext,
+    ) -> Any:
         """Concurrent mode: primary attempt, a hedge copy after the
         p99-derived delay, failover relaunch on failures; first success
         wins.  Late losers still resolve on the pool and record into
-        their own breakers/stats (all lock-guarded)."""
+        their own breakers/stats (all lock-guarded).  All attempts —
+        winner, loser, abandoned — are sibling spans under one trace
+        id, which is what makes a hedge race legible afterwards."""
         cfg = self._config
         errors: List[str] = []
         tried: Set[str] = set()
@@ -372,16 +570,20 @@ class FleetRouter:
             if rid is None or len(tried) >= cfg.max_attempts:
                 return False
             tried.add(rid)
-            fut = self._pool.submit(self._attempt, rid, payload)
+            fut = self._pool.submit(
+                self._attempt, rid, payload,
+                ctx=trace.child(), hedge=is_hedge,
+            )
             inflight[fut] = rid
             if is_hedge:
                 hedge_futs.add(fut)
             return True
 
         if not _launch(is_hedge=False):
-            with self._lock:
-                self.stats["unavailable"] += 1
-            raise FleetUnavailable("no eligible replica (breakers open)")
+            self._counters["unavailable"].inc()
+            raise FleetUnavailable(
+                f"no eligible replica (breakers open) (trace {trace.trace_id})"
+            )
         deadline = self._clock() + cfg.request_timeout_s
         hedge_at = self._clock() + self.hedge_delay_s()
         hedged = False
@@ -398,25 +600,23 @@ class FleetRouter:
                 if not hedged and self._clock() >= hedge_at:
                     hedged = True
                     if _launch(is_hedge=True):
-                        with self._lock:
-                            self.stats["hedges"] += 1
+                        self._counters["hedges"].inc()
                 continue
             for fut in done:
                 rid = inflight.pop(fut)
                 exc = fut.exception()
                 if exc is None:
                     if fut in hedge_futs:
-                        with self._lock:
-                            self.stats["hedge_wins"] += 1
+                        self._counters["hedge_wins"].inc()
                     return fut.result()
                 errors.append(f"{rid}: {type(exc).__name__}: {exc}")
-                with self._lock:
-                    self.stats["failovers"] += 1
+                self._counters["failovers"].inc()
                 _launch(is_hedge=False)
-        with self._lock:
-            self.stats["unavailable"] += 1
+        self._counters["unavailable"].inc()
         raise FleetUnavailable(
-            f"all attempts failed ({len(errors)}): {'; '.join(errors) or 'request deadline exceeded'}"
+            f"all attempts failed ({len(errors)}): "
+            f"{'; '.join(errors) or 'request deadline exceeded'} "
+            f"(trace {trace.trace_id})"
         )
 
     # --------------------------------------------------------------- shadow
@@ -435,8 +635,7 @@ class FleetRouter:
     def _shadow_probe(
         self, replica_id: str, payload: Any, primary_result: Any
     ) -> None:
-        with self._lock:
-            self.stats["shadow_requests"] += 1
+        self._counters["shadow_requests"].inc()
         try:
             client = self._registry.client_of(replica_id)
             shadow_result = client.predict(
@@ -448,8 +647,7 @@ class FleetRouter:
         except Exception:  # noqa: BLE001 - a failing shadow is a diff
             same = False
         if not same:
-            with self._lock:
-                self.stats["shadow_diffs"] += 1
+            self._counters["shadow_diffs"].inc()
 
     # ------------------------------------------------------------ lifecycle
 

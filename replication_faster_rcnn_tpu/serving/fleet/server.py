@@ -7,14 +7,24 @@ which owns placement, failover, hedging and caching.  Per-path
 isolation matches the replica server: one failing path costs that one
 entry, the rest of the request still returns detections.
 
+Tracing: an incoming ``traceparent`` header is adopted as the request's
+trace (a fresh root otherwise) and bound for the whole handler, so the
+router's request/attempt spans — and, through the per-attempt
+traceparent the HTTP replica client injects, the replica tier's hop
+spans — all share one trace id; error responses carry it too.
+
 Endpoints:
   POST /predict  {"paths": ["a.jpg", ...]} or {"path": "a.jpg"} —
                  per-path detections routed across the fleet; a fleet-
                  wide inability to serve a path returns 503 with a
-                 Retry-After derived from the breaker cooldown
+                 Retry-After derived from the breaker cooldown; error
+                 responses carry the request's "trace_id"
   GET  /healthz  fleet liveness: ok while any replica is in rotation,
                  plus the per-replica registry snapshot
-  GET  /stats    router + per-replica + registry gauges
+  GET  /stats    unified frcnn-stats/v1 envelope: schema/tier/metrics +
+                 the fleet's structured sections (router, replicas,
+                 registry, slo)
+  GET  /metrics  the router's registry in Prometheus text exposition
 """
 
 from __future__ import annotations
@@ -31,6 +41,11 @@ from replication_faster_rcnn_tpu.serving.fleet.router import (
     FleetRouter,
     FleetUnavailable,
     content_key,
+)
+from replication_faster_rcnn_tpu.telemetry import tracecontext
+from replication_faster_rcnn_tpu.telemetry.metrics import (
+    PROMETHEUS_CONTENT_TYPE,
+    stats_payload,
 )
 
 __all__ = ["make_fleet_server"]
@@ -71,7 +86,16 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 },
             )
         elif self.path == "/stats":
-            self._reply(200, router.snapshot())
+            self._reply(
+                200, stats_payload("fleet", router.metrics, **router.snapshot())
+            )
+        elif self.path == "/metrics":
+            body = router.metrics.render_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -79,12 +103,27 @@ class _FleetHandler(BaseHTTPRequestHandler):
         if self.path != "/predict":
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
+        # adopt the caller's trace or start a root, bound for the whole
+        # handler so router.dispatch (and everything under it) joins it
+        parent = tracecontext.parse_traceparent(
+            self.headers.get(tracecontext.TRACEPARENT_HEADER)
+        )
+        trace = (
+            parent.child()
+            if parent is not None
+            else tracecontext.new_trace_context()
+        )
+        with tracecontext.bind(trace):
+            self._handle_predict(trace)
+
+    def _handle_predict(self, trace) -> None:
+        trace_id = trace.trace_id
         # the front shares the replica tier's handler failpoint site, so
         # one chaos spec can fault either layer of the serving stack
         try:
             inj = failpoints.fire("http.handler", path=self.path, tier="fleet")
         except failpoints.ChaosError as e:
-            self._reply(500, {"error": str(e)})
+            self._reply(500, {"error": str(e), "trace_id": trace_id})
             return
         if inj is not None and inj.kind == "drop":
             with contextlib.suppress(OSError):
@@ -98,7 +137,7 @@ class _FleetHandler(BaseHTTPRequestHandler):
             if not paths:
                 raise ValueError('need "path" or non-empty "paths"')
         except (ValueError, KeyError, json.JSONDecodeError) as e:
-            self._reply(400, {"error": str(e)})
+            self._reply(400, {"error": str(e), "trace_id": trace_id})
             return
         results, errors = {}, {}
         unavailable = bad_input = 0
@@ -127,13 +166,26 @@ class _FleetHandler(BaseHTTPRequestHandler):
             cooldown = self.server.router._config.breaker_cooldown_s
             self._reply(
                 503,
-                {"error": "fleet unavailable", "errors": errors},
+                {
+                    "error": "fleet unavailable",
+                    "errors": errors,
+                    "trace_id": trace_id,
+                },
                 headers={"Retry-After": max(1, math.ceil(cooldown))},
             )
         elif bad_input == len(paths):
-            self._reply(400, {"error": "; ".join(errors.values())})
+            self._reply(
+                400, {"error": "; ".join(errors.values()), "trace_id": trace_id}
+            )
         else:
-            self._reply(500, {"error": "all paths failed", "errors": errors})
+            self._reply(
+                500,
+                {
+                    "error": "all paths failed",
+                    "errors": errors,
+                    "trace_id": trace_id,
+                },
+            )
 
 
 def make_fleet_server(

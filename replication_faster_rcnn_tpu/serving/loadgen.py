@@ -40,6 +40,7 @@ from replication_faster_rcnn_tpu.serving.overload import (
     DeadlineExceeded,
     backoff_delays,
 )
+from replication_faster_rcnn_tpu.telemetry import tracecontext
 
 __all__ = [
     "percentile_ms",
@@ -210,21 +211,33 @@ def run_fleet_loop(
     join, so a wedged fleet costs the run a bounded wait (workers still
     stuck at the deadline are counted as hung and their remaining
     requests as failures).
+
+    Each request runs under its own root trace context (the way a real
+    client front door would mint one), so with a tracer installed the
+    router's attempt spans group per request in the merged timeline;
+    the first few failed requests' trace ids come back under
+    ``failed_trace_ids`` — paste one into
+    ``frcnn telemetry --trace-id`` to see where the request died.
     """
     if concurrency < 1:
         raise ValueError(f"concurrency must be >= 1, got {concurrency}")
     counters = _Counters()
     n = len(requests)
+    failed_traces: List[str] = []
 
     def _worker(start: int) -> None:
         for i in range(start, n, concurrency):
             payload, content_hash = requests[i]
+            trace = tracecontext.new_trace_context()
             t0 = time.monotonic()
             try:
-                dispatch(payload, content_hash)
+                with tracecontext.bind(trace):
+                    dispatch(payload, content_hash)
             except Exception:  # noqa: BLE001 - tallied as unavailability
                 with counters.lock:
                     counters.errors += 1
+                    if len(failed_traces) < 16:
+                        failed_traces.append(trace.trace_id)
                 continue
             dt = time.monotonic() - t0
             with counters.lock:
@@ -254,6 +267,8 @@ def run_fleet_loop(
     )
     summary["ok"] = ok
     summary["availability"] = round(ok / n, 6) if n else 0.0
+    with counters.lock:
+        summary["failed_trace_ids"] = list(failed_traces)
     return summary
 
 

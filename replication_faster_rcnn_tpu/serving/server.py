@@ -16,13 +16,24 @@ Overload contract (serving/overload.py):
 * multi-path requests are isolated per path: one failing image costs
   that one entry an ``"error"`` value, the rest still return detections.
 
+Tracing: an incoming W3C ``traceparent`` header (the fleet tier's
+client injects one per attempt) is adopted as the request's trace —
+the handler binds a child context for the duration, so the engine's
+queue-wait/dispatch hop spans and any error response share the
+caller's trace id.  Requests arriving without the header get a fresh
+root trace.
+
 Endpoints:
   POST /predict  {"paths": ["a.jpg", ...]} or {"path": "a.jpg"}, optional
                  "score_thresh" — detections per image (boxes in original
                  image coordinates, row-major [r1, c1, r2, c2]); per-path
-                 failures come back under "errors"
+                 failures come back under "errors"; error responses carry
+                 the request's "trace_id"
   GET  /healthz  liveness + bucket inventory + degraded flag
-  GET  /stats    request/flush/padding + shed/timeout/error counters
+  GET  /stats    unified frcnn-stats/v1 envelope: schema/tier/metrics +
+                 the replica's structured sections (stats, queue depths,
+                 compile_seconds, slo)
+  GET  /metrics  the same registry in Prometheus text exposition format
 """
 
 from __future__ import annotations
@@ -40,6 +51,12 @@ from replication_faster_rcnn_tpu.faultlib import failpoints
 from replication_faster_rcnn_tpu.serving.overload import (
     DeadlineExceeded,
     retry_after_s,
+)
+from replication_faster_rcnn_tpu.telemetry import spans as tspans
+from replication_faster_rcnn_tpu.telemetry import tracecontext
+from replication_faster_rcnn_tpu.telemetry.metrics import (
+    PROMETHEUS_CONTENT_TYPE,
+    stats_payload,
 )
 
 __all__ = ["make_server"]
@@ -102,17 +119,25 @@ class _Handler(BaseHTTPRequestHandler):
                 },
             )
         elif self.path == "/stats":
-            payload = {
+            sections = {
                 "stats": dict(engine.stats),
                 "queue_depth": engine.queue_depth(),
                 "bucket_queue_depths": engine.bucket_queue_depths(),
                 "compile_seconds": dict(engine.compile_seconds),
+                "slo": engine.slo.snapshot(),
             }
             if engine.deadline_controller is not None:
-                payload["adaptive_delay_ms"] = (
+                sections["adaptive_delay_ms"] = (
                     engine.deadline_controller.delays_ms()
                 )
-            self._reply(200, payload)
+            self._reply(200, stats_payload("replica", engine.metrics, **sections))
+        elif self.path == "/metrics":
+            body = engine.metrics.render_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -120,10 +145,43 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/predict":
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
+        engine = self.server.engine
+        # adopt the caller's trace (e.g. the fleet client's traceparent
+        # header) as this request's parent, or start a fresh root; the
+        # context is BOUND for the whole handler body so the engine's
+        # hop spans, chaos events and error replies share the trace id
+        trace = None
+        if engine.config.telemetry.trace_propagation:
+            parent = tracecontext.parse_traceparent(
+                self.headers.get(tracecontext.TRACEPARENT_HEADER)
+            )
+            trace = (
+                parent.child()
+                if parent is not None
+                else tracecontext.new_trace_context()
+            )
+        tracer = tspans.current_tracer()
+        t_req = tracer.now_us()
+        try:
+            with tracecontext.bind(trace):
+                self._handle_predict(trace)
+        finally:
+            if tracer.enabled and trace is not None:
+                tracer.complete(
+                    "serve/request",
+                    t_req,
+                    tracer.now_us() - t_req,
+                    cat="serve",
+                    **trace.span_args(),
+                )
+
+    def _handle_predict(self, trace) -> None:
+        engine = self.server.engine
+        trace_id = trace.trace_id if trace is not None else None
         try:
             inj = failpoints.fire("http.handler", path=self.path)
         except failpoints.ChaosError as e:
-            self._reply(500, {"error": str(e)})
+            self._reply(500, {"error": str(e), "trace_id": trace_id})
             return
         if inj is not None and inj.kind == "drop":
             # simulate a dropped connection: shut the socket with no
@@ -131,7 +189,6 @@ class _Handler(BaseHTTPRequestHandler):
             with contextlib.suppress(OSError):
                 self.connection.shutdown(socket.SHUT_RDWR)
             return
-        engine = self.server.engine
         try:
             length = int(self.headers.get("Content-Length", 0))
             req = json.loads(self.rfile.read(length) or b"{}")
@@ -140,7 +197,7 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError('need "path" or non-empty "paths"')
             thresh = float(req.get("score_thresh", self.server.score_thresh))
         except (ValueError, KeyError, json.JSONDecodeError) as e:
-            self._reply(400, {"error": str(e)})
+            self._reply(400, {"error": str(e), "trace_id": trace_id})
             return
 
         # submit everything first: same-bucket paths coalesce into shared
@@ -188,7 +245,11 @@ class _Handler(BaseHTTPRequestHandler):
         if shed:
             self._reply(
                 503,
-                {"error": "serving queue is full", "errors": errors},
+                {
+                    "error": "serving queue is full",
+                    "errors": errors,
+                    "trace_id": trace_id,
+                },
                 headers={
                     "Retry-After": retry_after_s(
                         engine.config.serving.max_delay_ms
@@ -201,7 +262,11 @@ class _Handler(BaseHTTPRequestHandler):
             # client when the queue should have turned over
             self._reply(
                 504,
-                {"error": "request deadline exceeded", "errors": errors},
+                {
+                    "error": "request deadline exceeded",
+                    "errors": errors,
+                    "trace_id": trace_id,
+                },
                 headers={
                     "Retry-After": retry_after_s(
                         engine.config.serving.max_delay_ms
@@ -209,9 +274,18 @@ class _Handler(BaseHTTPRequestHandler):
                 },
             )
         elif bad_input == len(paths):
-            self._reply(400, {"error": "; ".join(errors.values())})
+            self._reply(
+                400, {"error": "; ".join(errors.values()), "trace_id": trace_id}
+            )
         else:
-            self._reply(500, {"error": "all paths failed", "errors": errors})
+            self._reply(
+                500,
+                {
+                    "error": "all paths failed",
+                    "errors": errors,
+                    "trace_id": trace_id,
+                },
+            )
 
 
 def make_server(

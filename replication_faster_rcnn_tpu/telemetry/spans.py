@@ -59,6 +59,19 @@ class NullTracer:
     def counter(self, name: str, value: float, cat: str = "counter") -> None:
         pass
 
+    def now_us(self) -> float:
+        return 0.0
+
+    def complete(
+        self,
+        name: str,
+        ts_us: float,
+        dur_us: float,
+        cat: str = "phase",
+        **args: Any,
+    ) -> None:
+        pass
+
     def flush(self, path: Optional[str] = None) -> None:
         pass
 
@@ -134,6 +147,35 @@ class SpanTracer:
             if args:
                 event["args"] = args
             self._emit(event)
+
+    def now_us(self) -> float:
+        """This tracer's clock, for callers that measure a span whose
+        start and end happen on different threads (queue-wait hops) and
+        emit it afterwards with :meth:`complete`."""
+        return self._now_us()
+
+    def complete(
+        self,
+        name: str,
+        ts_us: float,
+        dur_us: float,
+        cat: str = "phase",
+        **args: Any,
+    ) -> None:
+        """Emit a complete event with an explicit start/duration — the
+        non-contextmanager twin of :meth:`span` for cross-thread hops."""
+        event = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": ts_us,
+            "dur": dur_us,
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+        }
+        if args:
+            event["args"] = args
+        self._emit(event)
 
     def instant(self, name: str, cat: str = "mark", **args: Any) -> None:
         event = {

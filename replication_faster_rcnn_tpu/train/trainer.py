@@ -156,7 +156,9 @@ class Trainer:
                 return os.path.join(telemetry_dir, f"{stem}.rank{rank}{ext}")
 
             self.tracer = tspans.SpanTracer(
-                _rank_file("trace.json"), rank=rank
+                _rank_file("trace.json"),
+                rank=rank,
+                max_events=config.telemetry.trace_max_events,
             )
             # install process-wide so the loader/evaluator/device-cache
             # span call sites (which take no tracer parameter) attach here

@@ -45,9 +45,15 @@ from replication_faster_rcnn_tpu.serving.fleet.registry import (
     DRAINING,
     HEALTHY,
     JOINING,
+    SERVING,
     SHADOW,
 )
-from replication_faster_rcnn_tpu.serving.fleet.router import content_key
+from replication_faster_rcnn_tpu.serving.fleet.router import (
+    CANARY_SLO_MIN_SAMPLES,
+    content_key,
+)
+from replication_faster_rcnn_tpu.telemetry import tracecontext
+from replication_faster_rcnn_tpu.telemetry.spans import SpanTracer, set_tracer
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -534,16 +540,206 @@ class TestHedgedDispatch:
         try:
             # no samples yet: hedge conservatively at the ceiling
             assert router.hedge_delay_s() == 1.0
-            with router._lock:
-                router._latency_s.extend([0.05] * 100)
-            # 2.0 x 50ms p99 = 100ms, inside the clamps
-            assert router.hedge_delay_s() == pytest.approx(0.1)
-            with router._lock:
-                router._latency_s.clear()
-                router._latency_s.extend([0.001] * 100)
+            # the delay derives from the attempt HISTOGRAM p99 (bounded
+            # memory), not a raw-sample list
+            for _ in range(100):
+                router._attempt_hist.observe(0.05)
+            expected = 2.0 * router._attempt_hist.percentile(99)
+            assert 0.08 <= expected <= 0.1  # ~2 x 50ms, inside the clamps
+            assert router.hedge_delay_s() == pytest.approx(expected)
+            # tiny latencies clamp up to the floor
+            for _ in range(10_000):
+                router._attempt_hist.observe(0.0001)
             assert router.hedge_delay_s() == pytest.approx(0.01)  # floor
         finally:
             router.close()
+
+
+# ------------------------------------------------- trace propagation
+
+
+class TestTracePropagation:
+    """ISSUE 16: every attempt of one request — failover walk or hedge
+    fan-out — is a child span of the request's root context, so the
+    merged Chrome trace groups the whole story under one trace id."""
+
+    def _attempt_spans(self, tracer):
+        return [e for e in tracer.to_dict()["traceEvents"]
+                if e["name"] == "fleet/attempt"]
+
+    def test_failover_attempts_share_trace_with_distinct_spans(self):
+        clients = {
+            rid: LocalReplicaClient(rid, lambda p, rid=rid: rid)
+            for rid in ("r0", "r1", "r2")
+        }
+        reg, router = _fleet(clients, cache_entries=0)
+        primary = router.candidates("img")[0]
+        clients[primary].kill()
+        tracer = SpanTracer()
+        set_tracer(tracer)
+        try:
+            root = tracecontext.new_trace_context()
+            with tracecontext.bind(root):
+                router.dispatch("x", content_hash="img")
+        finally:
+            set_tracer(None)
+        attempts = self._attempt_spans(tracer)
+        assert len(attempts) == 2  # failed primary + winning failover
+        args = [e["args"] for e in attempts]
+        # one trace id across the walk — the caller's root, not a fresh one
+        assert {a["trace_id"] for a in args} == {root.trace_id}
+        # distinct span ids, both siblings under the request span
+        assert len({a["span_id"] for a in args}) == 2
+        assert {a["parent_span_id"] for a in args} == {root.span_id}
+        by_ok = {a["ok"]: a for a in args}
+        assert by_ok[False]["replica"] == primary
+        assert by_ok[True]["replica"] != primary
+        # the request-level span wraps the walk under the same trace
+        req = [e for e in tracer.to_dict()["traceEvents"]
+               if e["name"] == "fleet/request"]
+        assert len(req) == 1
+        assert req[0]["args"]["trace_id"] == root.trace_id
+
+    def test_hedged_attempts_are_siblings_under_one_trace(self):
+        release = threading.Event()
+
+        def slow(p):
+            release.wait(10)
+            return "slow"
+
+        clients = {
+            "fast": LocalReplicaClient("fast", lambda p: "fast"),
+            "slow": LocalReplicaClient("slow", slow),
+        }
+        reg, router = _fleet(
+            clients, hedge=True, hedge_floor_ms=20.0, hedge_ceiling_ms=20.0,
+            request_timeout_s=10.0, cache_entries=0,
+        )
+        tracer = SpanTracer()
+        set_tracer(tracer)
+        try:
+            root = tracecontext.new_trace_context()
+            k = next(k for k in (f"k{i}" for i in range(32))
+                     if router.candidates(k)[0] == "slow")
+            with tracecontext.bind(root):
+                assert router.dispatch("x", content_hash=k) == "fast"
+        finally:
+            release.set()
+            router.close()  # joins the pool: the abandoned span lands
+            set_tracer(None)
+        attempts = self._attempt_spans(tracer)
+        assert len(attempts) == 2  # the winner AND the abandoned primary
+        args = [e["args"] for e in attempts]
+        assert {a["trace_id"] for a in args} == {root.trace_id}
+        assert len({a["span_id"] for a in args}) == 2
+        assert {a["parent_span_id"] for a in args} == {root.span_id}
+        hedged = next(a for a in args if a["hedge"])
+        assert hedged["replica"] == "fast"
+
+    def test_router_mints_a_root_when_none_is_bound(self):
+        clients = {"r0": LocalReplicaClient("r0", lambda p: p)}
+        reg, router = _fleet(clients, cache_entries=0)
+        tracer = SpanTracer()
+        set_tracer(tracer)
+        try:
+            router.dispatch("x", content_hash="h")
+        finally:
+            set_tracer(None)
+        (attempt,) = self._attempt_spans(tracer)
+        assert len(attempt["args"]["trace_id"]) == 32
+
+    def test_http_client_stamps_traceparent_header(self):
+        from replication_faster_rcnn_tpu.serving.fleet.client import (
+            HTTPReplicaClient,
+        )
+
+        seen = {}
+
+        class _Resp:
+            status = 200
+
+            def read(self):
+                return b'{"detections": {"img.png": []}}'
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        client = HTTPReplicaClient("r0", "http://127.0.0.1:9")
+        ctx = tracecontext.new_trace_context()
+
+        def fake_urlopen(req, timeout=None):
+            seen.update(req.headers)
+            return _Resp()
+
+        import urllib.request as _ur
+
+        real = _ur.urlopen
+        _ur.urlopen = fake_urlopen
+        try:
+            with tracecontext.bind(ctx):
+                client.predict("img.png", timeout_s=1.0)
+            assert seen.get("Traceparent") == ctx.to_traceparent()
+            # no bound context, no header — tracing stays opt-in
+            seen.clear()
+            client.predict("img.png", timeout_s=1.0)
+        finally:
+            _ur.urlopen = real
+        assert "Traceparent" not in seen
+
+
+# ------------------------------------------------- canary SLO auto-demote
+
+
+class TestCanaryAutoDemote:
+    def test_alarming_canary_is_demoted_to_serving(self):
+        clients = {
+            "r0": LocalReplicaClient("r0", lambda p: "r0"),
+            "canary0": LocalReplicaClient("canary0", lambda p: "canary0"),
+        }
+        # breaker held open-proof so the canary keeps taking (failing)
+        # attempts long enough to cross the demote sample floor
+        reg, router = _fleet(
+            clients, canary_fraction=0.5, cache_entries=0,
+            breaker_threshold=10 * CANARY_SLO_MIN_SAMPLES,
+            lease_timeout_s=600.0,
+        )
+        clients["canary0"].kill()
+        hashes = [content_key(f"img-{i}".encode()) for i in range(512)]
+        hit = [h for h in hashes if router.candidates(h)[0] == "canary0"]
+        assert len(hit) > CANARY_SLO_MIN_SAMPLES
+        demoted_after = None
+        for i, h in enumerate(hit):
+            # every request still serves — the kill only costs a failover
+            assert router.dispatch("x", content_hash=h) == "r0"
+            if reg.role_of("canary0") == SERVING:
+                demoted_after = i + 1
+                break
+        assert demoted_after == CANARY_SLO_MIN_SAMPLES
+        assert router.stats["canary_demotions"] == 1
+        events = [e for e in reg.events()
+                  if e.get("event") == "replica_role_changed"]
+        assert len(events) == 1
+        assert events[0]["replica"] == "canary0"
+        assert events[0]["from"] == CANARY and events[0]["to"] == SERVING
+        assert "burn-rate" in events[0]["reason"]
+        # demoted means out of the canary slice: no more canary routing
+        assert router.candidates(hit[0])[0] != "canary0"
+
+    def test_healthy_canary_keeps_its_slice(self):
+        clients = {
+            "r0": LocalReplicaClient("r0", lambda p: "r0"),
+            "canary0": LocalReplicaClient("canary0", lambda p: "canary0"),
+        }
+        reg, router = _fleet(clients, canary_fraction=0.5, cache_entries=0)
+        hashes = [content_key(f"img-{i}".encode()) for i in range(256)]
+        hit = [h for h in hashes if router.candidates(h)[0] == "canary0"]
+        for h in hit[: 2 * CANARY_SLO_MIN_SAMPLES]:
+            router.dispatch("x", content_hash=h)
+        assert reg.role_of("canary0") == CANARY
+        assert router.stats["canary_demotions"] == 0
 
 
 # ------------------------------------------------------------- HTTP front
@@ -559,12 +755,12 @@ def _fleet_http(clients, tmp_path, **cfg_kw):
     return server, router, f"http://{host}:{port}"
 
 
-def _post(base, payload, timeout=30):
+def _post(base, payload, timeout=30, headers=None):
     req = urllib.request.Request(
         f"{base}/predict",
         data=json.dumps(payload).encode(),
         method="POST",
-        headers={"Content-Type": "application/json"},
+        headers={"Content-Type": "application/json", **(headers or {})},
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -647,6 +843,72 @@ class TestFleetHTTP:
             server.server_close()
             router.close()
 
+    def test_stats_schema_and_prometheus_parity(self, tmp_path):
+        """ISSUE 16 acceptance: /stats serves the unified envelope and
+        /metrics serves Prometheus text whose counter values MATCH the
+        JSON — one registry behind both renders."""
+        from tests.test_observability import parse_prometheus
+
+        clients = {"r0": LocalReplicaClient("r0", lambda p: p)}
+        server, router, base = _fleet_http(clients, tmp_path)
+        p = str(tmp_path / "a.bin")
+        with open(p, "wb") as f:
+            f.write(b"x")
+        try:
+            for _ in range(3):
+                assert _post(base, {"path": p})[0] == 200
+            with urllib.request.urlopen(f"{base}/stats", timeout=10) as r:
+                stats = json.loads(r.read())
+            assert stats["schema"] == "frcnn-stats/v1"
+            assert stats["tier"] == "fleet"
+            assert stats["router"]["requests"] == 3  # historical section
+            assert stats["metrics"]["counters"]["fleet_requests_total"] == 3
+            assert "slo" in stats and "burn_rates" in stats["slo"]
+            with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+                ctype = r.headers["Content-Type"]
+                text = r.read().decode()
+            assert ctype.startswith("text/plain") and "0.0.4" in ctype
+            values, types = parse_prometheus(text)
+            assert types["fleet_requests_total"] == "counter"
+            for series, v in stats["metrics"]["counters"].items():
+                assert values[series] == v, series
+            # gauges and the attempt-latency histogram ride along
+            assert "fleet_cache_size" in values
+            assert values["fleet_attempt_seconds_count"] >= 1
+        finally:
+            server.shutdown()
+            server.server_close()
+            router.close()
+
+    def test_error_replies_carry_the_callers_trace_id(self, tmp_path):
+        clients = {"r0": LocalReplicaClient("r0", lambda p: p)}
+        server, router, base = _fleet_http(clients, tmp_path)
+        tid = "ab" * 16
+        header = {"traceparent": f"00-{tid}-{'cd' * 8}-01"}
+        try:
+            # client error: the trace id from the caller's traceparent
+            status, body, _ = _post(base, {}, headers=header)
+            assert status == 400
+            assert body["trace_id"] == tid
+            # server minting: no header still yields a well-formed id
+            status, body, _ = _post(base, {})
+            assert status == 400
+            assert len(body["trace_id"]) == 32
+            # unavailability carries it too (and names it in the message)
+            clients["r0"].kill()
+            p = str(tmp_path / "a.bin")
+            with open(p, "wb") as f:
+                f.write(b"x")
+            status, body, _ = _post(base, {"path": p}, headers=header)
+            assert status == 503
+            assert body["trace_id"] == tid
+            # the per-path failure message names the trace id too
+            assert any(tid in msg for msg in body["errors"].values())
+        finally:
+            server.shutdown()
+            server.server_close()
+            router.close()
+
 
 # --------------------------------------------------- fleet_profile gate
 
@@ -674,6 +936,13 @@ class TestFleetProfileGate:
             "failovers": 2,
             "hedge": {"hedges": 3, "hedge_wins": 2},
             "fleet": {"errors": 0, "n_requests": 240},
+            "slo": {
+                "alarm_during_kill": True,
+                "cleared_after_rejoin": True,
+                "burn_during_kill": {"short": 20.0, "long": 18.0},
+                "burn_after_rejoin": {"short": 0.0, "long": 0.0},
+            },
+            "trace_failover_evidence": True,
         }
         rec.update(kw)
         return rec
@@ -708,6 +977,47 @@ class TestFleetProfileGate:
         cur = self._record(fp, **{fp.GATE_KEY: 500.0 * 0.85})
         failures, warnings = fp.check_regression(cur, banked, tol=0.25)
         assert not failures and any("slipping" in w for w in warnings)
+
+    def test_slo_gate_requires_alarm_during_kill(self, fp):
+        cur = self._record(fp)
+        cur["slo"]["alarm_during_kill"] = False
+        failures, _ = fp.check_regression(cur, None)
+        assert any("alarm did not fire" in f for f in failures)
+
+    def test_slo_gate_requires_burn_to_clear_after_rejoin(self, fp):
+        cur = self._record(fp)
+        cur["slo"]["cleared_after_rejoin"] = False
+        cur["slo"]["burn_after_rejoin"] = {"short": 7.0, "long": 3.0}
+        failures, _ = fp.check_regression(cur, None)
+        assert any("did not drop below 1" in f and "short=7.0" in f
+                   for f in failures)
+
+    def test_trace_failover_evidence_gate(self, fp):
+        cur = self._record(fp, trace_failover_evidence=False)
+        failures, _ = fp.check_regression(cur, None)
+        assert any("single trace id" in f for f in failures)
+        # records predating the leg (no key at all) don't fail the gate
+        cur = self._record(fp)
+        del cur["trace_failover_evidence"]
+        del cur["slo"]
+        assert fp.check_regression(cur, None)[0] == []
+
+    def test_failover_trace_evidence_helper(self, fp):
+        def att(tid, replica, ok):
+            return {"name": "fleet/attempt", "ph": "X",
+                    "args": {"trace_id": tid, "replica": replica, "ok": ok}}
+
+        events = [
+            att("t2", "r0", True),                      # clean request
+            att("t1", "r0", False), att("t1", "r1", True),  # failed over
+            {"name": "fleet/request", "ph": "X", "args": {"trace_id": "t1"}},
+        ]
+        assert fp._failover_trace_evidence(events) == "t1"
+        # one replica only, or no failure, is not failover evidence
+        assert fp._failover_trace_evidence([att("t3", "r0", True)]) is None
+        assert fp._failover_trace_evidence(
+            [att("t4", "r0", False), att("t4", "r0", False)]
+        ) is None
 
     def test_schema_mismatch_skips_comparison(self, fp):
         banked = self._record(fp, schema="fleet_profile/v0")

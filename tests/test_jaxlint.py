@@ -244,12 +244,18 @@ class TestCheckCLI:
         assert rc == 0
         payload = json.loads(capsys.readouterr().out)
         assert payload["ok"] is True
-        # check runs jaxlint + threadlint; every JX rule must be present
+        # check runs jaxlint + threadlint + obslint; every rule of each
+        # must be present
+        from replication_faster_rcnn_tpu.analysis.obslint import (
+            RULES as OB_RULES,
+        )
         from replication_faster_rcnn_tpu.analysis.threadlint import (
             RULES as TL_RULES,
         )
 
-        assert sorted(payload["rules"]) == sorted([*RULES, *TL_RULES])
+        assert sorted(payload["rules"]) == sorted(
+            [*RULES, *TL_RULES, *OB_RULES]
+        )
         assert payload["findings"] == []
 
     def test_check_nonzero_on_findings(self, capsys):

@@ -1,0 +1,475 @@
+"""Observability core (ISSUE 16 tentpole): W3C-style trace contexts,
+the unified MetricsRegistry with Prometheus text exposition, SLO
+error-budget burn-rate accounting, and the OB001 unified-metrics lint.
+
+Everything here is pure host code — no JAX, no sockets.  The Prometheus
+renderer is checked with a test-side text-format parser (the acceptance
+criterion: ``/metrics`` must expose the SAME counter values the
+``/stats`` JSON reports), and the burn tracker runs on an injected
+clock so window expiry is deterministic.
+"""
+
+import json
+import threading
+
+import pytest
+
+from replication_faster_rcnn_tpu.telemetry import tracecontext
+from replication_faster_rcnn_tpu.telemetry.metrics import (
+    PROMETHEUS_CONTENT_TYPE,
+    STATS_SCHEMA,
+    MetricsRegistry,
+    stats_payload,
+)
+from replication_faster_rcnn_tpu.telemetry.slo_burn import BurnRateTracker
+
+# ------------------------------------------------------------ trace context
+
+
+class TestTraceContext:
+    def test_new_context_shape(self):
+        ctx = tracecontext.new_trace_context()
+        assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+        int(ctx.trace_id, 16)  # hex or raise
+        int(ctx.span_id, 16)
+        assert ctx.parent_span_id is None
+
+    def test_traceparent_roundtrip(self):
+        ctx = tracecontext.new_trace_context()
+        header = ctx.to_traceparent()
+        assert header == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+        back = tracecontext.parse_traceparent(header)
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+
+    @pytest.mark.parametrize("bad", [
+        None,
+        "",
+        "garbage",
+        "00-zz-zz-01",
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",   # short trace id
+        "01-" + "a" * 32 + "-" + "b" * 16 + "-01",   # unknown version
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",   # all-zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",   # all-zero span id
+    ])
+    def test_malformed_headers_parse_to_none(self, bad):
+        assert tracecontext.parse_traceparent(bad) is None
+
+    def test_child_and_sibling_semantics(self):
+        root = tracecontext.new_trace_context()
+        child = root.child()
+        assert child.trace_id == root.trace_id
+        assert child.span_id != root.span_id
+        assert child.parent_span_id == root.span_id
+        # hedged attempts: same trace AND same parent, fresh span id
+        a, b = root.child(), root.child()
+        assert a.span_id != b.span_id
+        assert a.parent_span_id == b.parent_span_id == root.span_id
+        sib = child.sibling()
+        assert sib.trace_id == child.trace_id
+        assert sib.parent_span_id == child.parent_span_id
+        assert sib.span_id != child.span_id
+
+    def test_span_args_carry_tree_edge(self):
+        root = tracecontext.new_trace_context()
+        assert root.span_args() == {
+            "trace_id": root.trace_id, "span_id": root.span_id
+        }
+        child = root.child()
+        assert child.span_args()["parent_span_id"] == root.span_id
+
+    def test_bind_is_thread_local(self):
+        assert tracecontext.current_trace() is None
+        ctx = tracecontext.new_trace_context()
+        seen_in_thread = []
+
+        def other():
+            seen_in_thread.append(tracecontext.current_trace())
+
+        with tracecontext.bind(ctx):
+            assert tracecontext.current_trace() is ctx
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+            with tracecontext.bind(ctx.child()) as inner:
+                assert tracecontext.current_trace() is inner
+            assert tracecontext.current_trace() is ctx  # restored
+        assert tracecontext.current_trace() is None
+        assert seen_in_thread == [None]  # never leaks across threads
+
+
+# -------------------------------------------------------- metrics registry
+
+
+def parse_prometheus(text: str):
+    """Minimal Prometheus text-format 0.0.4 parser: returns
+    ({series -> value}, {family -> type}).  A series key is
+    ``name{label="v",...}`` exactly as rendered."""
+    values, types = {}, {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE"):
+            _, _, family, kind = line.split(None, 3)
+            types[family] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        series, value = line.rsplit(None, 1)
+        assert series not in values, f"duplicate series {series}"
+        values[series] = float(value)
+    return values, types
+
+
+class TestMetricsRegistry:
+    def test_counter_monotonic(self):
+        reg = MetricsRegistry()
+        c = reg.counter("requests_total", "requests")
+        c.inc()
+        c.inc(2)
+        assert c.value == 3
+        with pytest.raises(ValueError, match="cannot decrease"):
+            c.inc(-1)
+        # same (name, labels) returns the same instrument
+        assert reg.counter("requests_total", "requests") is c
+
+    def test_gauge_moves_both_ways(self):
+        g = MetricsRegistry().gauge("depth", "queue depth")
+        g.set(5)
+        g.dec(2)
+        g.inc(1)
+        assert g.value == 4
+
+    def test_kind_mismatch_is_a_type_error(self):
+        reg = MetricsRegistry()
+        reg.counter("x_total", "x")
+        with pytest.raises(TypeError, match="x_total"):
+            reg.gauge("x_total", "x")
+
+    def test_labels_make_distinct_series(self):
+        reg = MetricsRegistry()
+        a = reg.counter("attempts_total", "per replica", replica="r0")
+        b = reg.counter("attempts_total", "per replica", replica="r1")
+        assert a is not b
+        a.inc(3)
+        b.inc()
+        flat = reg.counters_flat()
+        assert flat['attempts_total{replica="r0"}'] == 3
+        assert flat['attempts_total{replica="r1"}'] == 1
+
+    def test_histogram_percentiles_and_snapshot(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "latency",
+                          buckets=(0.01, 0.1, 1.0, 10.0))
+        assert h.percentile(99) == 0.0  # empty: defined, not an error
+        for _ in range(100):
+            h.observe(0.05)
+        p50, p99 = h.percentile(50), h.percentile(99)
+        # every sample landed in the (0.01, 0.1] bucket: interpolated
+        # percentiles stay inside it and are monotone
+        assert 0.01 <= p50 <= p99 <= 0.1
+        snap = h.snapshot()
+        assert snap["count"] == 100
+        assert snap["sum"] == pytest.approx(5.0)
+        assert snap["p50"] == pytest.approx(p50)
+        # cumulative buckets end at the total count
+        assert snap["buckets"]["+Inf"] == 100
+        assert snap["buckets"]["0.1"] == 100
+
+    def test_histogram_rejects_bad_buckets(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.histogram("h", "h", buckets=(1.0, 0.5))
+
+    def test_collectors_refresh_gauges_on_snapshot(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("depth", "sampled lazily")
+        state = {"depth": 7}
+        reg.register_collector(lambda: g.set(state["depth"]))
+        assert reg.snapshot()["gauges"]["depth"] == 7
+        state["depth"] = 9
+        assert reg.snapshot()["gauges"]["depth"] == 9
+
+    def test_prometheus_exposition_matches_snapshot(self):
+        """The acceptance criterion at registry level: the text format
+        parses and every counter value equals the JSON snapshot's."""
+        reg = MetricsRegistry()
+        reg.counter("requests_total", "total requests").inc(12)
+        reg.counter("attempts_total", "per replica", replica="r0").inc(5)
+        reg.counter("attempts_total", "per replica", replica="r1").inc(2)
+        reg.gauge("depth", "queue depth").set(3)
+        h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+
+        assert "version=0.0.4" in PROMETHEUS_CONTENT_TYPE
+        values, types = parse_prometheus(reg.render_prometheus())
+        assert types["requests_total"] == "counter"
+        assert types["depth"] == "gauge"
+        assert types["lat_seconds"] == "histogram"
+        for series, value in reg.counters_flat().items():
+            assert values[series] == value, series
+        assert values["depth"] == 3
+        # histogram: cumulative buckets, +Inf == count, sum matches
+        assert values['lat_seconds_bucket{le="0.1"}'] == 1
+        assert values['lat_seconds_bucket{le="1"}'] == 2
+        assert values['lat_seconds_bucket{le="+Inf"}'] == 2
+        assert values["lat_seconds_count"] == 2
+        assert values["lat_seconds_sum"] == pytest.approx(0.55)
+
+    def test_registry_is_thread_safe_under_contention(self):
+        reg = MetricsRegistry()
+        c = reg.counter("n_total", "contended")
+
+        def worker():
+            for _ in range(1000):
+                c.inc()
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 8000
+
+
+class TestStatsPayload:
+    def test_envelope_shape(self):
+        reg = MetricsRegistry()
+        reg.counter("x_total", "x").inc()
+        payload = stats_payload("replica", reg, stats={"x": 1})
+        assert payload["schema"] == STATS_SCHEMA
+        assert payload["tier"] == "replica"
+        assert payload["metrics"]["counters"]["x_total"] == 1
+        assert payload["stats"] == {"x": 1}
+
+    def test_section_names_cannot_collide_with_parameters(self):
+        # router.snapshot() has "registry"/"router" sections; the
+        # positional-only signature must accept them as kwargs
+        payload = stats_payload(
+            "fleet", MetricsRegistry(), registry={"r0": {}}, tier_x=1
+        )
+        assert payload["registry"] == {"r0": {}}
+
+
+# ----------------------------------------------------------- SLO burn rate
+
+
+class TestBurnRateTracker:
+    def _tracker(self, **kw):
+        now = [0.0]
+        kw.setdefault("availability_target", 0.999)
+        kw.setdefault("short_window_s", 10.0)
+        kw.setdefault("long_window_s", 100.0)
+        return BurnRateTracker(clock=lambda: now[0], **kw), now
+
+    def test_burn_is_error_rate_over_budget(self):
+        tr, _ = self._tracker()
+        for _ in range(99):
+            tr.record(True)
+        tr.record(False)  # 1% error rate against a 0.1% budget
+        burns = tr.burn_rates()
+        assert burns["short"] == pytest.approx(10.0)
+        assert burns["long"] == pytest.approx(10.0)
+
+    def test_alarm_requires_both_windows(self):
+        """The multi-window AND rule: a burst that has already aged out
+        of the short window must not alarm on the long window alone."""
+        tr, now = self._tracker(alarm_burn=1.0)
+        for _ in range(10):
+            tr.record(False)
+        assert tr.alarm()  # burst is in both windows
+        now[0] = 50.0  # past the short window, inside the long one
+        for _ in range(1000):
+            tr.record(True)  # short window now clean
+        assert tr.burn_rates()["long"] > 1.0
+        assert tr.burn_rates()["short"] < 1.0
+        assert not tr.alarm()
+
+    def test_burn_clears_when_windows_age_out(self):
+        tr, now = self._tracker()
+        for _ in range(10):
+            tr.record(False)
+        assert tr.alarm()
+        now[0] = 200.0  # everything expired
+        assert tr.burn_rates() == {"short": 0.0, "long": 0.0}
+        assert not tr.alarm()
+
+    def test_latency_slo_counts_slow_successes_as_errors(self):
+        tr, _ = self._tracker(latency_target_s=0.1)
+        for _ in range(9):
+            tr.record(True, latency_s=0.01)
+        tr.record(True, latency_s=5.0)  # ok but over the latency SLO
+        assert tr.burn_rates()["short"] == pytest.approx(100.0)
+
+    def test_snapshot_shape(self):
+        tr, _ = self._tracker()
+        tr.record(True)
+        tr.record(False)
+        snap = tr.snapshot()
+        assert snap["availability_target"] == 0.999
+        assert snap["budget"] == pytest.approx(0.001)
+        assert snap["samples"] == {"short": 2, "long": 2}
+        assert snap["error_rates"]["short"] == pytest.approx(0.5)
+        assert snap["burn_rates"]["short"] == pytest.approx(500.0)
+        assert snap["alarm"] is True
+        assert snap["total_ok"] == 1 and snap["total_err"] == 1
+
+    def test_empty_tracker_is_quiet(self):
+        tr, _ = self._tracker()
+        assert tr.burn_rates() == {"short": 0.0, "long": 0.0}
+        assert not tr.alarm()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            BurnRateTracker(availability_target=1.5)
+        with pytest.raises(ValueError):
+            BurnRateTracker(short_window_s=100.0, long_window_s=10.0)
+
+
+# ----------------------------------------------------------------- obslint
+
+
+class TestObslint:
+    def _lint(self, tmp_path, source, baseline=None):
+        from replication_faster_rcnn_tpu.analysis import obslint
+
+        p = tmp_path / "mod.py"
+        p.write_text(source)
+        return obslint.lint_paths([str(p)], baseline=baseline,
+                                  pkg_root=str(tmp_path))
+
+    def test_mutation_outside_init_is_flagged(self, tmp_path):
+        res = self._lint(tmp_path, (
+            "class Engine:\n"
+            "    def __init__(self):\n"
+            "        self.stats = {'shed': 0}\n"       # construction: fine
+            "    def on_shed(self):\n"
+            "        self.stats['shed'] += 1\n"        # OB001
+            "    def merge(self, other):\n"
+            "        self.stats.update(other)\n"       # OB001
+            "    def read(self):\n"
+            "        return self.stats['shed']\n"      # read: fine
+        ))
+        assert len(res.findings) == 2
+        assert {f.rule for f in res.findings} == {"OB001"}
+        assert {f.line for f in res.findings} == {5, 7}
+        assert all("self.stats" in f.message for f in res.findings)
+
+    def test_counters_and_suffixed_names_covered(self, tmp_path):
+        res = self._lint(tmp_path, (
+            "def f(router):\n"
+            "    router._counters['x'] = 1\n"
+            "    router.flush_stats.setdefault('y', 0)\n"
+            "    router.status = 1\n"          # not a stats name: fine
+            "    del router._counters['x']\n"
+        ))
+        assert len(res.findings) == 3
+
+    def test_registry_module_is_exempt(self, tmp_path):
+        from replication_faster_rcnn_tpu.analysis import obslint
+
+        d = tmp_path / "telemetry"
+        d.mkdir()
+        p = d / "metrics.py"
+        p.write_text("def f(self):\n    self.stats['x'] = 1\n")
+        res = obslint.lint_paths([str(p)], pkg_root=str(tmp_path))
+        assert res.findings == []
+
+    def test_package_is_clean(self):
+        """The tentpole's contract: no stats-dict mutation anywhere in
+        the shipped package outside the registry itself."""
+        from replication_faster_rcnn_tpu.analysis import obslint
+
+        res = obslint.lint_package()
+        assert res.findings == [], [f.to_dict() for f in res.findings]
+        assert res.stale_waivers == []
+
+    def test_frcnn_check_knows_ob001(self, capsys):
+        from replication_faster_rcnn_tpu import cli
+
+        assert cli.main(["check", "--rules", "OB001"]) == 0
+        assert "finding" in capsys.readouterr().out
+
+
+# --------------------------------------------------- trace timeline report
+
+
+class TestTraceTimeline:
+    def _events(self, tid="a" * 32):
+        root, att1, att2 = "f" * 16, "1" * 16, "2" * 16
+        return [
+            {"name": "fleet/request", "ph": "X", "ts": 0.0, "dur": 9000.0,
+             "pid": 1, "tid": 1,
+             "args": {"trace_id": tid, "span_id": root}},
+            {"name": "fleet/attempt", "ph": "X", "ts": 100.0, "dur": 3000.0,
+             "pid": 1, "tid": 2,
+             "args": {"trace_id": tid, "span_id": att1,
+                      "parent_span_id": root, "replica": "r0",
+                      "hedge": False, "ok": False}},
+            {"name": "fleet/attempt", "ph": "X", "ts": 3500.0, "dur": 5000.0,
+             "pid": 1, "tid": 2,
+             "args": {"trace_id": tid, "span_id": att2,
+                      "parent_span_id": root, "replica": "r1",
+                      "hedge": False, "ok": True}},
+            {"name": "serve/request", "ph": "X", "ts": 3700.0, "dur": 4000.0,
+             "pid": 2, "tid": 1,
+             "args": {"trace_id": tid, "span_id": "3" * 16,
+                      "parent_span_id": att2}},
+            {"name": "serve/request", "ph": "X", "ts": 0.0, "dur": 1.0,
+             "pid": 3, "tid": 1,
+             "args": {"trace_id": "b" * 32, "span_id": "4" * 16}},
+        ]
+
+    def test_filters_one_trace_and_derives_network_time(self):
+        from replication_faster_rcnn_tpu.telemetry.report import (
+            trace_timeline,
+        )
+
+        tl = trace_timeline(self._events(), "a" * 32)
+        assert tl["trace_id"] == "a" * 32
+        assert len(tl["spans"]) == 4  # the other trace's span excluded
+        assert tl["replicas"] == ["r0", "r1"]
+        winning = next(r for r in tl["spans"]
+                       if r["name"] == "fleet/attempt" and r["ok"])
+        # attempt 5 ms, replica-side 4 ms: 1 ms on the wire
+        assert winning["network_ms"] == pytest.approx(1.0)
+        assert tl["total_ms"] == pytest.approx(9.0)
+
+    def test_unknown_trace_returns_none(self):
+        from replication_faster_rcnn_tpu.telemetry.report import (
+            trace_timeline,
+        )
+
+        assert trace_timeline(self._events(), "c" * 32) is None
+
+    def test_format_names_hops_and_failures(self):
+        from replication_faster_rcnn_tpu.telemetry.report import (
+            format_trace_timeline,
+            trace_timeline,
+        )
+
+        text = format_trace_timeline(trace_timeline(self._events(), "a" * 32))
+        assert "a" * 32 in text
+        assert "fleet/attempt" in text and "serve/request" in text
+        assert "replica=r0" in text and "FAILED" in text
+        assert "network=" in text
+
+    def test_cli_trace_id_filter(self, tmp_path, capsys):
+        from replication_faster_rcnn_tpu import cli
+
+        d = tmp_path / "run"
+        d.mkdir()
+        with open(d / "trace.json", "w") as f:
+            json.dump({"traceEvents": self._events(),
+                       "displayTimeUnit": "ms"}, f)
+        assert cli.main(
+            ["telemetry", str(d), "--trace-id", "a" * 32]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "fleet/attempt" in out
+        # an unknown id is a clean nonzero exit, not a stack trace
+        assert cli.main(
+            ["telemetry", str(d), "--trace-id", "c" * 32]
+        ) == 1

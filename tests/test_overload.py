@@ -307,13 +307,13 @@ def _serve(engine):
     return server, f"http://{host}:{port}"
 
 
-def _post(base, payload, timeout=30):
+def _post(base, payload, timeout=30, headers=None):
     """(status, body) for POST /predict; HTTP errors return their code."""
     req = urllib.request.Request(
         f"{base}/predict",
         data=json.dumps(payload).encode(),
         method="POST",
-        headers={"Content-Type": "application/json"},
+        headers={"Content-Type": "application/json", **(headers or {})},
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -447,6 +447,79 @@ class TestHTTPOverload:
             assert "consecutive" in health["degraded_reason"]
         finally:
             knobs.fail = False
+            server.shutdown()
+            server.server_close()
+            engine.close()
+
+    def test_stats_schema_and_prometheus_parity(self, parts, tmp_path):
+        """ISSUE 16 acceptance at the replica tier: /stats serves the
+        unified frcnn-stats/v1 envelope and /metrics serves Prometheus
+        text with the SAME counter values — one registry, two renders."""
+        from tests.test_observability import parse_prometheus
+
+        engine, _ = _make_engine(parts)
+        server, base = _serve(engine)
+        p = _png(tmp_path, "img.png")
+        try:
+            for _ in range(2):
+                assert _post(base, {"path": p})[0] == 200
+            with urllib.request.urlopen(f"{base}/stats", timeout=10) as r:
+                stats = json.loads(r.read())
+            assert stats["schema"] == "frcnn-stats/v1"
+            assert stats["tier"] == "replica"
+            assert stats["stats"]["requests"] >= 2  # historical section
+            assert "slo" in stats and "burn_rates" in stats["slo"]
+            assert stats["metrics"]["counters"]["serve_requests_total"] \
+                == stats["stats"]["requests"]
+            with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+                ctype = r.headers["Content-Type"]
+                text = r.read().decode()
+            assert ctype.startswith("text/plain") and "0.0.4" in ctype
+            values, types = parse_prometheus(text)
+            assert types["serve_requests_total"] == "counter"
+            for series, v in stats["metrics"]["counters"].items():
+                assert values[series] == v, series
+            assert values["serve_queue_wait_seconds_count"] >= 2
+        finally:
+            server.shutdown()
+            server.server_close()
+            engine.close()
+
+    def test_request_hop_spans_join_the_callers_trace(self, parts, tmp_path):
+        """A traceparent header on POST /predict threads the caller's
+        trace id through the replica's hop spans (request -> queue wait
+        -> dispatch) and back out on error replies."""
+        from replication_faster_rcnn_tpu.telemetry.spans import (
+            SpanTracer,
+            set_tracer,
+        )
+
+        engine, _ = _make_engine(parts)
+        server, base = _serve(engine)
+        tid = "ab" * 16
+        header = {"traceparent": f"00-{tid}-{'cd' * 8}-01"}
+        tracer = SpanTracer()
+        set_tracer(tracer)
+        try:
+            status, _, _ = _post(
+                base, {"path": _png(tmp_path, "img.png")}, headers=header
+            )
+            assert status == 200
+            events = [e for e in tracer.to_dict()["traceEvents"]
+                      if e["ph"] == "X"
+                      and e.get("args", {}).get("trace_id") == tid]
+            names = {e["name"] for e in events}
+            assert {"serve/request", "serve/queue_wait",
+                    "serve/dispatch"} <= names
+            # the hops are phases of ONE replica-side span: they share
+            # the handler's span id, distinguished by name
+            assert len({e["args"]["span_id"] for e in events}) == 1
+            # a malformed request's error reply names the trace
+            status, body, _ = _post(base, {}, headers=header)
+            assert status == 400
+            assert body["trace_id"] == tid
+        finally:
+            set_tracer(None)
             server.shutdown()
             server.server_close()
             engine.close()
