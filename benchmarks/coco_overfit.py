@@ -13,7 +13,10 @@ category ids, exercising the id remap of `data/coco.py`):
   single-scale random sampling, 2-bucket multi-scale
   (data.train_resolutions), and topk_iou region sampling
   (arXiv:1702.02138) — each writing an mAP@[.50:.95] curve to
-  benchmarks/coco_overfit_curve_mini_{leg}.jsonl. Before any training
+  benchmarks/coco_overfit_curve_mini_{leg}.jsonl, plus the ISSUE-17
+  quantization A/B on the single leg's checkpoint (f32 eval vs the
+  PTQ int8 serving compute; the drop must stay within
+  QUANT_MAP_DROP_PT mAP points). Before any training
   the run must pass (a) hand-computed COCO-evaluator oracles *exactly*
   and (b) a per-bucket-program presence check against the committed
   fingerprint bank. The result is compared against the banked record
@@ -57,6 +60,9 @@ BANK_PATH = os.path.join(
 # (a >15% multi-scale dispatch overhead fails the run)
 THROUGHPUT_RATIO_FLOOR = 0.85
 MINI_BUCKETS = ((32, 32), (64, 64))
+# int8 PTQ may cost at most this many mAP@[.50:.95] points vs the same
+# checkpoint's f32 eval (ISSUE-17 acceptance)
+QUANT_MAP_DROP_PT = 0.3
 
 
 def write_synthetic_coco(root: str, split: str, n_images: int,
@@ -268,6 +274,18 @@ def check_gate(record: dict, banked: dict) -> tuple:
                 f"{floor:.4f}"
             )
 
+    quant = record.get("quant") or {}
+    drop = quant.get("map_drop_pt")
+    if drop is None:
+        fails.append("record has no quantization mAP A/B (quant leg)")
+    elif float(drop) > QUANT_MAP_DROP_PT:
+        fails.append(
+            f"int8 PTQ costs {float(drop):.3f} mAP points "
+            f"(f32 {quant.get('f32_mAP'):.4f} -> int8 "
+            f"{quant.get('int8_mAP'):.4f}); budget is "
+            f"{QUANT_MAP_DROP_PT} pt"
+        )
+
     legs = record.get("legs", {})
     single = float(legs.get("single", {}).get("images_per_sec", 0.0))
     buckets = float(legs.get("buckets", {}).get("images_per_sec", 0.0))
@@ -291,6 +309,58 @@ def check_gate(record: dict, banked: dict) -> tuple:
                 f"{old:.3f} img/s (timing only — not gated)"
             )
     return fails, warns
+
+
+def _quant_leg(args) -> dict:
+    """ISSUE-17 quantization A/B on the single leg's checkpoint: the
+    f32 eval vs the quantized serving compute (PTQ calibration on the
+    train split, the sensitivity sweep's per-group plan, then
+    `quant/apply.py` reconstruction — dequantized weights + the
+    QuantDense int8 head GEMMs — through the SAME Evaluator protocol).
+    Gated: the mAP@[.50:.95] drop must stay within QUANT_MAP_DROP_PT."""
+    from replication_faster_rcnn_tpu import quant
+    from replication_faster_rcnn_tpu.data import make_dataset
+    from replication_faster_rcnn_tpu.eval import Evaluator
+    from replication_faster_rcnn_tpu.serving.engine import _plain_dicts
+    from replication_faster_rcnn_tpu.train.trainer import load_eval_variables
+
+    cfg = _mini_config(args)
+    model, variables = load_eval_variables(
+        cfg, os.path.join(args.workdir, "single")
+    )
+    variables = _plain_dicts(variables)
+    train_ds = make_dataset(cfg.data, "train")
+    ev = Evaluator(cfg, model)
+
+    def eval_map(v) -> float:
+        return float(ev.evaluate(v, train_ds, batch_size=args.batch)["mAP"])
+
+    batches = quant.dataset_calibration_batches(
+        train_ds, batches=cfg.quant.calib_batches,
+        batch_size=cfg.quant.calib_batch_size,
+    )
+    artifact = quant.calibrate(model, variables, batches, cfg)
+    artifact = quant.sweep(
+        model, variables, artifact, batches, cfg, eval_fn=eval_map
+    )
+    infer_vars = quant.build_infer_variables(
+        quant.quantize_variables(variables, artifact), cfg
+    )
+    f32_map = eval_map(variables)
+    int8_map = eval_map(infer_vars)
+    leg = {
+        "f32_mAP": f32_map,
+        "int8_mAP": int8_map,
+        "map_drop_pt": round(100.0 * (f32_map - int8_map), 4),
+        "plan": dict(artifact["plan"]),
+        "recon_rel_err": {
+            g: s["recon_rel_err"]
+            for g, s in artifact.get("sensitivity", {}).items()
+            if "recon_rel_err" in s
+        },
+    }
+    print(f"leg quant: {json.dumps(leg)}", flush=True)
+    return leg
 
 
 def _mini_config(args, buckets=(), sampling="random"):
@@ -416,6 +486,7 @@ def mini_main(args) -> int:
             "topk", _mini_config(args, sampling="topk_iou"), args
         ),
     }
+    quant_leg = _quant_leg(args)
     record = {
         "schema": 1,
         "config": "coco-format resnet18@64 mini A/B (num_classes=9): "
@@ -432,6 +503,7 @@ def mini_main(args) -> int:
         "bucket_programs": expected_bucket_programs(),
         "missing_bucket_programs": missing,
         "legs": legs,
+        "quant": quant_leg,
     }
 
     if args.update:

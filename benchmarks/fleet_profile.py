@@ -36,6 +36,15 @@ Measured legs:
   * hedge    — a fast/slow replica pair under tight hedge clamps: the
     p99-derived hedge must fire and win at least once (tail tolerance
     failover alone cannot see).
+  * mixed    — the dtype-heterogeneous fleet a quantized rollout
+    creates: one int8 replica beside two bf16 replicas.  The router
+    must hold the availability floor over the full load, the int8
+    replica must actually serve traffic, and each replica's resident
+    params dtype must be observable both in ``router.snapshot()`` (the
+    /stats "registry" view, fed by /healthz probes) and as the
+    ``fleet_replica_params_dtype`` info gauge in the Prometheus
+    /metrics exposition — the ISSUE 17 observability contract: you can
+    always tell which replicas serve quantized weights.
 
 Banked under benchmarks/records/ (step_profile.py conventions: atomic
 save, --update to re-bank, --no-check to just measure). The gate fails
@@ -65,8 +74,8 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 RECORDS_DIR = os.path.join(_REPO, "benchmarks", "records")
-# v2: adds the slo burn-rate leg + merged-trace failover evidence
-SCHEMA = "fleet_profile/v2"
+# v3: adds the mixed-precision (int8 + bf16) dtype-observability leg
+SCHEMA = "fleet_profile/v3"
 DEFAULT_TOL = 0.25  # sleep-paced throughput is steadier than compute,
 #                     but the CI host still jitters thread wakeups
 DEFAULT_MIN_SPEEDUP = 2.0
@@ -190,6 +199,33 @@ def check_regression(
                 f"(burn short={slo.get('burn_after_rejoin', {}).get('short')} "
                 f"long={slo.get('burn_after_rejoin', {}).get('long')})"
             )
+    # mixed-precision leg: availability floor, dtype observability on
+    # both surfaces, and the int8 replica genuinely in rotation
+    mixed = current.get("mixed") or {}
+    if mixed:
+        mixed_avail = mixed.get("availability")
+        if mixed_avail is not None and mixed_avail < min_availability:
+            failures.append(
+                f"mixed: availability {mixed_avail:.4%} below the "
+                f"{min_availability:.2%} floor with an int8 replica in "
+                "rotation"
+            )
+        dtypes = set((mixed.get("replica_dtypes") or {}).values())
+        if not {"int8", "bfloat16"} <= dtypes:
+            failures.append(
+                "mixed: registry snapshot does not report both int8 and "
+                f"bfloat16 replica dtypes (got {sorted(map(str, dtypes))})"
+            )
+        if not mixed.get("metrics_dtype_gauge"):
+            failures.append(
+                "mixed: fleet_replica_params_dtype info gauge missing "
+                "from the Prometheus exposition"
+            )
+        if mixed.get("int8_requests_ok", 0) < 1:
+            failures.append(
+                "mixed: the int8 replica served no successful request — "
+                "it never entered rotation"
+            )
     # tracing: the merged Chrome trace must show one failed-over request
     # whose attempt spans touch >= 2 replicas under a single trace id
     if current.get("trace_failover_evidence") is False:
@@ -204,11 +240,16 @@ def check_regression(
 # simulated replicas
 
 
-def make_sim_replica(replica_id: str, service_s: float):
+def make_sim_replica(
+    replica_id: str, service_s: float, params_dtype: str = None
+):
     """A single-slot replica: capacity 1/service_s regardless of caller
     concurrency.  The slot is a virtual busy-until queue — arrival
     reserves the next free interval under the lock, then sleeps out its
-    own completion time outside it (never sleep while holding a lock)."""
+    own completion time outside it (never sleep while holding a lock).
+    ``params_dtype`` makes /healthz report a resident dtype the way a
+    real engine replica does — the registry tracks it and the router
+    exposes it (mixed leg)."""
     from replication_faster_rcnn_tpu.serving.fleet.client import (
         LocalReplicaClient,
     )
@@ -226,7 +267,12 @@ def make_sim_replica(replica_id: str, service_s: float):
             time.sleep(delay)
         return {"replica": replica_id, "payload": payload}
 
-    return LocalReplicaClient(replica_id, predict)
+    def health():
+        return {"ok": True, "params_dtype": params_dtype}
+
+    return LocalReplicaClient(
+        replica_id, predict, health if params_dtype is not None else None
+    )
 
 
 def build_fleet(clients, cfg):
@@ -418,6 +464,42 @@ def profile(
         prober.stop()
         router.close()
 
+    # -- mixed-precision leg: one int8 replica beside two bf16 replicas.
+    # No kill here — the fleet leg already prices self-healing; this leg
+    # prices the quantized-rollout contract: heterogeneous dtypes hold
+    # the availability floor, and every replica's resident dtype is
+    # observable in /stats (registry snapshot) and /metrics (the
+    # fleet_replica_params_dtype info gauge).
+    replica_dtypes_cfg = {"b0": "bfloat16", "b1": "bfloat16", "q0": "int8"}
+    clients = {
+        rid: make_sim_replica(rid, service_s, params_dtype=dt)
+        for rid, dt in replica_dtypes_cfg.items()
+    }
+    registry, prober, router = build_fleet(clients, cfg)
+    try:
+        mixed_run = loadgen.run_fleet_loop(
+            router.dispatch, requests, concurrency=concurrency
+        )
+        mixed_snap = router.snapshot()
+        mixed_prom = router.metrics.render_prometheus()
+    finally:
+        prober.stop()
+        router.close()
+    replica_dtypes = {
+        rid: info.get("params_dtype")
+        for rid, info in mixed_snap["registry"].items()
+    }
+    dtype_gauge_lines = sorted(
+        line
+        for line in mixed_prom.splitlines()
+        if line.startswith("fleet_replica_params_dtype{")
+    )
+    int8_ok = sum(
+        stats.get("ok", 0)
+        for rid, stats in mixed_snap["replicas"].items()
+        if replica_dtypes.get(rid) == "int8"
+    )
+
     speedup = (
         round(fleet["images_per_sec"] / single["images_per_sec"], 3)
         if single["images_per_sec"]
@@ -461,6 +543,14 @@ def profile(
             "availability": hedge_run["availability"],
             "hedges": hedge_stats["hedges"],
             "hedge_wins": hedge_stats["hedge_wins"],
+        },
+        "mixed": {
+            "availability": mixed_run["availability"],
+            "images_per_sec": mixed_run["images_per_sec"],
+            "replica_dtypes": replica_dtypes,
+            "int8_requests_ok": int(int8_ok),
+            "metrics_dtype_gauge": bool(dtype_gauge_lines),
+            "metrics_dtype_gauge_lines": dtype_gauge_lines,
         },
         "measured": True,
     }

@@ -95,6 +95,25 @@ def parse_custom_calls(stablehlo_text: str) -> Dict[str, int]:
     return dict(sorted(counts.items()))
 
 
+def parse_int8_ops(stablehlo_text: str) -> Dict[str, int]:
+    """{op_kind: count} of dot_general/convolution ops with an int8
+    operand in a lowered module.
+
+    The quantization provenance signal for hlolint's HX008: a
+    ``serve_*__int8`` program with true-int8 GEMMs must show i8 dots,
+    and NO other program may contain any — an i8 contraction outside the
+    quantized twins means quantized weights leaked into a program whose
+    numerics were never calibrated for them."""
+    counts: Dict[str, int] = {}
+    for line in stablehlo_text.splitlines():
+        if "xi8>" not in line:
+            continue
+        for kind in ("dot_general", "convolution"):
+            if f"stablehlo.{kind}" in line:
+                counts[kind] = counts.get(kind, 0) + 1
+    return dict(sorted(counts.items()))
+
+
 def module_hash(stablehlo_text: str) -> str:
     """sha256[:16] of the lowered module text — a whole-program identity
     cheap enough to bank. Interpret-mode pallas twins contain no custom
@@ -385,6 +404,7 @@ def fingerprint_program(spec) -> Dict[str, Any]:
         ),
         "has_f64": contains_f64(stablehlo),
         "custom_calls": parse_custom_calls(stablehlo),
+        "int8_ops": parse_int8_ops(stablehlo),
         "module_hash": module_hash(stablehlo),
         "cost": lowered_cost_analysis(lowered),
         "memory": memory_stats(compiled),
@@ -457,6 +477,9 @@ MEMORY_REL_TOL = 0.25
 # `custom_calls` / `module_hash` are likewise excluded: banks recorded
 # before those fields stay valid, and module text wobbles with the jax
 # version — the HX007 ops-backend rule asserts on the live values.
+# `int8_ops` follows the same pattern: the HX008 quantization-provenance
+# rule asserts on the live inventory, so pre-ISSUE-17 bank entries stay
+# bitwise valid.
 _EXACT_FIELDS = ("args", "params", "outputs", "aliasing", "collectives", "has_f64")
 
 

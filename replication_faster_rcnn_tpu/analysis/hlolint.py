@@ -35,6 +35,11 @@ HX007  ops-backend provenance: a backend=xla program must contain NO
        custom call exists to witness) the twin's ``module_hash`` must
        differ from its base's — the backend scope demonstrably changed
        the lowered program.
+HX008  quantization provenance: a ``serve_*__int8`` program whose plan
+       keeps the head dense layers int8 must lower true-int8
+       contractions (``stablehlo.dot_general`` over i8 operands), and NO
+       other program may contain an i8 dot/conv — quantized weights in
+       an uncalibrated program would be a silent numerics break.
 
 `frcnn audit` drives this (``--json``, ``--update`` to re-bank, nonzero
 exit on any violation); tests/test_hlolint.py gates a CPU subset in
@@ -57,6 +62,7 @@ HLO_RULES: Dict[str, str] = {
     "HX005": "fingerprint drift vs the banked record",
     "HX006": "program set does not match the expected bucket count / bank missing",
     "HX007": "ops-backend provenance: pallas custom-calls in an xla program, or a pallas twin indistinguishable from its base",
+    "HX008": "quantization provenance: int8 dot/conv missing from a quantized program, or present anywhere else",
 }
 
 # custom-call targets that witness a pallas lowering (Mosaic on TPU,
@@ -72,7 +78,8 @@ PALLAS_CALL_MARKERS = ("tpu_custom_call", "mosaic", "triton")
 # (train/warmup.py::pallas_twin_base_names: loader k=1, eval, one
 # serving bucket), plus the multi-scale TRAIN bucket programs
 # (audit_config's 2 train_resolutions × the loader/cached feeds × both
-# Ks = 8 more), 30 programs total
+# Ks = 8 more), plus the quantized serving twins (4 ``serve_*__int8``
+# bucket programs + 1 int8 pallas twin), 35 programs total
 AUDIT_FEEDS = ("loader", "cached", "spmd", "zero", "zero_lamb", "mp", "mp_zero")
 AUDIT_KS = (1, 2)
 AUDIT_BANK_NAME = "ci"
@@ -175,6 +182,7 @@ def expected_program_names(
     and the ops.backend=pallas twin programs are included."""
     from replication_faster_rcnn_tpu.train.warmup import (
         bucket_train_program_names,
+        int8_program_names,
         pallas_program_name,
         pallas_twin_base_names,
         program_name,
@@ -190,6 +198,7 @@ def expected_program_names(
         names.extend(
             pallas_program_name(b) for b in pallas_twin_base_names(config)
         )
+        names.extend(int8_program_names(config))
     return names
 
 
@@ -203,6 +212,7 @@ def collect_fingerprints(
     program on CPU; the contract/drift rules below are pure functions
     over the returned dicts."""
     from replication_faster_rcnn_tpu.train.warmup import (
+        build_int8_program_specs,
         build_pallas_program_specs,
         build_program_specs,
         build_serving_specs,
@@ -215,6 +225,7 @@ def collect_fingerprints(
         **specs,
         **build_serving_specs(config),
         **build_pallas_program_specs(config),
+        **build_int8_program_specs(config),
     }
     if programs is None:
         wanted = list(specs)
@@ -484,6 +495,37 @@ def check_contracts(
                             "— the backend scope changed nothing",
                         )
                     )
+
+        # HX008 — quantization provenance. Like HX007, applied only to
+        # records carrying the `int8_ops` field (live fingerprints and
+        # post-ISSUE-17 banks; older banked records skip the rule).
+        int8_ops = fp.get("int8_ops")
+        if int8_ops is not None:
+            meta = fp.get("meta", {})
+            n_int8 = sum(int8_ops.values())
+            if meta.get("params_dtype") == "int8" and meta.get("int8_dense"):
+                if not n_int8:
+                    out.append(
+                        Violation(
+                            "HX008",
+                            name,
+                            "no int8 dot_general/convolution in a quantized "
+                            "program whose plan keeps the head dense layers "
+                            "int8 — the QuantDense GEMMs were dequantized "
+                            "away before the contraction",
+                        )
+                    )
+            elif n_int8:
+                out.append(
+                    Violation(
+                        "HX008",
+                        name,
+                        f"int8 contraction ops {int8_ops} in a "
+                        f"params_dtype={meta.get('params_dtype', 'float32')!r} "
+                        "program — quantized weights leaked outside the "
+                        "serve_*__int8 twins",
+                    )
+                )
 
         # HX004 — memory budget
         mem = fp.get("memory")

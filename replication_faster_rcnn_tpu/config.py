@@ -732,9 +732,12 @@ class ServingConfig:
     # data/prefetch_device.py (submit blocks/raises rather than queueing
     # unboundedly while the device falls behind)
     queue_depth: int = 64
-    # dtype the resident inference params are cast to on upload; bf16
-    # halves HBM residency and the flax modules cast per-layer anyway
-    params_dtype: str = "bfloat16"  # float32 | bfloat16
+    # dtype the resident inference params are held in on upload. bf16
+    # halves HBM residency (the flax modules cast per-layer anyway);
+    # "int8" halves it again: planned layer groups stay device-resident
+    # as int8 weights + per-channel scales (quant/ sidecar artifact
+    # required, see `frcnn quantize`), the rest fall back to bf16
+    params_dtype: str = "bfloat16"  # float32 | bfloat16 | int8
     oversize: str = "downscale"  # downscale | reject
     # per-request deadline, end to end: the HTTP handler's future wait
     # times out to 504 after this many seconds, and an entry whose
@@ -783,9 +786,9 @@ class ServingConfig:
             raise ValueError(
                 f"serving.queue_depth must be >= 1, got {self.queue_depth}"
             )
-        if self.params_dtype not in ("float32", "bfloat16"):
+        if self.params_dtype not in ("float32", "bfloat16", "int8"):
             raise ValueError(
-                "serving.params_dtype must be float32|bfloat16, got "
+                "serving.params_dtype must be float32|bfloat16|int8, got "
                 f"{self.params_dtype!r}"
             )
         if self.oversize not in ("downscale", "reject"):
@@ -997,6 +1000,58 @@ class OpsConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class QuantConfig:
+    """Post-training int8 quantization (quant/, `frcnn quantize`).
+
+    Calibration collects per-channel symmetric int8 weight scales plus
+    per-layer-group activation ranges from a small sweep through the
+    Evaluator inference path, and writes them as a CRC-manifested
+    sidecar artifact next to the checkpoint. The optional sensitivity
+    sweep (`frcnn quantize --sweep`) quantizes one layer group at a
+    time, measures response-reconstruction error (arXiv:1806.00370) and
+    the mAP delta on a mini eval set, and records a per-group dtype
+    plan: groups whose solo-quantization cost exceeds the thresholds
+    fall back to bf16 at serve time instead of int8.
+    """
+
+    # sidecar artifact path used by `serving.params_dtype="int8"`; ""
+    # means "<checkpoint_dir>/quant_artifact.json" (the default written
+    # by `frcnn quantize`)
+    artifact: str = ""
+    # calibration sweep size: batches x batch_size images drawn in
+    # dataset order (deterministic — same order => bit-identical scales)
+    calib_batches: int = 2
+    calib_batch_size: int = 2
+    # sensitivity sweep fallback thresholds, per layer group: a group
+    # whose solo-int8 mAP drop exceeds `sensitivity_map_drop_pt` mAP
+    # points OR whose response-reconstruction relative error exceeds
+    # `sensitivity_recon_rel_err` is planned as bf16, not int8
+    sensitivity_map_drop_pt: float = 0.1
+    sensitivity_recon_rel_err: float = 0.25
+
+    def __post_init__(self):
+        if self.calib_batches < 1:
+            raise ValueError(
+                f"quant.calib_batches must be >= 1, got {self.calib_batches}"
+            )
+        if self.calib_batch_size < 1:
+            raise ValueError(
+                "quant.calib_batch_size must be >= 1, got "
+                f"{self.calib_batch_size}"
+            )
+        if self.sensitivity_map_drop_pt < 0:
+            raise ValueError(
+                "quant.sensitivity_map_drop_pt must be >= 0, got "
+                f"{self.sensitivity_map_drop_pt}"
+            )
+        if self.sensitivity_recon_rel_err <= 0:
+            raise ValueError(
+                "quant.sensitivity_recon_rel_err must be > 0, got "
+                f"{self.sensitivity_recon_rel_err}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class TelemetryConfig:
     """Observability layer knobs (telemetry/).
 
@@ -1055,6 +1110,7 @@ class FasterRCNNConfig:
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     elastic: ElasticConfig = dataclasses.field(default_factory=ElasticConfig)
     ops: OpsConfig = dataclasses.field(default_factory=OpsConfig)
+    quant: QuantConfig = dataclasses.field(default_factory=QuantConfig)
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig
     )

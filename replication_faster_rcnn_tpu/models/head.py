@@ -32,6 +32,46 @@ from replication_faster_rcnn_tpu.ops import roi_ops
 Array = jnp.ndarray
 
 
+class QuantDense(nn.Module):
+    """int8 twin of the cls/reg Dense: same param names/shapes ("kernel"
+    int8 [in, out], "bias" f32), computed as a true int8 GEMM through
+    `ops/quant_ops.py::quant_dense` with the calibrated activation scale.
+    Only ever instantiated when the serve path supplies a ``"quant"``
+    collection entry — the f32/bf16 trace never reaches this class, so
+    the fingerprint-banked programs are untouched."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x: Array, qinfo) -> Array:
+        from replication_faster_rcnn_tpu.ops import quant_ops
+
+        kernel = self.param(
+            "kernel",
+            lambda rng, shape: jnp.zeros(shape, jnp.int8),
+            (x.shape[-1], self.features),
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.features,), jnp.float32
+        )
+        return quant_ops.quant_dense(
+            x, kernel, qinfo["w_scale"], qinfo["x_scale"], bias
+        )
+
+
+def _head_dense(mod: nn.Module, x: Array, features: int, stddev: float, name: str) -> Array:
+    """cls/reg projection: the banked nn.Dense, or its QuantDense twin
+    when the caller passed quantization info for this layer."""
+    if mod.has_variable("quant", name):
+        return QuantDense(features, name=name)(x, mod.get_variable("quant", name))
+    return nn.Dense(
+        features,
+        kernel_init=nn.initializers.normal(stddev=stddev),
+        param_dtype=jnp.float32,
+        name=name,
+    )(x)
+
+
 class DetectionHead(nn.Module):
     """ROI extract + tail + cls/reg Linear heads.
 
@@ -95,18 +135,8 @@ class DetectionHead(nn.Module):
 
         # Paper-standard inits the reference leaves at torch defaults:
         # cls N(0, 0.01), reg N(0, 0.001).
-        cls = nn.Dense(
-            self.num_classes,
-            kernel_init=nn.initializers.normal(stddev=0.01),
-            param_dtype=jnp.float32,
-            name="cls",
-        )(embed)
-        reg = nn.Dense(
-            self.num_classes * 4,
-            kernel_init=nn.initializers.normal(stddev=0.001),
-            param_dtype=jnp.float32,
-            name="reg",
-        )(embed)
+        cls = _head_dense(self, embed, self.num_classes, 0.01, "cls")
+        reg = _head_dense(self, embed, self.num_classes * 4, 0.001, "reg")
         return cls.reshape(n, r, -1), reg.reshape(n, r, -1)
 
 
@@ -150,18 +180,8 @@ class FPNDetectionHead(nn.Module):
             nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=jnp.float32, name="fc7")(x)
         )
         x = x.astype(jnp.float32)  # cls/reg logits in f32
-        cls = nn.Dense(
-            self.num_classes,
-            kernel_init=nn.initializers.normal(stddev=0.01),
-            param_dtype=jnp.float32,
-            name="cls",
-        )(x)
-        reg = nn.Dense(
-            self.num_classes * 4,
-            kernel_init=nn.initializers.normal(stddev=0.001),
-            param_dtype=jnp.float32,
-            name="reg",
-        )(x)
+        cls = _head_dense(self, x, self.num_classes, 0.01, "cls")
+        reg = _head_dense(self, x, self.num_classes * 4, 0.001, "reg")
         return cls.reshape(n, r, -1), reg.reshape(n, r, -1)
 
 

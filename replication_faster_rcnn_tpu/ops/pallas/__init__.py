@@ -32,6 +32,10 @@ from replication_faster_rcnn_tpu.ops.pallas.iou_kernel import (  # noqa: F401
 from replication_faster_rcnn_tpu.ops.pallas.nms_kernel import (  # noqa: F401
     nms_fixed_pallas,
 )
+from replication_faster_rcnn_tpu.ops.pallas.quant_kernel import (  # noqa: F401
+    dequantize_pallas,
+    quant_matmul_pallas,
+)
 from replication_faster_rcnn_tpu.ops.pallas.roi_kernel import (  # noqa: F401
     roi_align_pallas,
 )

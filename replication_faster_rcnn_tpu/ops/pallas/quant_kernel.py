@@ -1,0 +1,148 @@
+"""Pallas int8 kernels for quantized serving (quant/, ISSUE 17).
+
+Two kernels back the ``ops.backend = "pallas"`` half of the quantized
+op pair in `ops/quant_ops.py`:
+
+  * :func:`quant_matmul_pallas` — tiled int8 x int8 -> int32 matmul.
+    Operands are blocked over (M, N) with the contraction axis resident
+    per block, and the product accumulates in int32 on the MXU
+    (``preferred_element_type=jnp.int32`` — int8 inputs otherwise
+    accumulate in int8 and wrap). Integer arithmetic has no rounding,
+    so the kernel is **bitwise** equal to the XLA reference
+    (`quant_ops.py::_int8_matmul_xla`) in both interpret mode and on
+    chip; tier-1 pins that equality.
+  * :func:`dequantize_pallas` — per-channel symmetric dequantize
+    ``w_q.astype(f32) * scale`` tiled over rows, the op the int8 serve
+    programs apply to conv weights on their way into the convolution.
+
+Both take ``interpret`` (default: interpret unless running on a real
+TPU backend) so the kernel code is parity-tested on CPU in tier-1, and
+both pad up to the int8 minimum tile (32, 128) — narrow head GEMMs
+([N*R, 512] x [512, classes]) are the expected shape, far below one
+natural MXU tile.
+
+On-chip compilation must only happen through the warmup ProgramSpec
+registry (`train/warmup.py::build_int8_program_specs`), never lazily.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+Array = jnp.ndarray
+
+# int8 minimum TPU tile (sublane, lane); also a sane CPU interpret block
+_MIN_TILE_M = 32
+_MIN_TILE_N = 128
+
+
+def _matmul_kernel(x_ref, w_ref, o_ref):
+    o_ref[...] = jax.lax.dot_general(
+        x_ref[...],
+        w_ref[...],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+@partial(jax.jit, static_argnames=("tile_m", "tile_n", "interpret"))
+def _quant_matmul(
+    x_q: Array, w_q: Array, tile_m: int, tile_n: int, interpret: bool
+) -> Array:
+    m, k = x_q.shape
+    k2, n = w_q.shape
+    assert k == k2, (k, k2)
+    mp = _round_up(max(m, 1), tile_m)
+    np_ = _round_up(max(n, 1), tile_n)
+    kp = _round_up(max(k, 1), _MIN_TILE_N)
+    x_p = jnp.pad(x_q, ((0, mp - m), (0, kp - k)))
+    w_p = jnp.pad(w_q, ((0, kp - k), (0, np_ - n)))
+    # zero padding contributes zero products: the valid [m, n] block of
+    # the padded product equals the unpadded product exactly
+    out = pl.pallas_call(
+        _matmul_kernel,
+        grid=(mp // tile_m, np_ // tile_n),
+        in_specs=[
+            pl.BlockSpec((tile_m, kp), lambda i, j: (i, 0)),
+            pl.BlockSpec((kp, tile_n), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((tile_m, tile_n), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.int32),
+        interpret=interpret,
+    )(x_p, w_p)
+    return out[:m, :n]
+
+
+def _dequant_kernel(w_ref, s_ref, o_ref):
+    o_ref[...] = w_ref[...].astype(jnp.float32) * s_ref[...]
+
+
+@partial(jax.jit, static_argnames=("tile_m", "interpret"))
+def _dequantize(w_q: Array, scale: Array, tile_m: int, interpret: bool) -> Array:
+    r, c = w_q.shape
+    rp = _round_up(max(r, 1), tile_m)
+    cp = _round_up(max(c, 1), _MIN_TILE_N)
+    w_p = jnp.pad(w_q, ((0, rp - r), (0, cp - c)))
+    s_p = jnp.pad(scale.astype(jnp.float32), (0, cp - c))[None, :]
+    out = pl.pallas_call(
+        _dequant_kernel,
+        grid=(rp // tile_m,),
+        in_specs=[
+            pl.BlockSpec((tile_m, cp), lambda i: (i, 0)),
+            pl.BlockSpec((1, cp), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile_m, cp), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rp, cp), jnp.float32),
+        interpret=interpret,
+    )(w_p, s_p)
+    return out[:r, :c]
+
+
+def _resolve_interpret(interpret: bool | None) -> bool:
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return bool(interpret)
+
+
+def quant_matmul_pallas(
+    x_q: Array,
+    w_q: Array,
+    tile_m: int = _MIN_TILE_M,
+    tile_n: int = _MIN_TILE_N,
+    interpret: bool | None = None,
+) -> Array:
+    """int8 ``x_q [M, K] @ w_q [K, N] -> int32 [M, N]``, int32-accumulated.
+
+    Bitwise equal to ``jax.lax.dot_general`` over the same int8 operands
+    with ``preferred_element_type=jnp.int32`` (integer arithmetic — no
+    rounding anywhere to drift).
+    """
+    if x_q.dtype != jnp.int8 or w_q.dtype != jnp.int8:
+        raise TypeError(
+            f"quant_matmul_pallas wants int8 operands, got "
+            f"{x_q.dtype}/{w_q.dtype}"
+        )
+    return _quant_matmul(x_q, w_q, tile_m, tile_n, _resolve_interpret(interpret))
+
+
+def dequantize_pallas(
+    w_q: Array,
+    scale: Array,
+    tile_m: int = _MIN_TILE_M,
+    interpret: bool | None = None,
+) -> Array:
+    """Per-channel dequantize: ``w_q.astype(f32) * scale`` with ``scale``
+    broadcast over the last axis. Arbitrary-rank weights are flattened to
+    ``[prod(leading), channels]`` for the kernel and reshaped back."""
+    shape = w_q.shape
+    w2 = w_q.reshape((-1, shape[-1]))
+    out = _dequantize(w2, scale, tile_m, _resolve_interpret(interpret))
+    return out.reshape(shape)

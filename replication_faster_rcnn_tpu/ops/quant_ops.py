@@ -1,0 +1,141 @@
+"""Quantized matmul/conv op pair behind the ``ops.backend`` seam.
+
+The int8 serve path (`serving.params_dtype = "int8"`) keeps planned
+weights device-resident as int8 plus per-channel symmetric scales and
+reconstitutes compute-dtype values on the way into each matmul/conv:
+
+  * :func:`quant_dense` — true int8 GEMM: the activation is quantized
+    against its calibrated range, the product runs int8 x int8 -> int32
+    (MXU-native on TPU), and the result is rescaled by
+    ``x_scale * w_scale``. This is the op the detection-head cls/reg
+    layers take (`models/head.py::QuantDense`) and the one HX008 audits
+    for int8 dot provenance.
+  * :func:`quant_conv` — weight-only quantization: per-channel
+    dequantize into the convolution. XLA:CPU has no usable int8
+    convolution (measured ~75x slower than f32), and on TPU the MXU
+    consumes the dequantized bf16/f32 operand directly, so the conv
+    itself stays in compute dtype while residency stays int8.
+  * :func:`dequantize` — the shared per-channel reconstruction.
+
+Backend dispatch follows `ops/__init__.py::want_pallas`: the ``xla``
+family is the correctness oracle (plain ``lax`` ops, the fingerprint
+banks pin its HLO), ``pallas`` routes through
+`ops/pallas/quant_kernel.py` (interpret-mode off-TPU). Integer
+arithmetic has no rounding, so the two int8 GEMM families are bitwise
+equal — tier-1 pins that (tests/test_quant.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from replication_faster_rcnn_tpu import ops as ops_dispatch
+
+Array = jnp.ndarray
+
+INT8_MAX = 127.0
+
+
+def quantize_channelwise(w: Array, eps: float = 1e-12) -> tuple[Array, Array]:
+    """Per-channel symmetric int8 quantization over the last axis.
+
+    Returns ``(w_q int8, scale f32 [channels])`` with
+    ``scale = max|w| / 127`` per output channel (all-but-last axes
+    reduced) — the jnp twin of the numpy calibration implementation in
+    `quant/calibrate.py` (which owns artifact determinism).
+    """
+    w = w.astype(jnp.float32)
+    reduce_axes = tuple(range(w.ndim - 1))
+    amax = jnp.max(jnp.abs(w), axis=reduce_axes)
+    scale = jnp.maximum(amax, eps) / INT8_MAX
+    w_q = jnp.clip(jnp.round(w / scale), -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return w_q, scale
+
+
+def quantize_activation(x: Array, x_scale: Array) -> Array:
+    """Symmetric int8 activation quantization against a calibrated scale."""
+    return jnp.clip(
+        jnp.round(x.astype(jnp.float32) / x_scale), -INT8_MAX, INT8_MAX
+    ).astype(jnp.int8)
+
+
+def _int8_matmul_xla(x_q: Array, w_q: Array) -> Array:
+    return jax.lax.dot_general(
+        x_q,
+        w_q,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+
+def int8_matmul(x_q: Array, w_q: Array, config=None) -> Array:
+    """int8 ``[M, K] @ [K, N] -> int32`` through the backend seam."""
+    if ops_dispatch.want_pallas("quant_matmul", config):
+        from replication_faster_rcnn_tpu.ops.pallas.quant_kernel import (
+            quant_matmul_pallas,
+        )
+
+        return quant_matmul_pallas(x_q, w_q)
+    return _int8_matmul_xla(x_q, w_q)
+
+
+def dequantize(w_q: Array, scale: Array, config=None) -> Array:
+    """Per-channel reconstruction ``w_q * scale`` (scale over last axis)."""
+    if ops_dispatch.want_pallas("quant_dequant", config):
+        from replication_faster_rcnn_tpu.ops.pallas.quant_kernel import (
+            dequantize_pallas,
+        )
+
+        return dequantize_pallas(w_q, scale)
+    return w_q.astype(jnp.float32) * scale.astype(jnp.float32)
+
+
+def quant_dense(
+    x: Array,
+    w_q: Array,
+    w_scale: Array,
+    x_scale: Array,
+    bias: Optional[Array] = None,
+    config=None,
+) -> Array:
+    """int8 dense layer: quantize ``x``, int8 GEMM, rescale, add bias.
+
+    ``x [..., K]`` (any float dtype), ``w_q [K, N] int8``,
+    ``w_scale [N]``, ``x_scale`` scalar (calibrated activation range /
+    127). Output is float32 ``[..., N]``.
+    """
+    lead = x.shape[:-1]
+    x2 = x.reshape((-1, x.shape[-1]))
+    x_q = quantize_activation(x2, x_scale)
+    y = int8_matmul(x_q, w_q, config).astype(jnp.float32)
+    y = y * (x_scale.astype(jnp.float32) * w_scale.astype(jnp.float32))[None, :]
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)[None, :]
+    return y.reshape(lead + (y.shape[-1],))
+
+
+def quant_conv(
+    x: Array,
+    w_q: Array,
+    w_scale: Array,
+    *,
+    window_strides=(1, 1),
+    padding="SAME",
+    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    feature_group_count: int = 1,
+    config=None,
+) -> Array:
+    """Weight-only quantized convolution: per-channel dequantize the
+    ``HWIO`` int8 kernel into the conv operand dtype, then convolve."""
+    w = dequantize(w_q, w_scale, config).astype(x.dtype)
+    return jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=window_strides,
+        padding=padding,
+        dimension_numbers=dimension_numbers,
+        feature_group_count=feature_group_count,
+    )

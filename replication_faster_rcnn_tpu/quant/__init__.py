@@ -1,0 +1,52 @@
+"""Post-training int8 quantization (PTQ) for serving — ISSUE 17.
+
+The subsystem has four pieces, mirroring the calibrate -> sweep -> serve
+workflow (`frcnn quantize`, then `frcnn serve --params-dtype int8`):
+
+* `calibrate.py` — per-channel symmetric int8 weight scales (numpy, so
+  the artifact is bit-identical across runs and thread counts) plus
+  activation ranges captured from a small calibration sweep through the
+  model's inference forward.
+* `sensitivity.py` — the arXiv:1806.00370 per-layer sweep: quantize one
+  layer group at a time (fake-quant), measure response-reconstruction
+  error and optionally the mAP delta on a mini eval set, and emit a
+  per-group dtype plan (int8 vs bf16 fallback).
+* `artifact.py` — the sidecar serialization next to the checkpoint:
+  JSON with per-entry CRC32s and an atomic tmp+rename write, the PR 3
+  checkpoint-manifest discipline applied to quantization state.
+* `apply.py` — turning (f32 variables + artifact) into the quantized
+  resident tree the serving engine uploads, and the in-program
+  reconstruction the `serve_*__int8` programs run through the
+  `ops/quant_ops.py` backend seam.
+"""
+
+from replication_faster_rcnn_tpu.quant.artifact import (  # noqa: F401
+    ARTIFACT_SCHEMA,
+    QuantArtifactError,
+    default_artifact_path,
+    load_artifact,
+    save_artifact,
+)
+from replication_faster_rcnn_tpu.quant.calibrate import (  # noqa: F401
+    EMBED_RANGE_KEY,
+    QUANT_DENSE_PATHS,
+    calibrate,
+    dataset_calibration_batches,
+    layer_group_of,
+    quantizable,
+    synthetic_calibration_batches,
+    weight_scales,
+)
+from replication_faster_rcnn_tpu.quant.apply import (  # noqa: F401
+    abstract_quantize_variables,
+    build_infer_variables,
+    fake_quant_variables,
+    quantize_variables,
+    quantized_params_bytes,
+    round_trip_errors,
+    synthetic_artifact,
+)
+from replication_faster_rcnn_tpu.quant.sensitivity import (  # noqa: F401
+    response_reconstruction_error,
+    sweep,
+)

@@ -1,0 +1,274 @@
+"""Quantized resident tree <-> in-program reconstruction.
+
+`quantize_variables` is the engine-side (host, once at startup) half:
+it turns f32 checkpoint variables + a calibration artifact into the
+tree the ServingEngine uploads — planned weights as int8, their
+per-channel scales alongside (device-resident, per the ISSUE 17
+contract), everything else cast to the compute dtype. The detection
+head's cls/reg kernels stay int8 *inside* the params tree and carry a
+``"quant"`` collection entry (w_scale + calibrated x_scale) so
+`models/head.py::QuantDense` runs them as true int8 GEMMs.
+
+`build_infer_variables` is the in-program (traced, per dispatch) half:
+every other int8 leaf is reconstructed on its way into the matmul/conv
+through `ops/quant_ops.py::dequantize` — the op behind the
+``ops.backend = xla|pallas`` seam, so the ``serve_*__int8`` and
+``serve_*__int8__pallas`` twin programs differ exactly in that kernel.
+
+`fake_quant_variables` is the sensitivity-sweep simulator: float
+variables with one layer group's weights replaced by their
+quantize->dequantize round trip, no serving machinery involved.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from replication_faster_rcnn_tpu.quant.calibrate import (
+    EMBED_RANGE_KEY,
+    QUANT_DENSE_PATHS,
+    embed_scale,
+    flatten_params,
+    group_paths,
+    layer_group_of,
+    path_key,
+    quantizable,
+    quantize_weight,
+)
+
+_DENSE_KEYS = {path_key(p) for p in QUANT_DENSE_PATHS}
+
+
+def _planned_int8(artifact: Dict[str, Any], path: Tuple[str, ...], leaf) -> bool:
+    if not quantizable(path, leaf):
+        return False
+    if path_key(path) not in artifact["weight_scales"]:
+        return False
+    return artifact["plan"].get(layer_group_of(path), "bfloat16") == "int8"
+
+
+def quantize_variables(
+    variables: Dict[str, Any],
+    artifact: Dict[str, Any],
+    compute_dtype: Any = None,
+) -> Dict[str, Any]:
+    """Build the quantized resident tree from f32 variables + artifact.
+
+    Returns ``{"params": ..., "qscales": {path: scale}, "quant": {...},
+    <other collections cast to compute_dtype>}``. ``compute_dtype``
+    defaults to bfloat16 — the fallback dtype of everything the plan
+    does not keep int8.
+    """
+    import jax.numpy as jnp
+
+    compute_dtype = compute_dtype or jnp.bfloat16
+    qscales: Dict[str, Any] = {}
+    dense_quant: Dict[str, Any] = {}
+    x_scale = np.float32(embed_scale(artifact["activation_ranges"]))
+
+    def walk(prefix: Tuple[str, ...], node: Any) -> Any:
+        if isinstance(node, dict):
+            return {k: walk(prefix + (str(k),), v) for k, v in node.items()}
+        key = path_key(prefix)
+        if _planned_int8(artifact, prefix, node):
+            scale = artifact["weight_scales"][key]
+            w_q = quantize_weight(np.asarray(node), scale)
+            if key in _DENSE_KEYS:
+                # head/cls/kernel -> quant collection entry at scope
+                # head/{cls,reg} consumed by QuantDense
+                name = prefix[-2]
+                dense_quant[name] = {
+                    "w_scale": jnp.asarray(scale),
+                    "x_scale": jnp.asarray(x_scale),
+                }
+            else:
+                qscales[key] = jnp.asarray(scale)
+            return jnp.asarray(w_q)
+        if np.dtype(getattr(node, "dtype", np.float32)).kind == "f":
+            return jnp.asarray(node, dtype=compute_dtype)
+        return jnp.asarray(node)
+
+    out: Dict[str, Any] = {}
+    for collection, tree in variables.items():
+        if collection == "params":
+            out["params"] = walk((), tree)
+        else:
+            out[collection] = walk((collection, "!"), tree)
+    out["qscales"] = qscales
+    if dense_quant:
+        out["quant"] = {"head": dense_quant}
+    return out
+
+
+def build_infer_variables(
+    qvars: Dict[str, Any], config=None, compute_dtype: Any = None
+) -> Dict[str, Any]:
+    """In-program reconstruction: dequantize every int8 leaf except the
+    QuantDense kernels, yielding the variables dict ``model.apply``
+    consumes (including the pass-through ``"quant"`` collection).
+
+    ``compute_dtype`` is the dtype the forward actually runs in —
+    ``config.model.compute_dtype`` when a config is given (bfloat16
+    otherwise). Residency and compute are deliberately decoupled: the
+    resident tree stays int8 + bf16 (that's the memory claim), while
+    the traced reconstruction both dequantizes the int8 leaves and
+    upcasts the bf16 fallback leaves into the compute dtype. On
+    XLA:CPU, whose bf16 conv/dot lowerings are several times slower
+    than f32, serving a compute_dtype=float32 model any other way
+    would burn the entire quantization win on slow bf16 math."""
+    import jax.numpy as jnp
+
+    from replication_faster_rcnn_tpu.ops import quant_ops
+
+    if compute_dtype is None:
+        compute_dtype = (
+            jnp.dtype(config.model.compute_dtype)
+            if config is not None
+            else jnp.bfloat16
+        )
+    qscales = qvars.get("qscales", {})
+
+    def walk(prefix: Tuple[str, ...], node: Any) -> Any:
+        if isinstance(node, dict):
+            return {k: walk(prefix + (str(k),), v) for k, v in node.items()}
+        key = path_key(prefix)
+        if node.dtype == jnp.int8 and key not in _DENSE_KEYS:
+            return quant_ops.dequantize(node, qscales[key], config).astype(
+                compute_dtype
+            )
+        if jnp.issubdtype(node.dtype, jnp.floating):
+            return node.astype(compute_dtype)
+        return node
+
+    out = {"params": walk((), qvars["params"])}
+    for collection, tree in qvars.items():
+        if collection in ("params", "qscales", "quant"):
+            continue
+        out[collection] = walk((collection, "!"), tree)
+    out["quant"] = qvars.get("quant")
+    if out["quant"] is None:
+        del out["quant"]
+    return out
+
+
+def fake_quant_variables(
+    variables: Dict[str, Any],
+    scales: Dict[str, np.ndarray],
+    paths: List[str],
+) -> Dict[str, Any]:
+    """Float variables with the given param paths' weights replaced by
+    their int8 quantize->dequantize round trip (sensitivity sweep)."""
+    import jax.numpy as jnp
+
+    wanted = set(paths)
+
+    def walk(prefix: Tuple[str, ...], node: Any) -> Any:
+        if isinstance(node, dict):
+            return {k: walk(prefix + (str(k),), v) for k, v in node.items()}
+        key = path_key(prefix)
+        if key in wanted:
+            scale = scales[key].astype(np.float32)
+            w_q = quantize_weight(np.asarray(node), scale)
+            return jnp.asarray(w_q.astype(np.float32) * scale)
+        return node
+
+    out = dict(variables)
+    out["params"] = walk((), variables["params"])
+    return out
+
+
+def round_trip_errors(
+    params: Dict[str, Any], scales: Dict[str, np.ndarray]
+) -> Dict[str, float]:
+    """Per-path max-abs quantize->dequantize error relative to the
+    channel scale (<= 0.5 by construction of round-to-nearest; pinned
+    in tier-1)."""
+    errors: Dict[str, float] = {}
+    for path, leaf in flatten_params(params):
+        key = path_key(path)
+        if key not in scales:
+            continue
+        w = np.asarray(leaf, dtype=np.float32)
+        scale = scales[key].astype(np.float32)
+        w_rt = quantize_weight(w, scale).astype(np.float32) * scale
+        errors[key] = float(np.max(np.abs(w - w_rt) / scale))
+    return errors
+
+
+def synthetic_artifact(variables_abs: Dict[str, Any]) -> Dict[str, Any]:
+    """A structure-only artifact (unit scales, all-int8 plan) for AOT
+    lowering when no calibration ran — the audit/warmup registry builds
+    the ``serve_*__int8`` programs' abstract inputs from it. Never used
+    to serve real traffic (the engine demands a real sidecar)."""
+    params = variables_abs["params"]
+    scales = {
+        path_key(path): np.full(
+            (leaf.shape[-1],), 1.0 / 127.0, dtype=np.float32
+        )
+        for path, leaf in flatten_params(params)
+        if quantizable(path, leaf)
+    }
+    groups = group_paths(params)
+    return {
+        "weight_scales": scales,
+        "activation_ranges": {EMBED_RANGE_KEY: 127.0},
+        "groups": groups,
+        "plan": {g: "int8" for g in groups},
+        "calib": {"batches": 0, "batch_size": 0, "synthetic": True},
+    }
+
+
+def abstract_quantize_variables(
+    variables_abs: Dict[str, Any],
+    artifact: Dict[str, Any],
+    compute_dtype: Any = None,
+) -> Dict[str, Any]:
+    """`quantize_variables` over ``jax.ShapeDtypeStruct`` leaves: the
+    abstract qvars tree the warmup registry lowers the int8 serving
+    programs against (same structure, no values)."""
+    import jax
+    import jax.numpy as jnp
+
+    compute_dtype = np.dtype(compute_dtype or jnp.bfloat16)
+    qscales: Dict[str, Any] = {}
+    dense_quant: Dict[str, Any] = {}
+
+    def walk(prefix: Tuple[str, ...], node: Any) -> Any:
+        if isinstance(node, dict):
+            return {k: walk(prefix + (str(k),), v) for k, v in node.items()}
+        key = path_key(prefix)
+        if _planned_int8(artifact, prefix, node):
+            out_ch = node.shape[-1]
+            if key in _DENSE_KEYS:
+                dense_quant[prefix[-2]] = {
+                    "w_scale": jax.ShapeDtypeStruct((out_ch,), np.float32),
+                    "x_scale": jax.ShapeDtypeStruct((), np.float32),
+                }
+            else:
+                qscales[key] = jax.ShapeDtypeStruct((out_ch,), np.float32)
+            return jax.ShapeDtypeStruct(node.shape, np.int8)
+        if np.issubdtype(node.dtype, np.floating):
+            return jax.ShapeDtypeStruct(node.shape, compute_dtype)
+        return node
+
+    out: Dict[str, Any] = {}
+    for collection, tree in variables_abs.items():
+        if collection == "params":
+            out["params"] = walk((), tree)
+        else:
+            out[collection] = walk((collection, "!"), tree)
+    out["qscales"] = qscales
+    if dense_quant:
+        out["quant"] = {"head": dense_quant}
+    return out
+
+
+def quantized_params_bytes(qvars: Dict[str, Any]) -> int:
+    """Total bytes of the resident quantized tree (weights + scales)."""
+    import jax
+
+    return int(
+        sum(x.nbytes for x in jax.tree_util.tree_leaves(qvars))
+    )

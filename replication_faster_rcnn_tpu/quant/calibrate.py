@@ -1,0 +1,231 @@
+"""PTQ calibration: weight scales + activation ranges.
+
+Weight scales are per-channel symmetric over the last (output-channel)
+axis — ``scale = max|w| / 127`` — computed in **numpy** over the
+checkpoint leaves. Every reduction in this file is an abs-max, which is
+exactly associative and commutative in IEEE arithmetic, so the scales
+(and therefore the artifact bytes) are bit-identical no matter how the
+work is chunked or threaded; tests/test_quant.py pins that across runs
+and across a thread-pool split.
+
+Activation ranges come from a small calibration sweep: the full
+inference forward over a handful of batches with
+``capture_intermediates`` filtered to the module whose output feeds the
+detection-head cls/reg GEMMs (the ResNet/VGG ``tail``, or ``fc6``/
+``fc7`` for the FPN two-fc head). Those ranges become the static
+``x_scale`` of `ops/quant_ops.py::quant_dense` — the true-int8 GEMMs in
+the serve program.
+
+Layer groups follow the ISSUE 17 / arXiv:1806.00370 granularity:
+backbone conv blocks (``trunk.stem``, ``trunk.layer1`` ...), FPN
+laterals (``neck``), RPN head (``rpn``), detection head (``head``) —
+each independently quantizable so the sensitivity sweep can fall a
+single group back to bf16.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+INT8_MAX = 127.0
+SCALE_EPS = 1e-12
+
+# param paths (under "params") routed through QuantDense / quant_dense —
+# true int8 GEMMs with activation quantization, not weight-only dequant
+QUANT_DENSE_PATHS = (
+    ("head", "cls", "kernel"),
+    ("head", "reg", "kernel"),
+)
+
+# activation_ranges key for the cls/reg input (the head embedding)
+EMBED_RANGE_KEY = "head.embed"
+
+
+def layer_group_of(path: Tuple[str, ...]) -> str:
+    """Map a param path (under the "params" collection) to its layer group.
+
+    ("trunk", "layer2.1", "conv1", "kernel") -> "trunk.layer2"
+    ("trunk", "conv1", "kernel")             -> "trunk.stem"
+    ("neck", ...) / ("rpn", ...) / ("head", ...) -> that subsystem.
+    """
+    top = path[0]
+    if top == "trunk":
+        if len(path) < 3:
+            return "trunk.stem"
+        block = path[1].split(".")[0]
+        return f"trunk.{block}" if block.startswith("layer") else "trunk.stem"
+    return top
+
+
+def quantizable(path: Tuple[str, ...], leaf: Any) -> bool:
+    """int8-eligible leaves: float weight tensors of rank >= 2 (conv and
+    dense kernels). Biases and norm scales/offsets stay in bf16."""
+    dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+    return getattr(leaf, "ndim", 0) >= 2 and dtype.kind == "f"
+
+
+def flatten_params(params: Dict[str, Any]) -> List[Tuple[Tuple[str, ...], Any]]:
+    """Deterministic (sorted) flattening of a nested params dict."""
+    out: List[Tuple[Tuple[str, ...], Any]] = []
+
+    def walk(prefix: Tuple[str, ...], node: Any) -> None:
+        if isinstance(node, dict):
+            for k in sorted(node):
+                walk(prefix + (str(k),), node[k])
+        else:
+            out.append((prefix, node))
+
+    walk((), params)
+    return out
+
+
+def path_key(path: Sequence[str]) -> str:
+    return "/".join(path)
+
+
+def channel_scale(w: np.ndarray) -> np.ndarray:
+    """Per-output-channel symmetric scale: ``max|w| / 127`` over all but
+    the last axis (order-invariant — abs-max is exactly associative)."""
+    w = np.asarray(w, dtype=np.float32)
+    amax = np.max(np.abs(w), axis=tuple(range(w.ndim - 1)))
+    return (np.maximum(amax, SCALE_EPS) / INT8_MAX).astype(np.float32)
+
+
+def weight_scales(params: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """All quantizable leaves' per-channel scales, keyed by param path."""
+    scales: Dict[str, np.ndarray] = {}
+    for path, leaf in flatten_params(params):
+        if quantizable(path, leaf):
+            scales[path_key(path)] = channel_scale(np.asarray(leaf))
+    return scales
+
+
+def quantize_weight(w: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """Symmetric round-to-nearest int8 against a per-channel scale."""
+    w = np.asarray(w, dtype=np.float32)
+    q = np.rint(w / scale.astype(np.float32))
+    return np.clip(q, -INT8_MAX, INT8_MAX).astype(np.int8)
+
+
+def _embed_capture_filter(mdl, method_name: str) -> bool:
+    return method_name == "__call__" and mdl.name in ("tail", "fc6", "fc7")
+
+
+def _leaf_arrays(tree: Any) -> List[np.ndarray]:
+    import jax
+
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def activation_ranges(model, variables, batches: Sequence[Any]) -> Dict[str, float]:
+    """Run the calibration sweep, returning abs-max activation ranges.
+
+    ``batches`` is a sequence of image arrays exactly as the engine feeds
+    them (NHWC, preprocessed upstream of the model's own normalize).
+    Captures the output of the module feeding the cls/reg GEMMs: the
+    ``tail`` for single-scale heads, ``fc7`` for the FPN two-fc head
+    (whose relu the head applies before cls/reg — folded in here).
+    """
+    import jax.numpy as jnp
+
+    amax = 0.0
+    for images in batches:
+        _, inter = model.apply(
+            variables,
+            jnp.asarray(images),
+            train=False,
+            capture_intermediates=_embed_capture_filter,
+        )
+        tree = inter.get("intermediates", inter).get("head", {})
+        # prefer fc7 (FPN) over tail: fc7's relu-ed output is the GEMM input
+        feeder = tree.get("fc7") or tree.get("tail")
+        if feeder is None:
+            raise ValueError(
+                "calibration captured no head tail/fc7 intermediates; "
+                f"got keys {sorted(tree)}"
+            )
+        for arr in _leaf_arrays(feeder):
+            a = np.asarray(arr, dtype=np.float32)
+            if "fc7" in tree and tree.get("fc7") is feeder:
+                a = np.maximum(a, 0.0)  # head applies relu before cls/reg
+            batch_max = float(np.max(np.abs(a)))
+            amax = max(amax, batch_max)
+    return {EMBED_RANGE_KEY: amax}
+
+
+def embed_scale(ranges: Dict[str, float]) -> float:
+    """The quant_dense x_scale derived from the calibrated embed range."""
+    return max(ranges[EMBED_RANGE_KEY], SCALE_EPS) / INT8_MAX
+
+
+def group_paths(params: Dict[str, Any]) -> Dict[str, List[str]]:
+    """group name -> sorted quantizable param paths in that group."""
+    groups: Dict[str, List[str]] = {}
+    for path, leaf in flatten_params(params):
+        if quantizable(path, leaf):
+            groups.setdefault(layer_group_of(path), []).append(path_key(path))
+    return {g: sorted(ps) for g, ps in sorted(groups.items())}
+
+
+def synthetic_calibration_batches(
+    config, batches: int, batch_size: int, seed: int = 0
+) -> List[np.ndarray]:
+    """Deterministic synthetic calibration images (uniform [0, 255) f32,
+    the scale the data pipeline's normalize expects) for environments
+    without a dataset on disk — tests and the CPU bench host."""
+    h, w = config.data.image_size
+    rng = np.random.RandomState(seed)
+    return [
+        rng.uniform(0.0, 255.0, size=(batch_size, h, w, 3)).astype(np.float32)
+        for _ in range(batches)
+    ]
+
+
+def dataset_calibration_batches(
+    dataset, batches: int, batch_size: int
+) -> List[np.ndarray]:
+    """Calibration batches drawn from a map-style dataset's normalized
+    ``"image"`` samples, in index order (deterministic — wrap-around when
+    the dataset is smaller than the sweep)."""
+    n = len(dataset)
+    out = []
+    idx = 0
+    for _ in range(batches):
+        imgs = [
+            np.asarray(dataset[(idx + j) % n]["image"], dtype=np.float32)
+            for j in range(batch_size)
+        ]
+        idx += batch_size
+        out.append(np.stack(imgs))
+    return out
+
+
+def calibrate(
+    model,
+    variables,
+    batches: Sequence[Any],
+    config=None,
+) -> Dict[str, Any]:
+    """The full calibration pass -> an (unplanned) artifact dict.
+
+    Weight scales for every quantizable leaf, activation ranges from the
+    sweep, layer-group membership, and an all-int8 default plan the
+    sensitivity sweep may later demote per group.
+    """
+    params = variables["params"]
+    scales = weight_scales(params)
+    groups = group_paths(params)
+    ranges = activation_ranges(model, variables, batches)
+    plan = {g: "int8" for g in groups}
+    return {
+        "weight_scales": scales,
+        "activation_ranges": ranges,
+        "groups": groups,
+        "plan": plan,
+        "calib": {
+            "batches": len(batches),
+            "batch_size": int(np.asarray(batches[0]).shape[0]) if batches else 0,
+        },
+    }

@@ -84,6 +84,17 @@ class OversizedImageError(ValueError):
     """Request larger than every bucket under ``serving.oversize="reject"``."""
 
 
+def _plain_dicts(tree: Any) -> Any:
+    """Recursively coerce Mapping containers (FrozenDict from some
+    restore paths) to plain dicts — the `quant/apply.py` walkers key on
+    ``dict``."""
+    from collections.abc import Mapping
+
+    if isinstance(tree, Mapping):
+        return {k: _plain_dicts(v) for k, v in tree.items()}
+    return tree
+
+
 def select_bucket(
     resolutions: Sequence[Tuple[int, int]],
     orig_h: int,
@@ -124,6 +135,7 @@ class InferenceEngine:
         model=None,
         variables: Any = None,
         warmup: bool = False,
+        artifact_path: Optional[str] = None,
     ) -> None:
         from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
         from replication_faster_rcnn_tpu.train.warmup import (
@@ -137,8 +149,42 @@ class InferenceEngine:
         self.model = model if model is not None else FasterRCNN(config)
         self.buckets = config.serving.bucket_resolutions(config.data.image_size)
         self.batch_sizes = tuple(sorted(set(config.serving.batch_sizes)))
-        self._specs = build_serving_specs(config, model=self.model)
-        self._serve_name = serve_program_name
+        self.params_dtype = config.serving.params_dtype
+        self.quant_artifact_path: Optional[str] = None
+
+        if self.params_dtype == "int8":
+            # Quantized residency: the sidecar artifact (CRC-verified;
+            # `frcnn quantize` writes it) drives per-layer int8 vs bf16,
+            # the resident tree is the quantized one (weights + scales,
+            # ~4x smaller than f32), and every bucket dispatches its
+            # ``serve_*__int8`` twin program — which reconstructs bf16
+            # weights in-program through the ops backend seam and runs
+            # the head cls/reg kernels as true int8 GEMMs.
+            from replication_faster_rcnn_tpu.quant import (
+                default_artifact_path,
+                load_artifact,
+                quantize_variables,
+            )
+            from replication_faster_rcnn_tpu.train.warmup import (
+                build_int8_program_specs,
+                int8_program_name,
+            )
+
+            self.quant_artifact_path = artifact_path or default_artifact_path(
+                config
+            )
+            artifact = load_artifact(self.quant_artifact_path)
+            self._specs = build_int8_program_specs(
+                config, model=self.model, artifact=artifact
+            )
+            self._serve_name = lambda h, w, n: int8_program_name(
+                serve_program_name(h, w, n)
+            )
+            resident = quantize_variables(_plain_dicts(variables), artifact)
+        else:
+            self._specs = build_serving_specs(config, model=self.model)
+            self._serve_name = serve_program_name
+            resident = variables
 
         # Resident inference state: cast float leaves to the serving dtype
         # (the same rule build_serving_specs applies to the abstract
@@ -146,12 +192,14 @@ class InferenceEngine:
         # checkpoint's tree structure to the registry's (dict vs FrozenDict
         # containers differ across restore paths; the leaves are what
         # matters) and upload once — explicitly, so a strict-mode transfer
-        # guard engaged around serving never sees this as implicit.
+        # guard engaged around serving never sees this as implicit. The
+        # int8 tree is already built against the artifact's plan; the same
+        # leaf walk then only validates structure against the program.
         _, abs_args = self._specs[
-            serve_program_name(*self.buckets[0], self.batch_sizes[0])
+            self._serve_name(*self.buckets[0], self.batch_sizes[0])
         ].build()
         abs_leaves, abs_treedef = jax.tree_util.tree_flatten(abs_args[0])
-        leaves = jax.tree_util.tree_leaves(variables)
+        leaves = jax.tree_util.tree_leaves(resident)
         if len(leaves) != len(abs_leaves):
             raise ValueError(
                 f"variables have {len(leaves)} leaves; the serving program "
@@ -166,6 +214,11 @@ class InferenceEngine:
         ]
         self._variables = jax.device_put(
             jax.tree_util.tree_unflatten(abs_treedef, cast)
+        )
+        # what actually sits on the device for this model (weights +
+        # scales in int8 mode) — the /stats `params_bytes` contract
+        self.params_bytes = int(
+            sum(x.nbytes for x in jax.tree_util.tree_leaves(self._variables))
         )
 
         self._programs: Dict[str, Any] = {}
@@ -212,7 +265,7 @@ class InferenceEngine:
         if warmup:
             for h, w in self.buckets:
                 for n in self.batch_sizes:
-                    self._program(serve_program_name(h, w, n))
+                    self._program(self._serve_name(h, w, n))
         # SLO-driven deadlines (serving.adaptive_delay): the controller
         # owns per-bucket max_delay and learns from the batcher's flush
         # wait stats; otherwise the static max_delay_ms knob applies.
@@ -262,6 +315,11 @@ class InferenceEngine:
         self.metrics.gauge(
             "serve_queue_depth", help="requests waiting in the batch queue"
         ).set(self.queue_depth())
+        self.metrics.gauge(
+            "serve_params_bytes",
+            help="bytes of the device-resident model (weights + scales)",
+            params_dtype=self.params_dtype,
+        ).set(self.params_bytes)
         self.metrics.gauge(
             "serve_uptime_seconds", help="seconds since engine construction"
         ).set(self.uptime_s())

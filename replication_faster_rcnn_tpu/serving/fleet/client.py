@@ -111,6 +111,8 @@ def engine_client(replica_id: str, engine) -> LocalReplicaClient:
             "degraded_reason": engine.degraded_reason,
             "uptime_s": engine.uptime_s(),
             "bucket_queue_depths": engine.bucket_queue_depths(),
+            "params_dtype": engine.params_dtype,
+            "params_bytes": engine.params_bytes,
         }
 
     return LocalReplicaClient(replica_id, _predict, _health)

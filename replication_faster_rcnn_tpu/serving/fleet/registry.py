@@ -78,6 +78,10 @@ class Replica:
         self.probes = 0
         self.failed_probes = 0
         self.detail: Optional[str] = None  # why it is out of rotation
+        # resident params dtype the replica last reported via /healthz
+        # (float32 | bfloat16 | int8) — mixed-precision fleets surface
+        # it per replica in /stats and /metrics
+        self.params_dtype: Optional[str] = None
 
 
 class ReplicaRegistry:
@@ -137,19 +141,24 @@ class ReplicaRegistry:
         timeout = self._config.probe_interval_s
         for replica_id, client in targets:
             ok, draining, degraded, detail = False, False, False, None
+            params_dtype = None
             try:
                 failpoints.fire("router.probe", replica=replica_id)
                 health = client.healthz(timeout_s=timeout)
                 ok = bool(health.get("ok", False))
                 draining = bool(health.get("draining", False))
                 degraded = bool(health.get("degraded", False))
+                params_dtype = health.get("params_dtype")
                 if degraded:
                     detail = health.get("degraded_reason") or "degraded"
                 elif draining:
                     detail = "draining"
             except Exception as e:  # noqa: BLE001 - a failed probe is data
                 detail = f"probe failed: {type(e).__name__}: {e}"
-            self._note_probe(replica_id, ok, draining, degraded, detail)
+            self._note_probe(
+                replica_id, ok, draining, degraded, detail,
+                params_dtype=params_dtype,
+            )
 
     def _note_probe(
         self,
@@ -158,6 +167,7 @@ class ReplicaRegistry:
         draining: bool,
         degraded: bool,
         detail: Optional[str],
+        params_dtype: Optional[str] = None,
     ) -> None:
         now = self._clock()
         with self._lock:
@@ -166,6 +176,10 @@ class ReplicaRegistry:
                 return  # removed while we probed
             rep.probes += 1
             rep.detail = detail
+            if params_dtype is not None:
+                # keep the last reported dtype across failed probes — a
+                # dead replica's residency does not change by dying
+                rep.params_dtype = str(params_dtype)
             if not ok:
                 rep.failed_probes += 1
                 rep.consecutive_ok = 0
@@ -279,6 +293,7 @@ class ReplicaRegistry:
                     "consecutive_ok": rep.consecutive_ok,
                     "lease_age_s": round(self._clock() - rep.last_ok, 3),
                     "detail": rep.detail,
+                    "params_dtype": rep.params_dtype,
                 }
                 for rep in self._replicas.values()
             }

@@ -266,6 +266,17 @@ class FleetRouter:
                 help="error-budget burn rate per window",
                 window=rates_name,
             ).set(burn)
+        # info-style gauge: mixed-precision fleets expose each replica's
+        # reported residency dtype as a label (value is always 1)
+        for rid, info in self._registry.snapshot().items():
+            dtype = info.get("params_dtype")
+            if dtype:
+                self.metrics.gauge(
+                    "fleet_replica_params_dtype",
+                    help="replica resident params dtype (info gauge)",
+                    replica=rid,
+                    params_dtype=dtype,
+                ).set(1)
 
     def _replica_counter(self, replica_id: str, outcome: str):
         return self.metrics.counter(

@@ -116,6 +116,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "bucket_queue_depths": engine.bucket_queue_depths(),
                     "buckets": [list(b) for b in engine.buckets],
                     "batch_sizes": list(engine.batch_sizes),
+                    "params_dtype": engine.params_dtype,
+                    "params_bytes": engine.params_bytes,
                 },
             )
         elif self.path == "/stats":
@@ -124,6 +126,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "queue_depth": engine.queue_depth(),
                 "bucket_queue_depths": engine.bucket_queue_depths(),
                 "compile_seconds": dict(engine.compile_seconds),
+                "params_dtype": engine.params_dtype,
+                "params_bytes": engine.params_bytes,
                 "slo": engine.slo.snapshot(),
             }
             if engine.deadline_controller is not None:

@@ -321,6 +321,150 @@ def build_serving_specs(
     return specs
 
 
+INT8_TWIN_SUFFIX = "__int8"
+
+
+def int8_program_name(base: str) -> str:
+    """Registry name of the serving.params_dtype=int8 twin of a serve
+    bucket program."""
+    return base + INT8_TWIN_SUFFIX
+
+
+def int8_serving_program_names(config: FasterRCNNConfig) -> Tuple[str, ...]:
+    """Every int8 serving bucket program the config's engine would
+    compile under ``serving.params_dtype="int8"``."""
+    return tuple(
+        int8_program_name(base) for base in serving_program_names(config)
+    )
+
+
+def int8_program_names(config: FasterRCNNConfig) -> Tuple[str, ...]:
+    """The full int8 registry name set `build_int8_program_specs` emits:
+    every serving bucket program's int8 twin plus the one
+    ops.backend=pallas int8 twin (largest bucket, smallest batch) —
+    pure names, no lowering (the audit's expected-set arithmetic)."""
+    buckets = config.serving.bucket_resolutions(config.data.image_size)
+    batches = sorted(set(config.serving.batch_sizes))
+    names = list(int8_serving_program_names(config))
+    names.append(
+        pallas_program_name(
+            int8_program_name(serve_program_name(*buckets[-1], min(batches)))
+        )
+    )
+    return tuple(names)
+
+
+def make_int8_infer_fn(model, config: FasterRCNNConfig, image_size=None):
+    """The int8 serving program body: in-program reconstruction of the
+    quantized resident tree (`quant/apply.py::build_infer_variables` —
+    per-channel dequantize through the `ops/quant_ops.py` backend seam,
+    QuantDense kernels passed through as int8), then the SAME inference
+    function every other serve bucket jits."""
+    from replication_faster_rcnn_tpu.eval.evaluator import make_infer_fn
+    from replication_faster_rcnn_tpu.quant.apply import build_infer_variables
+
+    base = make_infer_fn(model, config, image_size)
+
+    def infer(qvars, images):
+        return base(build_infer_variables(qvars, config), images)
+
+    return infer
+
+
+def build_int8_program_specs(
+    config: FasterRCNNConfig, model=None, artifact=None
+) -> Dict[str, ProgramSpec]:
+    """{name: ProgramSpec} for the ``serve_*__int8`` twin programs — one
+    per serving bucket/batch — plus one ops.backend=pallas int8 twin
+    (largest bucket, smallest batch, ``serve_*__int8__pallas``) whose
+    dequantize routes through `ops/pallas/quant_kernel.py`.
+
+    ``artifact`` defaults to the structure-only synthetic artifact
+    (all-int8 plan, `quant/apply.py::synthetic_artifact`): lowering only
+    needs the qvars STRUCTURE, and pinning the canonical plan keeps the
+    audited program matrix independent of any local calibration run. The
+    engine builds the same specs against its real sidecar.
+    """
+    from replication_faster_rcnn_tpu import ops as ops_pkg
+    from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
+    from replication_faster_rcnn_tpu.quant.apply import (
+        abstract_quantize_variables,
+        synthetic_artifact,
+    )
+
+    if model is None:
+        model = FasterRCNN(config)
+    h0, w0 = config.data.image_size
+    variables_abs = jax.eval_shape(
+        lambda rng, img: model.init({"params": rng}, img, train=False),
+        jax.ShapeDtypeStruct((2,), np.uint32),
+        jax.ShapeDtypeStruct((1, h0, w0, 3), np.float32),
+    )
+    if artifact is None:
+        artifact = synthetic_artifact(variables_abs)
+    qvars_abs = abstract_quantize_variables(variables_abs, artifact)
+    plan = dict(artifact["plan"])
+    dense_int8 = "quant" in qvars_abs
+
+    def _spec(base_name: str, h: int, w: int, n: int, backend: str):
+        name = int8_program_name(base_name)
+        if backend == "pallas":
+            name = pallas_program_name(name)
+
+        def _build(hh=h, ww=w, nn=n, name_=name, backend_=backend):
+            from replication_faster_rcnn_tpu.parallel.plan import (
+                Plan,
+                compile_step_with_plan,
+            )
+
+            jitted = compile_step_with_plan(
+                make_int8_infer_fn(model, config, (hh, ww)),
+                Plan(label=name_),
+            )
+            if backend_ == "pallas":
+                jitted = _ScopedLower(jitted, "pallas")
+            images_abs = jax.ShapeDtypeStruct((nn, hh, ww, 3), np.float32)
+            return jitted, (qvars_abs, images_abs)
+
+        meta = {
+            "bucket": [h, w],
+            "batch": n,
+            "params_dtype": "int8",
+            "quant_plan": plan,
+            "int8_dense": dense_int8,
+            "twin": base_name,
+        }
+        if backend == "pallas":
+            meta.update(
+                ops_backend="pallas",
+                pallas_interpret=ops_pkg.interpret_mode(),
+                twin=int8_program_name(base_name),
+            )
+        return name, ProgramSpec(
+            name=name,
+            feed="serve",
+            k=0,
+            arg_roles=("qvariables", "images"),
+            build=_build,
+            meta=meta,
+        )
+
+    specs: Dict[str, ProgramSpec] = {}
+    buckets = config.serving.bucket_resolutions(config.data.image_size)
+    batches = sorted(set(config.serving.batch_sizes))
+    for h, w in buckets:
+        for n in batches:
+            name, spec = _spec(serve_program_name(h, w, n), h, w, n, "xla")
+            specs[name] = spec
+    # one pallas int8 twin, mirroring pallas_twin_base_names' serving
+    # choice: largest-area bucket, smallest batch
+    ph, pw = buckets[-1]
+    pn = min(batches)
+    name, spec = _spec(serve_program_name(ph, pw, pn), ph, pw, pn, "pallas")
+    specs[name] = spec
+    return specs
+
+
 def build_program_specs(
     config: FasterRCNNConfig,
     feeds: Sequence[str] = ("loader",),

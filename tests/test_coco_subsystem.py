@@ -265,6 +265,7 @@ def _record(co, **over):
             "buckets": {"train_mAP": 0.45, "images_per_sec": 0.95},
             "topk": {"train_mAP": 0.55, "images_per_sec": 1.1},
         },
+        "quant": {"f32_mAP": 0.5, "int8_mAP": 0.499, "map_drop_pt": 0.1},
     }
     rec.update(over)
     return rec

@@ -237,6 +237,26 @@ class TestReplicaRegistry:
         reg.probe_once()
         assert reg.state_of("r0") == HEALTHY
 
+    def test_params_dtype_tracked_from_healthz_and_kept_when_dead(self):
+        """Probes record the replica's reported residency dtype into the
+        snapshot (/stats "registry"), and a dead replica keeps its last
+        reported dtype — dying does not change what is resident."""
+        now = [0.0]
+        client = LocalReplicaClient(
+            "r0", lambda p: p,
+            lambda: {"ok": True, "params_dtype": "int8"},
+        )
+        reg = _registry({"r0": client}, lambda: now[0])
+        assert reg.snapshot()["r0"]["params_dtype"] is None
+        reg.probe_once(), reg.probe_once()
+        assert reg.snapshot()["r0"]["params_dtype"] == "int8"
+        client.kill()
+        now[0] = 5.0
+        reg.probe_once()
+        snap = reg.snapshot()["r0"]
+        assert snap["state"] == DEAD
+        assert snap["params_dtype"] == "int8"
+
     def test_draining_and_degraded_park_but_renew_lease(self):
         now = [0.0]
         health = {"ok": True}
@@ -943,6 +963,13 @@ class TestFleetProfileGate:
                 "burn_after_rejoin": {"short": 0.0, "long": 0.0},
             },
             "trace_failover_evidence": True,
+            "mixed": {
+                "availability": 1.0,
+                "replica_dtypes": {"b0": "bfloat16", "b1": "bfloat16",
+                                   "q0": "int8"},
+                "int8_requests_ok": 40,
+                "metrics_dtype_gauge": True,
+            },
         }
         rec.update(kw)
         return rec
@@ -1019,6 +1046,33 @@ class TestFleetProfileGate:
             [att("t4", "r0", False), att("t4", "r0", False)]
         ) is None
 
+    def test_mixed_leg_gates(self, fp):
+        # the dtype-heterogeneous fleet must hold the availability floor
+        cur = self._record(fp)
+        cur["mixed"]["availability"] = 0.95
+        failures, _ = fp.check_regression(cur, None)
+        assert any("mixed: availability" in f for f in failures)
+        # both residency dtypes must be visible in the registry snapshot
+        cur = self._record(fp)
+        cur["mixed"]["replica_dtypes"] = {"b0": "bfloat16", "b1": None}
+        failures, _ = fp.check_regression(cur, None)
+        assert any("both int8 and" in f for f in failures)
+        # ... and as the Prometheus info gauge
+        cur = self._record(fp)
+        cur["mixed"]["metrics_dtype_gauge"] = False
+        failures, _ = fp.check_regression(cur, None)
+        assert any("fleet_replica_params_dtype" in f for f in failures)
+        # the int8 replica must genuinely serve traffic
+        cur = self._record(fp)
+        cur["mixed"]["int8_requests_ok"] = 0
+        failures, _ = fp.check_regression(cur, None)
+        assert any("int8 replica served no successful" in f
+                   for f in failures)
+        # records predating the leg (no key) don't fail the gate
+        cur = self._record(fp)
+        del cur["mixed"]
+        assert fp.check_regression(cur, None)[0] == []
+
     def test_schema_mismatch_skips_comparison(self, fp):
         banked = self._record(fp, schema="fleet_profile/v0")
         cur = self._record(fp, **{fp.GATE_KEY: 1.0})
@@ -1043,3 +1097,9 @@ class TestFleetProfileGate:
         assert failures == []
         assert banked["availability"] >= fp.DEFAULT_MIN_AVAILABILITY
         assert banked["speedup"] >= fp.DEFAULT_MIN_SPEEDUP
+        mixed = banked["mixed"]
+        assert mixed["availability"] >= fp.DEFAULT_MIN_AVAILABILITY
+        assert set(mixed["replica_dtypes"].values()) >= \
+            {"int8", "bfloat16"}
+        assert mixed["int8_requests_ok"] >= 1
+        assert mixed["metrics_dtype_gauge"]

@@ -151,8 +151,12 @@ class TestWarmupTwins:
 
         names = expected_program_names(config=audit_config())
         twins = sorted(n for n in names if n.endswith("__pallas"))
+        # the int8 serve program gets its own pallas twin (ISSUE 17):
+        # the quantized GEMM is a distinct kernel whose provenance HX007
+        # and HX008 audit separately from the f32 serve twin
         assert twins == [
             "eval_infer__pallas",
+            "serve_64x64_b1__int8__pallas",
             "serve_64x64_b1__pallas",
             "train_loader_k1__pallas",
         ]

@@ -1,0 +1,699 @@
+"""Quantized inference subsystem (ISSUE 17): PTQ calibration, the
+sensitivity sweep's bf16 fallback, the sidecar artifact discipline, the
+int8 op pair behind the ops.backend seam, the quantized engine mode,
+HX008 quantization provenance, and the quant gate arithmetic.
+
+Pure tests pin the calibration math (per-channel abs-max scales are
+order-invariant — bit-identical across runs and a thread-pool split),
+the <= 0.5-scale-unit round-trip bound, artifact CRC/byte identity,
+HX008 in both directions, and the serving_profile/coco_overfit quant
+gates. Live tests run the sweep over a tiny resnet18 (the injected
+hostile-layer fallback — the "demonstrably falls back" acceptance pin)
+and compile the int8 engine at one 32x32 bucket.
+"""
+
+import dataclasses
+import importlib
+import importlib.util
+import json
+import os
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from replication_faster_rcnn_tpu import quant
+from replication_faster_rcnn_tpu.config import (
+    FasterRCNNConfig,
+    QuantConfig,
+)
+from replication_faster_rcnn_tpu.quant.artifact import ARTIFACT_SCHEMA
+
+# the package re-exports the calibrate() entry point under the module's
+# own name; reach the module itself for its internals
+calibrate_mod = importlib.import_module(
+    "replication_faster_rcnn_tpu.quant.calibrate"
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_benchmark(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "benchmarks", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------ calibration
+
+
+class TestCalibration:
+    def test_channel_scale_is_per_channel_absmax(self):
+        w = np.random.RandomState(0).randn(5, 4, 3).astype(np.float32)
+        scale = calibrate_mod.channel_scale(w)
+        expect = np.max(np.abs(w), axis=(0, 1)) / 127.0
+        np.testing.assert_allclose(scale, expect.astype(np.float32))
+        assert scale.dtype == np.float32 and scale.shape == (3,)
+
+    def test_round_trip_error_bounded_by_half_scale_unit(self):
+        rng = np.random.RandomState(1)
+        params = {"a": {"kernel": rng.randn(16, 8).astype(np.float32)},
+                  "b": {"kernel": rng.uniform(-3, 3, (3, 3, 4, 8))
+                        .astype(np.float32)}}
+        scales = quant.weight_scales(params)
+        errors = quant.round_trip_errors(params, scales)
+        assert set(errors) == {"a/kernel", "b/kernel"}
+        for key, err in errors.items():
+            # round-to-nearest against the per-channel scale: at most
+            # half a quantization step everywhere
+            assert err <= 0.5 + 1e-6, f"{key} round-trip error {err}"
+
+    def test_scales_bit_identical_across_thread_pool_split(self):
+        # the docstring claim: abs-max is exactly associative, so a
+        # chunked/threaded reduction reproduces the single-pass scale
+        # byte for byte
+        w = np.random.RandomState(2).randn(256, 16).astype(np.float32)
+        full = calibrate_mod.channel_scale(w)
+        chunks = np.array_split(w, 7)
+        with ThreadPoolExecutor(max_workers=4) as ex:
+            partials = list(
+                ex.map(lambda c: np.max(np.abs(c), axis=0), chunks)
+            )
+        amax = np.maximum.reduce(partials)
+        recombined = (
+            np.maximum(amax, calibrate_mod.SCALE_EPS) / 127.0
+        ).astype(np.float32)
+        assert full.tobytes() == recombined.tobytes()
+
+    def test_layer_group_of(self):
+        assert quant.layer_group_of(("trunk", "conv1", "kernel")) == \
+            "trunk.stem"
+        assert quant.layer_group_of(
+            ("trunk", "layer2.1", "conv1", "kernel")
+        ) == "trunk.layer2"
+        assert quant.layer_group_of(("rpn", "conv", "kernel")) == "rpn"
+        assert quant.layer_group_of(("head", "cls", "kernel")) == "head"
+        assert quant.layer_group_of(("neck", "lateral3", "kernel")) == "neck"
+
+    def test_quantizable_filters_rank_and_dtype(self):
+        kernel = np.zeros((3, 3, 8, 16), np.float32)
+        bias = np.zeros((16,), np.float32)
+        counter = np.zeros((4, 4), np.int32)
+        assert quant.quantizable(("x", "kernel"), kernel)
+        assert not quant.quantizable(("x", "bias"), bias)
+        assert not quant.quantizable(("x", "steps"), counter)
+
+    def test_group_paths_sorted_and_grouped(self):
+        params = {
+            "trunk": {"conv1": {"kernel": np.zeros((3, 3, 3, 8), np.float32),
+                                "bias": np.zeros((8,), np.float32)},
+                      "layer1.0": {"conv2": {
+                          "kernel": np.zeros((3, 3, 8, 8), np.float32)}}},
+            "rpn": {"cls": {"kernel": np.zeros((1, 1, 8, 3), np.float32)}},
+        }
+        groups = calibrate_mod.group_paths(params)
+        assert groups == {
+            "rpn": ["rpn/cls/kernel"],
+            "trunk.layer1": ["trunk/layer1.0/conv2/kernel"],
+            "trunk.stem": ["trunk/conv1/kernel"],
+        }
+
+    def test_synthetic_batches_deterministic(self):
+        cfg = FasterRCNNConfig()
+        a = quant.synthetic_calibration_batches(cfg, 2, 2, seed=3)
+        b = quant.synthetic_calibration_batches(cfg, 2, 2, seed=3)
+        assert len(a) == 2 and a[0].shape[0] == 2
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+
+# ------------------------------------------------------------ artifact
+
+
+def _toy_artifact():
+    rng = np.random.RandomState(4)
+    return {
+        "weight_scales": {
+            "trunk/conv1/kernel": rng.rand(8).astype(np.float32) + 0.01,
+            "head/cls/kernel": rng.rand(4).astype(np.float32) + 0.01,
+        },
+        "activation_ranges": {quant.EMBED_RANGE_KEY: 6.5},
+        "groups": {"trunk.stem": ["trunk/conv1/kernel"],
+                   "head": ["head/cls/kernel"]},
+        "plan": {"trunk.stem": "int8", "head": "int8"},
+        "calib": {"batches": 2, "batch_size": 2},
+    }
+
+
+class TestArtifact:
+    def test_round_trip_and_byte_identity(self, tmp_path):
+        art = _toy_artifact()
+        p1, p2 = str(tmp_path / "a1.json"), str(tmp_path / "a2.json")
+        quant.save_artifact(p1, art, config_hash="abc")
+        quant.save_artifact(p2, art, config_hash="abc")
+        b1 = open(p1, "rb").read()
+        assert b1 == open(p2, "rb").read(), "artifact bytes not stable"
+        loaded = quant.load_artifact(p1)
+        assert loaded["schema"] == ARTIFACT_SCHEMA
+        assert loaded["config_hash"] == "abc"
+        assert loaded["plan"] == art["plan"]
+        assert loaded["activation_ranges"] == art["activation_ranges"]
+        for key, scale in art["weight_scales"].items():
+            assert loaded["weight_scales"][key].tobytes() == scale.tobytes()
+
+    def test_crc_detects_corruption(self, tmp_path):
+        path = str(tmp_path / "a.json")
+        quant.save_artifact(path, _toy_artifact())
+        doc = json.load(open(path))
+        key = sorted(doc["weight_scales"])[0]
+        doc["weight_scales"][key]["crc32"] ^= 0xDEAD
+        json.dump(doc, open(path, "w"))
+        with pytest.raises(quant.QuantArtifactError, match="CRC"):
+            quant.load_artifact(path)
+
+    def test_missing_sidecar_names_frcnn_quantize(self, tmp_path):
+        with pytest.raises(quant.QuantArtifactError, match="frcnn quantize"):
+            quant.load_artifact(str(tmp_path / "nope.json"))
+
+    def test_schema_mismatch_rejected(self, tmp_path):
+        path = str(tmp_path / "a.json")
+        quant.save_artifact(path, _toy_artifact())
+        doc = json.load(open(path))
+        doc["schema"] = "quant_artifact/v0"
+        json.dump(doc, open(path, "w"))
+        with pytest.raises(quant.QuantArtifactError, match="schema"):
+            quant.load_artifact(path)
+
+    def test_default_artifact_path_resolution(self):
+        cfg = FasterRCNNConfig()
+        assert quant.default_artifact_path(cfg, "/ckpts") == \
+            "/ckpts/quant_artifact.json"
+        cfg = cfg.replace(quant=QuantConfig(artifact="/explicit/q.json"))
+        assert quant.default_artifact_path(cfg, "/ckpts") == \
+            "/explicit/q.json"
+
+
+class TestQuantConfig:
+    def test_rejects_bad_calib_sizes(self):
+        with pytest.raises(ValueError, match="calib_batches"):
+            QuantConfig(calib_batches=0)
+        with pytest.raises(ValueError, match="calib_batch_size"):
+            QuantConfig(calib_batch_size=0)
+
+    def test_rejects_negative_thresholds(self):
+        with pytest.raises(ValueError):
+            QuantConfig(sensitivity_map_drop_pt=-0.1)
+        with pytest.raises(ValueError):
+            QuantConfig(sensitivity_recon_rel_err=-0.1)
+
+
+# ------------------------------------------------------------ live model
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    """Tiny resnet18 at 32x32 + its PTQ calibration artifact — shared
+    by the sweep, apply, and engine tests."""
+    import jax
+
+    from replication_faster_rcnn_tpu.models.faster_rcnn import init_variables
+    from tests.test_serving import live_config
+
+    cfg = live_config()
+    model, variables = init_variables(cfg, jax.random.PRNGKey(0))
+    batches = quant.synthetic_calibration_batches(
+        cfg, batches=2, batch_size=1
+    )
+    artifact = quant.calibrate(model, variables, batches, cfg)
+    return {"cfg": cfg, "model": model, "variables": variables,
+            "batches": batches, "artifact": artifact}
+
+
+class TestCalibrationLive:
+    def test_artifact_bit_identical_across_runs(self, tiny, tmp_path):
+        again = quant.calibrate(
+            tiny["model"], tiny["variables"], tiny["batches"], tiny["cfg"]
+        )
+        p1, p2 = str(tmp_path / "r1.json"), str(tmp_path / "r2.json")
+        quant.save_artifact(p1, tiny["artifact"])
+        quant.save_artifact(p2, again)
+        assert open(p1, "rb").read() == open(p2, "rb").read()
+
+    def test_activation_range_positive_and_scales_cover_groups(self, tiny):
+        art = tiny["artifact"]
+        assert art["activation_ranges"][quant.EMBED_RANGE_KEY] > 0
+        covered = {p for ps in art["groups"].values() for p in ps}
+        assert covered == set(art["weight_scales"])
+        assert set(art["plan"]) == set(art["groups"])
+
+
+class TestSensitivitySweep:
+    def test_hostile_group_falls_back_to_bf16(self, tiny):
+        """The acceptance pin: a quantization-hostile layer group must
+        demonstrably fall back to bf16. Hostility is injected as what
+        huge intra-channel dynamic range actually produces — an
+        outlier-dominated calibrated scale, under which every
+        functionally-important weight sits below one quantization step
+        and rounds to zero. (Injecting the outlier into the weights
+        themselves can't pin this on a tiny random-init net: a spike
+        big enough to inflate the scale also dominates both the
+        baseline and quantized responses, so their relative error stays
+        small.)"""
+        from replication_faster_rcnn_tpu.quant.sensitivity import sweep
+
+        artifact = dict(tiny["artifact"])
+        artifact["weight_scales"] = dict(artifact["weight_scales"])
+        for p in artifact["groups"]["head"]:
+            artifact["weight_scales"][p] = (
+                artifact["weight_scales"][p] * 1000.0
+            )
+        out = sweep(
+            tiny["model"], tiny["variables"], artifact,
+            tiny["batches"][:1], tiny["cfg"],
+        )
+        cfg_q = tiny["cfg"].quant
+        assert out["plan"]["head"] == "bfloat16"
+        assert out["sensitivity"]["head"]["recon_rel_err"] > \
+            cfg_q.sensitivity_recon_rel_err
+        others = {g: d for g, d in out["plan"].items() if g != "head"}
+        assert "int8" in others.values(), (
+            "no group survived as int8 — the sweep demoted everything: "
+            f"{out['plan']}"
+        )
+
+    def test_map_drop_signal_demotes_group(self, tiny):
+        """With recon error tiny (clean weights), a mini-eval mAP drop
+        above quant.sensitivity_map_drop_pt alone must demote a group."""
+        from replication_faster_rcnn_tpu.quant.sensitivity import sweep
+
+        groups = sorted(tiny["artifact"]["groups"])
+        target = groups[0]
+        calls = {"n": 0}
+
+        def eval_fn(_variables):
+            i = calls["n"]
+            calls["n"] += 1
+            # call 0 is the f32 baseline; call 1 is the first group in
+            # sorted order — give it a 20-point drop
+            return 0.5 if i != 1 else 0.3
+
+        artifact = dict(tiny["artifact"])
+        out = sweep(
+            tiny["model"], tiny["variables"], artifact,
+            tiny["batches"][:1], tiny["cfg"], eval_fn=eval_fn,
+        )
+        assert out["plan"][target] == "bfloat16"
+        assert out["sensitivity"][target]["map_drop_pt"] == \
+            pytest.approx(20.0)
+        assert out["sensitivity"]["__baseline__"]["map"] == 0.5
+        # the demotion came from the mAP signal, not recon
+        assert out["sensitivity"][target]["recon_rel_err"] < \
+            tiny["cfg"].quant.sensitivity_recon_rel_err
+
+
+# ------------------------------------------------------------ apply
+
+
+class TestApply:
+    def test_quantize_variables_structure(self, tiny):
+        import jax
+        import jax.numpy as jnp
+
+        resident = quant.quantize_variables(
+            tiny["variables"], tiny["artifact"]
+        )
+        # QuantDense head kernels: int8 in params + a quant collection
+        # entry carrying w_scale/x_scale
+        params = resident["params"]
+        for name in ("cls", "reg"):
+            assert params["head"][name]["kernel"].dtype == jnp.int8
+            entry = resident["quant"]["head"][name]
+            assert entry["w_scale"].shape == \
+                (params["head"][name]["kernel"].shape[-1],)
+            assert entry["x_scale"].shape == ()
+        # every other planned leaf is int8 with a per-path scale
+        dense_keys = {calibrate_mod.path_key(p)
+                      for p in quant.QUANT_DENSE_PATHS}
+        for path, leaf in calibrate_mod.flatten_params(params):
+            key = calibrate_mod.path_key(path)
+            if leaf.dtype == jnp.int8 and key not in dense_keys:
+                assert key in resident["qscales"], f"no scale for {key}"
+        # residency shrink: quantized tree well under the f32 tree
+        f32_bytes = sum(
+            np.asarray(x).nbytes
+            for x in jax.tree_util.tree_leaves(tiny["variables"])
+        )
+        q_bytes = quant.quantized_params_bytes(resident)
+        assert q_bytes < 0.4 * f32_bytes, (q_bytes, f32_bytes)
+
+    def test_build_infer_variables_reconstructs_compute_dtype(self, tiny):
+        import jax.numpy as jnp
+
+        resident = quant.quantize_variables(
+            tiny["variables"], tiny["artifact"]
+        )
+        infer = quant.build_infer_variables(resident, tiny["cfg"])
+        want = jnp.dtype(tiny["cfg"].model.compute_dtype)
+        dense_keys = {calibrate_mod.path_key(p)
+                      for p in quant.QUANT_DENSE_PATHS}
+
+        def walk(prefix, node):
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    walk(prefix + (str(k),), v)
+                return
+            key = calibrate_mod.path_key(prefix)
+            if key in dense_keys:
+                assert node.dtype == jnp.int8, key
+            elif jnp.issubdtype(node.dtype, jnp.floating):
+                assert node.dtype == want, (key, node.dtype)
+
+        walk((), infer["params"])
+        assert "qscales" not in infer
+        assert "quant" in infer  # QuantDense pass-through
+
+    def test_fake_quant_matches_round_trip(self):
+        rng = np.random.RandomState(5)
+        w = rng.randn(8, 4).astype(np.float32)
+        params = {"layer": {"kernel": w}}
+        scales = quant.weight_scales(params)
+        fq = quant.fake_quant_variables(
+            {"params": params}, scales, ["layer/kernel"]
+        )
+        scale = scales["layer/kernel"]
+        expect = (
+            calibrate_mod.quantize_weight(w, scale).astype(np.float32)
+            * scale
+        )
+        np.testing.assert_allclose(
+            np.asarray(fq["params"]["layer"]["kernel"]), expect, atol=0
+        )
+
+
+# ------------------------------------------------------------ int8 ops
+
+
+@pytest.mark.pallas_interpret
+class TestQuantOps:
+    def test_int8_matmul_pallas_bitwise_equals_xla(self):
+        import jax.numpy as jnp
+
+        from replication_faster_rcnn_tpu import ops as ops_pkg
+        from replication_faster_rcnn_tpu.ops import quant_ops
+
+        rng = np.random.RandomState(6)
+        x = jnp.asarray(
+            rng.randint(-127, 128, size=(17, 70), dtype=np.int8)
+        )
+        w = jnp.asarray(
+            rng.randint(-127, 128, size=(70, 33), dtype=np.int8)
+        )
+        ref = np.asarray(quant_ops.int8_matmul(x, w))
+        with ops_pkg.backend_scope("pallas"):
+            got = np.asarray(quant_ops.int8_matmul(x, w))
+        assert ref.dtype == np.int32
+        np.testing.assert_array_equal(got, ref)
+
+    def test_dequantize_pallas_bitwise_equals_xla(self):
+        import jax.numpy as jnp
+
+        from replication_faster_rcnn_tpu import ops as ops_pkg
+        from replication_faster_rcnn_tpu.ops import quant_ops
+
+        rng = np.random.RandomState(7)
+        w_q = jnp.asarray(
+            rng.randint(-127, 128, size=(41, 9), dtype=np.int8)
+        )
+        scale = jnp.asarray(rng.rand(9).astype(np.float32) + 0.01)
+        ref = np.asarray(quant_ops.dequantize(w_q, scale))
+        with ops_pkg.backend_scope("pallas"):
+            got = np.asarray(quant_ops.dequantize(w_q, scale))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_quant_dense_matches_manual_reference(self):
+        import jax.numpy as jnp
+
+        from replication_faster_rcnn_tpu.ops import quant_ops
+
+        rng = np.random.RandomState(8)
+        x = rng.randn(3, 5, 16).astype(np.float32)
+        w = rng.randn(16, 6).astype(np.float32)
+        bias = rng.randn(6).astype(np.float32)
+        w_q, w_scale = quant_ops.quantize_channelwise(jnp.asarray(w))
+        x_scale = jnp.float32(np.max(np.abs(x)) / 127.0)
+        out = quant_ops.quant_dense(
+            jnp.asarray(x), w_q, w_scale, x_scale, bias=jnp.asarray(bias)
+        )
+        x_q = np.clip(
+            np.round(x.reshape(-1, 16) / float(x_scale)), -127, 127
+        ).astype(np.int32)
+        ref = x_q @ np.asarray(w_q, dtype=np.int32)
+        ref = ref.astype(np.float32) * (
+            float(x_scale) * np.asarray(w_scale, np.float32)[None, :]
+        ) + bias[None, :]
+        assert out.shape == (3, 5, 6)
+        np.testing.assert_allclose(
+            np.asarray(out).reshape(-1, 6), ref, rtol=1e-6, atol=1e-6
+        )
+
+
+# ------------------------------------------------------------ engine
+
+
+class TestQuantEngine:
+    @pytest.fixture(scope="class")
+    def int8_engine(self, tiny, tmp_path_factory):
+        from replication_faster_rcnn_tpu.serving import InferenceEngine
+
+        path = str(tmp_path_factory.mktemp("quant") / "quant_artifact.json")
+        quant.save_artifact(path, tiny["artifact"])
+        cfg = tiny["cfg"].replace(
+            serving=dataclasses.replace(
+                tiny["cfg"].serving, params_dtype="int8", batch_sizes=(1,)
+            )
+        )
+        engine = InferenceEngine(
+            cfg, tiny["model"], tiny["variables"],
+            warmup=True, artifact_path=path,
+        )
+        yield engine
+        engine.close()
+
+    def test_warmup_compiles_int8_twin_programs(self, int8_engine):
+        assert sorted(int8_engine.compile_seconds) == \
+            ["serve_32x32_b1__int8"]
+        assert int8_engine.params_dtype == "int8"
+
+    def test_resident_bytes_shrink_vs_f32(self, tiny, int8_engine):
+        import jax
+
+        f32_bytes = sum(
+            np.asarray(x).nbytes
+            for x in jax.tree_util.tree_leaves(tiny["variables"])
+        )
+        assert int8_engine.params_bytes < 0.4 * f32_bytes
+
+    def test_submit_serves_detections(self, int8_engine):
+        rng = np.random.RandomState(9)
+        img = (rng.rand(32, 32, 3) * 2.0 - 1.0).astype(np.float32)
+        out = int8_engine.submit(img).result(timeout=60)
+        for k in ("boxes", "scores", "classes", "valid"):
+            assert k in out, f"missing {k}"
+        assert np.all(np.isfinite(np.asarray(out["boxes"])))
+
+    def test_missing_sidecar_rejected_with_remedy(self, tiny, tmp_path):
+        from replication_faster_rcnn_tpu.serving import InferenceEngine
+
+        cfg = tiny["cfg"].replace(
+            serving=dataclasses.replace(
+                tiny["cfg"].serving, params_dtype="int8", batch_sizes=(1,)
+            )
+        )
+        with pytest.raises(quant.QuantArtifactError, match="frcnn quantize"):
+            InferenceEngine(
+                cfg, tiny["model"], tiny["variables"],
+                artifact_path=str(tmp_path / "absent.json"),
+            )
+
+
+# ------------------------------------------------------------ HX008
+
+
+class TestHX008:
+    def test_parse_int8_ops_counts_i8_contractions(self):
+        from replication_faster_rcnn_tpu.analysis.fingerprint import (
+            parse_int8_ops,
+        )
+
+        text = "\n".join([
+            "%0 = stablehlo.dot_general %a, %b : "
+            "(tensor<4x8xi8>, tensor<8x2xi8>) -> tensor<4x2xi32>",
+            "%1 = stablehlo.convolution(%x, %w) : "
+            "(tensor<1x4x4x3xi8>, tensor<3x3x3x8xi8>) -> "
+            "tensor<1x4x4x8xi32>",
+            "%2 = stablehlo.dot_general %c, %d : "
+            "(tensor<4x8xf32>, tensor<8x2xf32>) -> tensor<4x2xf32>",
+            "%3 = stablehlo.add %e, %f : tensor<4xi8>",
+        ])
+        assert parse_int8_ops(text) == {"convolution": 1, "dot_general": 1}
+        assert parse_int8_ops("stablehlo.dot_general f32 only") == {}
+
+    @staticmethod
+    def _hx008(fingerprints):
+        from replication_faster_rcnn_tpu.analysis.hlolint import (
+            check_contracts,
+        )
+
+        violations = check_contracts(
+            fingerprints, FasterRCNNConfig(), hbm_budget_bytes=2**40
+        )
+        return [v for v in violations if v.rule == "HX008"]
+
+    def test_quantized_program_without_int8_dot_flagged(self):
+        out = self._hx008({
+            "serve_16x16_b1__int8": {
+                "int8_ops": {},
+                "meta": {"params_dtype": "int8", "int8_dense": True},
+            }
+        })
+        assert len(out) == 1
+        assert "no int8 dot_general" in out[0].message
+
+    def test_int8_leak_into_f32_program_flagged(self):
+        out = self._hx008({
+            "serve_16x16_b1": {
+                "int8_ops": {"dot_general": 2},
+                "meta": {"params_dtype": "float32"},
+            }
+        })
+        assert len(out) == 1
+        assert "leaked" in out[0].message
+
+    def test_clean_records_pass_both_directions(self):
+        out = self._hx008({
+            "serve_16x16_b1__int8": {
+                "int8_ops": {"dot_general": 2},
+                "meta": {"params_dtype": "int8", "int8_dense": True},
+            },
+            "serve_16x16_b1": {
+                "int8_ops": {},
+                "meta": {"params_dtype": "float32"},
+            },
+            "legacy_no_field": {"meta": {}},
+        })
+        assert out == []
+
+
+# ------------------------------------------------------------ gates
+
+
+class TestServingProfileQuantGate:
+    @pytest.fixture(scope="class")
+    def sp(self):
+        return _load_benchmark("serving_profile")
+
+    def test_budget_batch_picks_largest_fit(self, sp):
+        ladder = (1, 2, 4, 8, 16, 32)
+        act = {b: 10 * b for b in ladder}
+        assert sp.budget_batch(ladder, 100, act, budget=250) == 8
+        assert sp.budget_batch(ladder, 100, act, budget=10_000) == 32
+        # nothing fits: fall to the smallest compiled batch
+        assert sp.budget_batch(ladder, 100, act, budget=50) == 1
+
+    def test_speedup_floor_enforced(self, sp):
+        rec = {"schema": sp.QUANT_SCHEMA, "quant_speedup": 1.2,
+               sp.QUANT_GATE_KEY: 120.0, "bf16_images_per_sec": 100.0,
+               "int8_budget_batch": 32, "bf16_budget_batch": 1}
+        fails, _ = sp.check_quant_regression(rec, None)
+        assert any("acceptance floor" in f for f in fails)
+        rec["quant_speedup"] = 2.0
+        fails, _ = sp.check_quant_regression(rec, None)
+        assert fails == []
+
+    def test_missing_speedup_fails(self, sp):
+        fails, _ = sp.check_quant_regression(
+            {"schema": sp.QUANT_SCHEMA}, None
+        )
+        assert any("no quant_speedup" in f for f in fails)
+
+    def test_ratio_regression_gated_absolute_drop_warns(self, sp):
+        banked = {"schema": sp.QUANT_SCHEMA, "quant_speedup": 3.0,
+                  sp.QUANT_GATE_KEY: 100.0}
+        rec = {"schema": sp.QUANT_SCHEMA, "quant_speedup": 2.0,
+               sp.QUANT_GATE_KEY: 40.0}
+        fails, warns = sp.check_quant_regression(rec, banked, tol=0.25)
+        assert any("regressed" in f for f in fails)
+        # the absolute capacity collapse is a warning, never a failure
+        assert any(sp.QUANT_GATE_KEY in w for w in warns)
+        assert not any(sp.QUANT_GATE_KEY in f for f in fails)
+        # drift-immune: same ratio with halved absolutes passes
+        rec = {"schema": sp.QUANT_SCHEMA, "quant_speedup": 3.0,
+               sp.QUANT_GATE_KEY: 50.0}
+        fails, _ = sp.check_quant_regression(rec, banked, tol=0.25)
+        assert fails == []
+
+    def test_schema_mismatch_warns_and_skips(self, sp):
+        rec = {"schema": sp.QUANT_SCHEMA, "quant_speedup": 2.0}
+        fails, warns = sp.check_quant_regression(
+            rec, {"schema": "serving_profile_quant/v0", "quant_speedup": 99}
+        )
+        assert fails == []
+        assert any("schema" in w for w in warns)
+
+    def test_banked_quant_record_passes_its_own_gate(self, sp):
+        import glob
+
+        paths = glob.glob(os.path.join(
+            REPO, "benchmarks", "records", "serving_profile_quant*.json"
+        ))
+        assert paths, "no banked quant serving record"
+        for path in paths:
+            banked = json.load(open(path))
+            assert banked["schema"] == sp.QUANT_SCHEMA
+            fails, _ = sp.check_quant_regression(banked, banked)
+            assert fails == [], (path, fails)
+            assert banked["quant_speedup"] >= sp.DEFAULT_MIN_QUANT_SPEEDUP
+
+
+class TestCocoQuantGate:
+    @pytest.fixture(scope="class")
+    def co(self):
+        return _load_benchmark("coco_overfit")
+
+    def _record(self, co, drop):
+        return {
+            "legs": {
+                "single": {"train_mAP": 0.4, "images_per_sec": 10.0},
+                "buckets": {"train_mAP": 0.3, "images_per_sec": 10.0},
+            },
+            "quant": {"f32_mAP": 0.4, "int8_mAP": 0.4 - drop / 100.0,
+                      "map_drop_pt": drop},
+        }
+
+    def test_drop_within_budget_passes(self, co):
+        rec = self._record(co, drop=0.2)
+        fails, _ = co.check_gate(rec, {"map_floor": 0.1})
+        assert fails == []
+
+    def test_drop_over_budget_fails(self, co):
+        rec = self._record(co, drop=co.QUANT_MAP_DROP_PT + 0.2)
+        fails, _ = co.check_gate(rec, {"map_floor": 0.1})
+        assert any("int8 PTQ costs" in f for f in fails)
+
+    def test_missing_quant_leg_fails(self, co):
+        rec = self._record(co, drop=0.0)
+        del rec["quant"]
+        fails, _ = co.check_gate(rec, {"map_floor": 0.1})
+        assert any("quant leg" in f for f in fails)
+
+    def test_banked_mini_record_carries_passing_quant_leg(self, co):
+        path = os.path.join(
+            REPO, "benchmarks", "records", "coco_overfit_mini_cpu.json"
+        )
+        banked = json.load(open(path))
+        fails, _ = co.check_gate(banked, banked)
+        assert fails == [], fails
+        assert float(banked["quant"]["map_drop_pt"]) <= co.QUANT_MAP_DROP_PT

@@ -576,7 +576,17 @@ class TestServingSpecs:
         extra = full - base
         serving = {n for n in extra if n.startswith("serve_")}
         # 4 bucket-matrix programs + the serve pallas twin (ISSUE 13)
-        assert len(serving) == 5 and "serve_64x64_b1__pallas" in serving
+        # + their 4 __int8 quantized twins and the int8 pallas twin
+        # (ISSUE 17)
+        assert len(serving) == 10 and "serve_64x64_b1__pallas" in serving
+        int8 = {n for n in serving if "__int8" in n}
+        assert int8 == {
+            "serve_32x32_b1__int8",
+            "serve_32x32_b2__int8",
+            "serve_64x64_b1__int8",
+            "serve_64x64_b2__int8",
+            "serve_64x64_b1__int8__pallas",
+        }
         # the only other config-dependent names are the remaining pallas
         # twins and the per-bucket training programs (ISSUE 15: the audit
         # config sets data.train_resolutions)
