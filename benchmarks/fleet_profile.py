@@ -45,6 +45,18 @@ Measured legs:
     ``fleet_replica_params_dtype`` info gauge in the Prometheus
     /metrics exposition — the ISSUE 17 observability contract: you can
     always tell which replicas serve quantized weights.
+  * rollout  — two full rolling weight rollouts driven by the REAL
+    RolloutController (serving/rollout/) against versioned replicas
+    (each wraps a real MicroBatcher whose flush key is
+    ``(model_version, bucket)`` — the engine's hot-swap keying) while
+    background load keeps hitting the router.  Wave one promotes; wave
+    two rolls to a version whose flushes fail, the canary's private
+    burn-rate tracker alarms, the router auto-demotes it, and the
+    controller reverse-rolls the fleet.  Gated: availability >= the
+    floor through BOTH waves, zero version-mixed batches (structural —
+    every flush's admitted-item versions are recorded), version skew
+    observed in the /stats registry view mid-wave, and the
+    ``fleet_replica_model_version`` info gauge present in /metrics.
 
 Banked under benchmarks/records/ (step_profile.py conventions: atomic
 save, --update to re-bank, --no-check to just measure). The gate fails
@@ -75,7 +87,9 @@ if _REPO not in sys.path:
 
 RECORDS_DIR = os.path.join(_REPO, "benchmarks", "records")
 # v3: adds the mixed-precision (int8 + bf16) dtype-observability leg
-SCHEMA = "fleet_profile/v3"
+# v4: adds the rolling-rollout leg (hot-swap under load, gated promote,
+#     auto-rollback on a burn-rate alarm, zero version-mixed batches)
+SCHEMA = "fleet_profile/v4"
 DEFAULT_TOL = 0.25  # sleep-paced throughput is steadier than compute,
 #                     but the CI host still jitters thread wakeups
 DEFAULT_MIN_SPEEDUP = 2.0
@@ -226,6 +240,46 @@ def check_regression(
                 "mixed: the int8 replica served no successful request — "
                 "it never entered rotation"
             )
+    # rollout leg: both waves must land (one promoted, one rolled back
+    # by the injected burn-rate alarm), availability must hold through
+    # them, no flush may ever mix model versions, and the skew must be
+    # observable while a wave is in flight
+    rollout = current.get("rollout") or {}
+    if rollout:
+        roll_avail = rollout.get("availability")
+        if roll_avail is not None and roll_avail < min_availability:
+            failures.append(
+                f"rollout: availability {roll_avail:.4%} below the "
+                f"{min_availability:.2%} floor through the two rollout "
+                "waves"
+            )
+        if not rollout.get("promoted_ok"):
+            failures.append(
+                "rollout: the good-version wave did not finish promoted "
+                f"(outcome {rollout.get('promote_outcome')!r})"
+            )
+        if not rollout.get("rolled_back_ok"):
+            failures.append(
+                "rollout: the bad-version wave was not auto-rolled-back "
+                "by the burn-rate alarm (outcome "
+                f"{rollout.get('rollback_outcome')!r})"
+            )
+        if rollout.get("version_mixed_batches", 0) != 0:
+            failures.append(
+                f"rollout: {rollout.get('version_mixed_batches')} flushes "
+                "mixed model versions — the (version, bucket) batch "
+                "keying is broken"
+            )
+        if not rollout.get("skew_observed"):
+            failures.append(
+                "rollout: version skew was never visible in the /stats "
+                "registry view while a wave was in flight"
+            )
+        if not rollout.get("metrics_version_gauge"):
+            failures.append(
+                "rollout: fleet_replica_model_version info gauge missing "
+                "from the Prometheus exposition"
+            )
     # tracing: the merged Chrome trace must show one failed-over request
     # whose attempt spans touch >= 2 replicas under a single trace id
     if current.get("trace_failover_evidence") is False:
@@ -273,6 +327,73 @@ def make_sim_replica(
     return LocalReplicaClient(
         replica_id, predict, health if params_dtype is not None else None
     )
+
+
+def make_versioned_sim_replica(
+    replica_id: str, service_s: float, version: str = "1", bad_versions=()
+):
+    """A rollout-capable sim replica: requests flow through a REAL
+    MicroBatcher whose flush key is ``(model_version, bucket)`` — the
+    engine's hot-swap keying — and every flush records the admitted
+    items' versions, so "zero version-mixed batches" is checked
+    structurally, not assumed.  ``swap()`` flips the admission version
+    (in-flight entries keep their old key and flush separately, exactly
+    like the engine).  Flushes at a version in ``bad_versions`` raise —
+    the bad-build stand-in the auto-rollback wave needs.
+
+    Returns ``(client, state)``; ``state['flushes']`` is the
+    ``(key_version, sorted(item_versions))`` log and ``state['close']``
+    drains the batcher."""
+    from replication_faster_rcnn_tpu.serving.batcher import MicroBatcher
+    from replication_faster_rcnn_tpu.serving.fleet.client import (
+        LocalReplicaClient,
+    )
+
+    lock = threading.Lock()
+    state = {"version": str(version), "flushes": []}
+
+    def flush(key, items):
+        key_version = key[0]
+        admitted = sorted({v for _, v in items})
+        with lock:
+            state["flushes"].append((key_version, admitted))
+        if key_version in bad_versions:
+            raise RuntimeError(
+                f"replica {replica_id!r}: version {key_version} cannot "
+                "serve (bad build)"
+            )
+        time.sleep(service_s)
+        return [{"replica": replica_id, "version": key_version,
+                 "payload": p} for p, _ in items]
+
+    batcher = MicroBatcher(
+        flush, max_batch=4, max_delay_s=service_s,
+        name=f"rollout-sim-{replica_id}",
+    )
+
+    def predict(payload):
+        with lock:
+            v = state["version"]
+        return batcher.submit((v, "b"), (payload, v)).result(timeout=10.0)
+
+    def health():
+        with lock:
+            v = state["version"]
+        return {
+            "ok": True,
+            "model_version": v,
+            "bucket_queue_depths": {
+                str(k): n for k, n in batcher.key_depths().items()
+            },
+        }
+
+    def swap(new_version):
+        with lock:
+            state["version"] = str(new_version)
+
+    state["close"] = batcher.close
+    client = LocalReplicaClient(replica_id, predict, health, swap_fn=swap)
+    return client, state
 
 
 def build_fleet(clients, cfg):
@@ -500,6 +621,111 @@ def profile(
         if replica_dtypes.get(rid) == "int8"
     )
 
+    # -- rollout leg: two rolling rollouts mid-load through the REAL
+    # controller.  Wave "2" promotes; wave "3" fails its flushes on the
+    # canary, the burn-rate alarm demotes it, and the controller
+    # reverse-rolls the fleet back to "2".  Load never stops.
+    from replication_faster_rcnn_tpu.config import (
+        FasterRCNNConfig,
+        RolloutConfig,
+    )
+    from replication_faster_rcnn_tpu.serving.rollout import RolloutController
+
+    rollout_fleet_cfg = dataclasses.replace(
+        cfg,
+        hedge=False,          # sequential failover: canary misses fall
+        #                       through to the serving walk in-thread
+        canary_fraction=0.4,  # a wide slice so the canary accumulates
+        #                       CANARY_SLO_MIN_SAMPLES within the hold
+        cache_entries=0,
+    )
+    full_cfg = FasterRCNNConfig().replace(
+        fleet=rollout_fleet_cfg,
+        rollout=RolloutConfig(
+            drain_timeout_s=1.0,
+            swap_timeout_s=5.0,
+            rejoin_timeout_s=5.0,
+            canary_hold_s=0.6,
+            canary_min_requests=5,
+        ),
+    )
+    versioned = {
+        rid: make_versioned_sim_replica(
+            rid, service_s, version="1", bad_versions=("3",)
+        )
+        for rid in ("v0", "v1", "v2")
+    }
+    clients = {rid: client for rid, (client, _) in versioned.items()}
+    registry, prober, router = build_fleet(clients, rollout_fleet_cfg)
+    controller = RolloutController(registry, router, full_cfg)
+    stop = threading.Event()
+    skew_samples = []
+    load_counts = []
+
+    def _load_loop(worker: int) -> None:
+        counts = {"ok": 0, "fail": 0}
+        load_counts.append(counts)
+        i = 0
+        while not stop.is_set():
+            payload = f"roll-{worker}-{i:05d}"
+            try:
+                router.dispatch(payload, content_hash=content_key(payload.encode()))
+                counts["ok"] += 1
+            except Exception:  # noqa: BLE001 - the availability ledger
+                counts["fail"] += 1
+            i += 1
+
+    def _skew_sampler() -> None:
+        # the /stats registry view: distinct reported versions per poll
+        while not stop.is_set():
+            snap = router.snapshot()["registry"]
+            versions_now = {
+                info.get("model_version")
+                for info in snap.values()
+                if info.get("model_version")
+            }
+            skew_samples.append(sorted(versions_now))
+            time.sleep(0.02)
+
+    threads = [
+        threading.Thread(target=_load_loop, args=(w,), daemon=False)
+        for w in range(concurrency // 2 or 1)
+    ] + [threading.Thread(target=_skew_sampler, daemon=False)]
+    try:
+        for t in threads:
+            t.start()
+        wave_promote = controller.rollout("2")
+        wave_rollback = controller.rollout("3")
+        rollout_prom = router.metrics.render_prometheus()
+        rollout_snap = router.snapshot()
+        rollout_registry = rollout_snap["registry"]
+        router_stats_rollout = rollout_snap["router"]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        prober.stop()
+        router.close()
+        for _, (_, st) in versioned.items():
+            st["close"]()
+    flush_log = [
+        entry for _, (_, st) in versioned.items() for entry in st["flushes"]
+    ]
+    mixed_batches = sum(
+        1
+        for key_version, admitted in flush_log
+        if len(admitted) != 1 or admitted[0] != key_version
+    )
+    roll_ok = sum(c["ok"] for c in load_counts)
+    roll_fail = sum(c["fail"] for c in load_counts)
+    roll_avail = (
+        roll_ok / (roll_ok + roll_fail) if (roll_ok + roll_fail) else None
+    )
+    final_versions = {
+        rid: info.get("model_version")
+        for rid, info in rollout_registry.items()
+    }
+
     speedup = (
         round(fleet["images_per_sec"] / single["images_per_sec"], 3)
         if single["images_per_sec"]
@@ -551,6 +777,29 @@ def profile(
             "int8_requests_ok": int(int8_ok),
             "metrics_dtype_gauge": bool(dtype_gauge_lines),
             "metrics_dtype_gauge_lines": dtype_gauge_lines,
+        },
+        "rollout": {
+            "availability": roll_avail,
+            "requests_ok": roll_ok,
+            "requests_failed": roll_fail,
+            "promote_outcome": wave_promote.outcome,
+            "promoted_ok": wave_promote.outcome == "promoted",
+            "rollback_outcome": wave_rollback.outcome,
+            "rollback_reason": wave_rollback.reason,
+            "rolled_back_ok": wave_rollback.outcome == "rolled_back",
+            "flushes": len(flush_log),
+            "version_mixed_batches": int(mixed_batches),
+            "skew_observed": any(len(s) > 1 for s in skew_samples),
+            "skew_samples": len(skew_samples),
+            "final_versions": final_versions,
+            "canary_demotions": router_stats_rollout["canary_demotions"],
+            "metrics_version_gauge": bool(
+                [
+                    line
+                    for line in rollout_prom.splitlines()
+                    if line.startswith("fleet_replica_model_version{")
+                ]
+            ),
         },
         "measured": True,
     }

@@ -994,7 +994,16 @@ def _cmd_serve_impl(args) -> int:
 
         artifact_path = default_artifact_path(cfg, args.workdir)
     engine = InferenceEngine(
-        cfg, model, variables, warmup=True, artifact_path=artifact_path
+        cfg,
+        model,
+        variables,
+        warmup=True,
+        artifact_path=artifact_path,
+        model_version=(
+            str(args.checkpoint_step)
+            if args.checkpoint_step is not None
+            else "0"
+        ),
     )
     stack = contextlib.ExitStack()
     if args.strict or cfg.debug.strict:
@@ -1013,17 +1022,29 @@ def _cmd_serve_impl(args) -> int:
                 "params_dtype": cfg.serving.params_dtype,
                 "params_bytes": engine.params_bytes,
                 "compile_seconds": engine.compile_seconds,
+                "model_version": engine.model_version,
                 "strict": engine.strict is not None,
             },
             indent=2,
         )
     )
+    def _swap_handler(version: str):
+        # POST /swap: load the requested checkpoint step from this
+        # replica's workdir and hot-swap the engine. The engine stages +
+        # validates the new buffer before flipping, so a bad version
+        # errors here and serving continues on the current one.
+        prior = engine.model_version
+        _, new_vars = load_eval_variables(cfg, args.workdir, int(version))
+        engine.swap_params(new_vars, version)
+        return prior
+
     server = make_server(
         engine,
         args.host,
         args.port,
         score_thresh=args.score_thresh,
         replica_id=args.replica_id,
+        swap_handler=_swap_handler if args.workdir else None,
     )
     host, port = server.server_address[:2]
     print(
@@ -1238,6 +1259,117 @@ def cmd_chaos(args) -> int:
     if cleanup:
         shutil.rmtree(workdir, ignore_errors=True)
     return 0
+
+
+def cmd_rollout(args) -> int:
+    """Rolling weight rollout control plane (serving/rollout/): discover
+    checkpoint versions the trainer published to WORKDIR/manifests/
+    (feed.jsonl + manifest scan), validate eligibility BEFORE any
+    replica drains (manifest CRC fields, topology, config hash, int8
+    quant sidecar), then drive a rolling fleet upgrade over --replica
+    URLs: hold/drain one replica, POST /swap, rejoin-gate at the new
+    version, canary-gate the first swapped replica on burn-rate +
+    shadow-diff windows, promote the wave or roll it back first-class."""
+    import dataclasses as _dc
+    import json
+    import os
+    import time
+
+    from replication_faster_rcnn_tpu.config import get_config
+    from replication_faster_rcnn_tpu.serving import fleet as fleet_mod
+    from replication_faster_rcnn_tpu.serving.rollout import (
+        RolloutController,
+        RolloutWatcher,
+        VersionFeed,
+    )
+
+    cfg = get_config(args.config)
+    if args.probe_interval_s is not None:
+        cfg = cfg.replace(
+            fleet=_dc.replace(
+                cfg.fleet, probe_interval_s=args.probe_interval_s
+            )
+        )
+    if args.poll_interval_s is not None:
+        cfg = cfg.replace(
+            rollout=_dc.replace(
+                cfg.rollout, poll_interval_s=args.poll_interval_s
+            )
+        )
+    if args.chaos_spec:
+        from replication_faster_rcnn_tpu.faultlib import failpoints
+
+        failpoints.configure(args.chaos_spec)
+    feed = VersionFeed(
+        args.workdir, config=None if args.no_config_checks else cfg
+    )
+
+    if args.validate_only:
+        verdicts = [feed.validate(step) for step in feed.poll()]
+        print(
+            json.dumps(
+                {
+                    "workdir": feed.workdir,
+                    "versions": [
+                        {
+                            "step": v.step,
+                            "eligible": v.eligible,
+                            "reasons": v.reasons,
+                        }
+                        for v in verdicts
+                    ],
+                },
+                indent=2,
+            )
+        )
+        return 0
+
+    if not args.replica:
+        print("rollout: need at least one --replica URL", file=sys.stderr)
+        return 2
+    registry = fleet_mod.ReplicaRegistry(cfg.fleet)
+    for url in args.replica:
+        registry.add(url, fleet_mod.HTTPReplicaClient(url, url))
+    router = fleet_mod.FleetRouter(registry, cfg.fleet)
+    prober = fleet_mod.Prober(registry, cfg.fleet.probe_interval_s).start()
+    controller = RolloutController(registry, router, cfg, feed=feed)
+    try:
+        if args.watch:
+            log_path = os.path.join(feed.workdir, "rollout.jsonl")
+            watcher = RolloutWatcher(feed, controller, log_path=log_path)
+            watcher.start()
+            print(
+                f"watching {feed.workdir} every "
+                f"{cfg.rollout.poll_interval_s}s for eligible versions "
+                f"(wave log: {log_path}); ctrl-c to stop",
+                flush=True,
+            )
+            try:
+                while True:
+                    time.sleep(60)
+            except KeyboardInterrupt:
+                pass
+            finally:
+                watcher.stop()
+            return 0
+        # one-shot wave (--once is the default mode)
+        if args.step is not None:
+            result = controller.rollout(str(args.step))
+        else:
+            verdict = feed.latest_eligible()
+            if verdict is None:
+                print(
+                    "rollout: no eligible version published under "
+                    f"{feed.workdir} (try --validate-only for reasons)",
+                    file=sys.stderr,
+                )
+                return 1
+            result = controller.rollout(verdict.version, verdict=verdict)
+        print(json.dumps(result.to_dict(), indent=2))
+        return 0 if result.outcome in ("promoted", "noop") else 1
+    finally:
+        prober.stop()
+        router.close()
 
 
 def cmd_viz(args) -> int:
@@ -1788,6 +1920,62 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_chaos.add_argument("--json", action="store_true",
                          help="print the full result record as JSON")
     p_chaos.set_defaults(fn=cmd_chaos)
+
+    p_roll = sub.add_parser(
+        "rollout",
+        help="rolling weight rollout: validate checkpoint versions "
+             "published to WORKDIR/manifests/ (pre-drain eligibility "
+             "gate), then drive a rolling fleet upgrade over --replica "
+             "URLs — drain → hot-swap (POST /swap) → rejoin-gate → "
+             "gated canary promote, with first-class rollback "
+             "(serving/rollout/)",
+    )
+    p_roll.add_argument("--workdir", required=True, metavar="DIR",
+                        help="trainer workdir whose manifests/ feed is "
+                             "the version source (the replicas must "
+                             "serve from the same workdir so POST /swap "
+                             "can load the step)")
+    p_roll.add_argument("--config", default="voc_resnet18",
+                        help="preset the fleet serves (eligibility "
+                             "checks the manifest config hash and, for "
+                             "int8, the quant sidecar against it)")
+    p_roll.add_argument("--no-config-checks", action="store_true",
+                        help="skip the config-hash and int8-sidecar "
+                             "eligibility checks (manifest integrity + "
+                             "topology still judged)")
+    p_roll.add_argument("--replica", action="append", metavar="URL",
+                        help="serving replica base URL (repeatable); "
+                             "each must run `frcnn serve --replica-id "
+                             "... --workdir ...` so /swap is enabled")
+    p_roll.add_argument("--validate-only", action="store_true",
+                        help="print every published version's "
+                             "eligibility verdict as JSON and exit — no "
+                             "replica is touched")
+    p_roll.add_argument("--once", action="store_true",
+                        help="run exactly one rollout wave to the "
+                             "newest eligible version (or --step) and "
+                             "exit; this is the default mode")
+    p_roll.add_argument("--step", type=int, default=None,
+                        help="with --once: roll to this checkpoint step "
+                             "instead of the newest eligible one (still "
+                             "validated first)")
+    p_roll.add_argument("--watch", action="store_true",
+                        help="poll the manifest feed forever "
+                             "(rollout.poll_interval_s) and run a wave "
+                             "per newly eligible version; wave results "
+                             "append to WORKDIR/rollout.jsonl")
+    p_roll.add_argument("--probe-interval-s", type=float, default=None,
+                        help="/healthz probe cadence "
+                             "(fleet.probe_interval_s)")
+    p_roll.add_argument("--poll-interval-s", type=float, default=None,
+                        help="manifest feed poll cadence for --watch "
+                             "(rollout.poll_interval_s)")
+    p_roll.add_argument("--chaos-spec", default=None, metavar="SPEC",
+                        help="arm failpoints (site:kind:prob:seed[:arg])"
+                             " — the rollout sites are rollout.swap "
+                             "(before each per-replica swap RPC) and "
+                             "rollout.promote (at the promote decision)")
+    p_roll.set_defaults(fn=cmd_rollout)
 
     p_viz = sub.add_parser("viz", help="visual sanity artifacts "
                                        "(anchor centers / gt overlay)")

@@ -1093,6 +1093,65 @@ class TelemetryConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class RolloutConfig:
+    """Rolling weight rollout control plane (serving/rollout/).
+
+    The trainer's ``workdir/manifests/`` feed publishes CRC-manifested
+    checkpoint versions; the rollout controller validates eligibility
+    (manifest CRC + topology + quant sidecar, *before* any replica
+    drains), then drives a rolling fleet upgrade through the registry:
+    drain one replica (DRAINING keeps the lease), hot-swap its params,
+    re-admit on `fleet.rejoin_probes` consecutive OKs at the new
+    version. The first upgraded replica lands as CANARY; a windowed
+    burn-rate + shadow-diff gate decides promote vs rollback, and
+    rollback is a first-class reverse rollout.
+    """
+
+    # watcher poll interval over workdir/manifests/
+    poll_interval_s: float = 2.0
+    # how long the controller waits for a held replica's queues to
+    # drain before swapping (simulated clocks make this cheap in tests)
+    drain_timeout_s: float = 10.0
+    # per-replica budget for the swap RPC itself
+    swap_timeout_s: float = 30.0
+    # budget for a swapped replica to re-reach HEALTHY at the new
+    # version before the wave is declared failed and rolled back
+    rejoin_timeout_s: float = 10.0
+    # canary gate: minimum routed canary requests before the windowed
+    # decision may *promote* (rollback triggers need no minimum)
+    canary_min_requests: int = 0
+    # how long the new version must hold CANARY before promotion
+    canary_hold_s: float = 5.0
+    # rollback if shadow_diffs / shadow_requests exceeds this fraction
+    # during the hold window (only when shadow traffic exists)
+    max_shadow_diff_fraction: float = 0.25
+    # require the manifest's config hash to match the serving config
+    # (disable when rolling between intentionally different configs)
+    require_config_hash: bool = True
+    # auto-reverse the wave on canary alarm/demotion; False = hold as
+    # CANARY and leave the decision to the operator
+    auto_rollback: bool = True
+
+    def __post_init__(self):
+        for name in ("poll_interval_s", "drain_timeout_s",
+                     "swap_timeout_s", "rejoin_timeout_s",
+                     "canary_hold_s"):
+            v = getattr(self, name)
+            if v <= 0:
+                raise ValueError(f"rollout.{name} must be > 0, got {v}")
+        if self.canary_min_requests < 0:
+            raise ValueError(
+                "rollout.canary_min_requests must be >= 0, got "
+                f"{self.canary_min_requests}"
+            )
+        if not (0.0 <= self.max_shadow_diff_fraction <= 1.0):
+            raise ValueError(
+                "rollout.max_shadow_diff_fraction must be in [0, 1], "
+                f"got {self.max_shadow_diff_fraction}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class FasterRCNNConfig:
     anchors: AnchorConfig = dataclasses.field(default_factory=AnchorConfig)
     proposals: ProposalConfig = dataclasses.field(default_factory=ProposalConfig)
@@ -1114,6 +1173,7 @@ class FasterRCNNConfig:
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig
     )
+    rollout: RolloutConfig = dataclasses.field(default_factory=RolloutConfig)
 
     def feature_size(self, image_size: Optional[Tuple[int, int]] = None) -> Tuple[int, int]:
         """Spatial size of the stride-16 feature map for a given image size.

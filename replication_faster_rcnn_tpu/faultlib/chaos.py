@@ -38,7 +38,18 @@ Legs:
    (DEAD, out of rotation), it revives, and rejoins after
    ``rejoin_probes`` clean probes.  Same machinery as the real
    ``frcnn fleet`` path (serving/fleet/), minus the processes.
-6. **determinism** — all legs run twice under the same seed; the two
+6. **rollout** — a rolling weight rollout over a 3-replica fleet driven
+   single-threaded (fake clock, injected sleep/probe): an unpublished
+   version is rejected by the pre-drain eligibility gate without
+   touching any replica, then a seeded ``rollout.swap`` drop kills the
+   first wave mid-swap (after hold+drain, before the swap RPC) — the
+   controller must abort the wave, reverse-roll the drained replica,
+   and reconverge the fleet on the old version — and the retry wave
+   (the drop is spent) must hold/drain/swap/rejoin every replica,
+   canary-gate the first one, and land the whole fleet on the new
+   version.  Same machinery as the real ``frcnn rollout`` path
+   (serving/rollout/), minus the processes.
+7. **determinism** — all legs run twice under the same seed; the two
    injected-event logs must match exactly.
 """
 
@@ -98,6 +109,12 @@ def smoke_rules(seed: int) -> List[failpoints.Rule]:
         # its admission to rotation by one round — transient, max_fires=1
         failpoints.Rule(
             "router.probe", "ioerror", 1.0, seed + 6, max_fires=1, after=4
+        ),
+        # rollout leg: the first rollout.swap hit is wave 1's first
+        # replica (post-drain, pre-RPC) — the mid-swap kill. max_fires=1
+        # spends the rule, so the retry wave's three hits pass clean
+        failpoints.Rule(
+            "rollout.swap", "drop", 1.0, seed + 7, max_fires=1
         ),
     ]
 
@@ -459,6 +476,165 @@ def _fleet_router_leg(seed: int) -> Dict[str, Any]:
     }
 
 
+def _rollout_leg(workdir: str, seed: int) -> Dict[str, Any]:
+    import os
+
+    from replication_faster_rcnn_tpu.config import (
+        FasterRCNNConfig,
+        FleetConfig,
+        RolloutConfig,
+    )
+    from replication_faster_rcnn_tpu.serving import fleet as fleet_mod
+    from replication_faster_rcnn_tpu.serving.rollout import (
+        RolloutController,
+        VersionFeed,
+    )
+    from replication_faster_rcnn_tpu.train import fault
+
+    # publish two real versions: manifest + feed line + a step dir, so
+    # the pre-drain eligibility gate judges the same artifacts the
+    # trainer writes (config=None on the feed skips the hash check —
+    # there is no training config in this leg)
+    wd = os.path.join(workdir, "rollout")
+    rng = np.random.RandomState(seed)
+    for step in (1, 2):
+        state = {"params": {"w": rng.rand(4, 4).astype(np.float32)}}
+        os.makedirs(os.path.join(wd, str(step)), exist_ok=True)
+        fault.write_manifest(wd, step, state, None, kind="scheduled")
+        fault.publish_manifest_event(wd, step)
+    feed = VersionFeed(wd, config=None)
+
+    cfg = FasterRCNNConfig().replace(
+        fleet=FleetConfig(
+            hedge=False,
+            probe_interval_s=0.5,
+            lease_timeout_s=2.0,
+            rejoin_probes=2,
+            canary_fraction=0.25,
+            cache_entries=0,
+        ),
+        rollout=RolloutConfig(
+            drain_timeout_s=2.0,
+            swap_timeout_s=5.0,
+            rejoin_timeout_s=10.0,
+            canary_hold_s=1.0,
+            canary_min_requests=0,
+        ),
+    )
+    # fake replicas: a mutable version map + swap/health callables —
+    # LocalReplicaClient's swap() is the same surface the HTTP transport
+    # gives the controller against real `frcnn serve` replicas
+    now = [0.0]
+    versions = {"r0": "1", "r1": "1", "r2": "1"}
+    clients = {
+        rid: fleet_mod.LocalReplicaClient(
+            rid,
+            lambda p: p * 2,
+            health_fn=lambda rid=rid: {
+                "ok": True,
+                "model_version": versions[rid],
+                "bucket_queue_depths": {},
+            },
+            swap_fn=lambda v, rid=rid: versions.__setitem__(rid, v),
+        )
+        for rid in ("r0", "r1", "r2")
+    }
+    registry = fleet_mod.ReplicaRegistry(cfg.fleet, clock=lambda: now[0])
+    for rid, client in clients.items():
+        registry.add(rid, client)
+    for _ in range(cfg.fleet.rejoin_probes):
+        registry.probe_once()
+        now[0] += 0.5
+    _check(
+        registry.in_rotation() == ["r0", "r1", "r2"],
+        f"rollout leg: fleet never admitted: {registry.in_rotation()}",
+    )
+    router = fleet_mod.FleetRouter(registry, cfg.fleet, clock=lambda: now[0])
+    controller = RolloutController(
+        registry,
+        router,
+        cfg,
+        feed=feed,
+        clock=lambda: now[0],
+        sleep=lambda s: now.__setitem__(0, now[0] + s),
+    )
+
+    def _names(result) -> List[str]:
+        return [e["event"] for e in result.events]
+
+    # an unpublished version must be rejected before any replica drains
+    gate = controller.rollout("9")
+    _check(
+        gate.outcome == "ineligible"
+        and _names(gate) == ["wave_ineligible", "wave_done"],
+        f"rollout leg: unpublished version verdict was {gate.outcome!r} "
+        f"with events {_names(gate)}",
+    )
+
+    # wave 1: the seeded rollout.swap drop is the mid-swap kill on the
+    # first (already held + drained) replica — abort, reverse-roll it,
+    # reconverge the fleet on the old version
+    wave1 = controller.rollout("2")
+    _check(
+        wave1.outcome == "aborted"
+        and "injected mid-swap kill" in (wave1.reason or ""),
+        f"rollout leg: wave 1 was {wave1.outcome!r} ({wave1.reason!r}), "
+        "want the injected abort",
+    )
+    _check(
+        _names(wave1)
+        == [
+            "wave_started",
+            "replica_hold",
+            "wave_aborted",
+            "replica_rolled_back",
+            "wave_done",
+        ],
+        f"rollout leg: wave 1 events were {_names(wave1)}",
+    )
+    _check(
+        registry.in_rotation() == ["r0", "r1", "r2"]
+        and set(versions.values()) == {"1"}
+        and set(registry.model_versions().values()) == {"1"},
+        "rollout leg: fleet did not reconverge on the old version after "
+        f"the aborted wave (versions={versions}, "
+        f"rotation={registry.in_rotation()})",
+    )
+
+    # wave 2: the drop is spent — hold/drain/swap/rejoin each replica,
+    # canary-gate the first, promote, finish the wave
+    wave2 = controller.rollout("2")
+    _check(
+        wave2.outcome == "promoted"
+        and wave2.swapped == ["r0", "r1", "r2"],
+        f"rollout leg: retry wave was {wave2.outcome!r} "
+        f"(swapped={wave2.swapped}), want a full promotion",
+    )
+    _check(
+        "canary_promoted" in _names(wave2),
+        f"rollout leg: retry wave skipped the canary gate: {_names(wave2)}",
+    )
+    _check(
+        registry.in_rotation() == ["r0", "r1", "r2"]
+        and set(versions.values()) == {"2"}
+        and set(registry.model_versions().values()) == {"2"},
+        "rollout leg: fleet did not land on the new version "
+        f"(versions={versions}, registry={registry.model_versions()})",
+    )
+    _check(
+        all(registry.role_of(rid) == "serving" for rid in clients),
+        f"rollout leg: a canary role leaked past promotion: "
+        f"{[registry.role_of(rid) for rid in clients]}",
+    )
+    return {
+        "gate": gate.outcome,
+        "wave1": wave1.outcome,
+        "wave1_rolled_back": wave1.rolled_back,
+        "wave2": wave2.outcome,
+        "final_versions": dict(versions),
+    }
+
+
 def _one_pass(workdir: str, seed: int) -> Dict[str, Any]:
     failpoints.configure(smoke_rules(seed))
     try:
@@ -468,6 +644,7 @@ def _one_pass(workdir: str, seed: int) -> Dict[str, Any]:
             "batcher": _batcher_leg(),
             "fleet": _fleet_leg(workdir, seed),
             "fleet_router": _fleet_router_leg(seed),
+            "rollout": _rollout_leg(workdir, seed),
         }
         events = failpoints.event_log()
     finally:

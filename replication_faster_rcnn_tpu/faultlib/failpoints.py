@@ -51,6 +51,13 @@ Sites (see the README failpoint table):
   router.probe         serving/fleet/registry.py per /healthz probe;
                        ``ioerror`` fails the probe (lease keeps aging),
                        ``delay`` stalls it
+  rollout.swap         serving/rollout/controller.py, fired before each
+                       per-replica swap RPC; ``drop``/``ioerror`` abort
+                       the wave mid-swap — the controller must roll the
+                       drained replica back to the prior version
+  rollout.promote      serving/rollout/controller.py, fired at the
+                       windowed promote decision; ``drop``/``ioerror``
+                       force the rollback path instead of promotion
 
 Kinds:
   ioerror      raise ChaosError (an OSError) at the site
@@ -108,6 +115,8 @@ SITES = (
     "heartbeat.beat",
     "router.dispatch",
     "router.probe",
+    "rollout.swap",
+    "rollout.promote",
 )
 
 KINDS = ("ioerror", "torn_write", "crc_corrupt", "nan", "delay", "drop")

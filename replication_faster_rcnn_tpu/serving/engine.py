@@ -136,6 +136,7 @@ class InferenceEngine:
         variables: Any = None,
         warmup: bool = False,
         artifact_path: Optional[str] = None,
+        model_version: str = "0",
     ) -> None:
         from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
         from replication_faster_rcnn_tpu.train.warmup import (
@@ -180,45 +181,32 @@ class InferenceEngine:
             self._serve_name = lambda h, w, n: int8_program_name(
                 serve_program_name(h, w, n)
             )
-            resident = quantize_variables(_plain_dicts(variables), artifact)
         else:
             self._specs = build_serving_specs(config, model=self.model)
             self._serve_name = serve_program_name
-            resident = variables
 
-        # Resident inference state: cast float leaves to the serving dtype
-        # (the same rule build_serving_specs applies to the abstract
-        # variables, so compiled signatures match), then canonicalize the
-        # checkpoint's tree structure to the registry's (dict vs FrozenDict
-        # containers differ across restore paths; the leaves are what
-        # matters) and upload once — explicitly, so a strict-mode transfer
-        # guard engaged around serving never sees this as implicit. The
-        # int8 tree is already built against the artifact's plan; the same
-        # leaf walk then only validates structure against the program.
-        _, abs_args = self._specs[
-            self._serve_name(*self.buckets[0], self.batch_sizes[0])
-        ].build()
-        abs_leaves, abs_treedef = jax.tree_util.tree_flatten(abs_args[0])
-        leaves = jax.tree_util.tree_leaves(resident)
-        if len(leaves) != len(abs_leaves):
-            raise ValueError(
-                f"variables have {len(leaves)} leaves; the serving program "
-                f"expects {len(abs_leaves)} — wrong model/config for this "
-                "checkpoint?"
-            )
-        cast = [
-            leaf
-            if np.dtype(getattr(leaf, "dtype", np.float32)) == a.dtype
-            else np.asarray(leaf).astype(a.dtype)
-            for leaf, a in zip(leaves, abs_leaves)
-        ]
-        self._variables = jax.device_put(
-            jax.tree_util.tree_unflatten(abs_treedef, cast)
-        )
-        # what actually sits on the device for this model (weights +
-        # scales in int8 mode) — the /stats `params_bytes` contract
+        # Versioned residency: `_resident` maps model_version -> the
+        # device-resident tree for that version, and `model_version`
+        # names the version new admissions bind to. `swap_params` stages
+        # a second buffer here and flips the pointer — the
+        # AsyncCheckpointWriter snapshot discipline in reverse: instead
+        # of snapshotting params before the step mutates them, serving
+        # pins each micro-batch to the params it was admitted under.
+        self.model_version = str(model_version)
+        self._version_lock = threading.RLock()
+        self._resident: Dict[str, Any] = {
+            self.model_version: self._build_resident(_plain_dicts(variables))
+        }
+        # what actually sits on the device for the CURRENT version
+        # (weights + scales in int8 mode) — the /stats `params_bytes`
+        # contract
         self.params_bytes = int(
-            sum(x.nbytes for x in jax.tree_util.tree_leaves(self._variables))
+            sum(
+                x.nbytes
+                for x in jax.tree_util.tree_leaves(
+                    self._resident[self.model_version]
+                )
+            )
         )
 
         self._programs: Dict[str, Any] = {}
@@ -274,11 +262,16 @@ class InferenceEngine:
             self.deadline_controller = DeadlineController.from_config(
                 config.serving, max_batch=lambda key: self.batch_sizes[-1]
             )
+        # batcher keys are (model_version, bucket): the admission-time
+        # version is part of the flush key, so a micro-batch can only
+        # ever contain one version — zero version-mixed batches holds by
+        # construction, and a request admitted before a swap is answered
+        # entirely by the version it was admitted under
         self._batcher = MicroBatcher(
             self._process_bucket,
             max_batch=lambda key: self.batch_sizes[-1],
             max_delay_s=(
-                self.deadline_controller.delay_s
+                (lambda key: self.deadline_controller.delay_s(key[1]))
                 if self.deadline_controller is not None
                 else config.serving.max_delay_ms / 1000.0
             ),
@@ -309,7 +302,9 @@ class InferenceEngine:
         for w in waits_s:
             self._queue_wait_hist.observe(w)
         if self.deadline_controller is not None:
-            self.deadline_controller.on_flush(key, waits_s)
+            # the controller learns per BUCKET — strip the version so a
+            # swap doesn't reset the learned deadlines
+            self.deadline_controller.on_flush(key[1], waits_s)
 
     def _collect_gauges(self) -> None:
         self.metrics.gauge(
@@ -323,6 +318,16 @@ class InferenceEngine:
         self.metrics.gauge(
             "serve_uptime_seconds", help="seconds since engine construction"
         ).set(self.uptime_s())
+        # info gauge: the current version's series reads 1, a staged /
+        # draining prior version's reads 0 (retired series stay at 0)
+        with self._version_lock:
+            versions = {v: int(v == self.model_version) for v in self._resident}
+        for v, live in versions.items():
+            self.metrics.gauge(
+                "serve_model_version",
+                help="device-resident model versions (1 = serving now)",
+                model_version=v,
+            ).set(live)
         for bucket, n in self.bucket_queue_depths().items():
             self.metrics.gauge(
                 "serve_bucket_queue_depth",
@@ -367,10 +372,19 @@ class InferenceEngine:
 
     def bucket_queue_depths(self) -> Dict[str, int]:
         """``"HxW" -> submitted-but-unflushed requests`` per bucket (the
-        /healthz per-bucket depth gauge)."""
-        return {
-            f"{k[0]}x{k[1]}": n for k, n in self._batcher.key_depths().items()
-        }
+        /healthz per-bucket depth gauge), summed across the version axis
+        of the batcher key."""
+        out: Dict[str, int] = {}
+        for (_, (h, w)), n in self._batcher.key_depths().items():
+            k = f"{h}x{w}"
+            out[k] = out.get(k, 0) + n
+        return out
+
+    def resident_versions(self) -> Dict[str, bool]:
+        """``version -> is the version new admissions bind to`` for every
+        device-resident buffer (current + any not-yet-retired prior)."""
+        with self._version_lock:
+            return {v: v == self.model_version for v in self._resident}
 
     def uptime_s(self) -> float:
         """Seconds since engine construction (surfaced in /healthz)."""
@@ -414,6 +428,107 @@ class InferenceEngine:
                 f"{rates['short']:.1f}x (5m) / {rates['long']:.1f}x (1h)"
             )
         return None
+
+    # ------------------------------------------------------- versioned params
+
+    def _build_resident(
+        self, variables: Any, artifact_path: Optional[str] = None
+    ) -> Any:
+        """Validate, cast, and upload one version's parameters against
+        the engine's compiled abstract signature.
+
+        Cast float leaves to the serving dtype (the same rule
+        build_serving_specs applies to the abstract variables, so
+        compiled signatures match), canonicalize the checkpoint's tree
+        structure to the registry's (dict vs FrozenDict containers
+        differ across restore paths; the leaves are what matters), and
+        upload explicitly — a strict-mode transfer guard engaged around
+        serving never sees this as implicit. int8 mode re-reads the
+        CRC-verified sidecar on every call (``artifact_path`` overrides
+        the engine's default), so a corrupt sidecar fails HERE — before
+        any serving state is touched — never mid-flush.
+        """
+        if self.params_dtype == "int8":
+            from replication_faster_rcnn_tpu.quant import (
+                load_artifact,
+                quantize_variables,
+            )
+
+            path = artifact_path or self.quant_artifact_path
+            artifact = load_artifact(path)
+            variables = quantize_variables(_plain_dicts(variables), artifact)
+        _, abs_args = self._specs[
+            self._serve_name(*self.buckets[0], self.batch_sizes[0])
+        ].build()
+        abs_leaves, abs_treedef = jax.tree_util.tree_flatten(abs_args[0])
+        leaves = jax.tree_util.tree_leaves(variables)
+        if len(leaves) != len(abs_leaves):
+            raise ValueError(
+                f"variables have {len(leaves)} leaves; the serving program "
+                f"expects {len(abs_leaves)} — wrong model/config for this "
+                "checkpoint?"
+            )
+        cast = [
+            leaf
+            if np.dtype(getattr(leaf, "dtype", np.float32)) == a.dtype
+            else np.asarray(leaf).astype(a.dtype)
+            for leaf, a in zip(leaves, abs_leaves)
+        ]
+        return jax.device_put(
+            jax.tree_util.tree_unflatten(abs_treedef, cast)
+        )
+
+    @property
+    def _variables(self) -> Any:
+        """The CURRENT version's device tree (legacy accessor — flush
+        dispatch resolves per-batch via the version in the flush key)."""
+        with self._version_lock:
+            return self._resident[self.model_version]
+
+    def swap_params(
+        self,
+        variables: Any,
+        version: str,
+        artifact_path: Optional[str] = None,
+    ) -> str:
+        """Hot-swap serving to ``version``; returns the prior version.
+
+        Stages a second device-resident buffer (validated + uploaded
+        BEFORE any serving state changes — a bad checkpoint or corrupt
+        int8 sidecar raises here and the engine keeps serving the old
+        version untouched), then atomically redirects admission under
+        the version lock. In-flight micro-batches drain against the
+        buffer named by their flush key: the flip lands exactly at a
+        micro-batch flush boundary and no request ever crosses it.
+
+        The prior version's buffer stays resident until the NEXT swap
+        (instant rollback target); older drained buffers are retired
+        then. Programs are version-independent (same shapes/dtypes), so
+        a swap never recompiles and banked fingerprints are unaffected.
+        """
+        version = str(version)
+        staged = self._build_resident(
+            _plain_dicts(variables), artifact_path=artifact_path
+        )
+        with self._version_lock:
+            prior = self.model_version
+            self._resident[version] = staged
+            self.model_version = version
+            if artifact_path is not None and self.params_dtype == "int8":
+                self.quant_artifact_path = artifact_path
+            self.params_bytes = int(
+                sum(x.nbytes for x in jax.tree_util.tree_leaves(staged))
+            )
+            # retire drained buffers — never `prior` (rollback target,
+            # and its admitted-but-unflushed batches still name it)
+            pending = {k[0] for k in self._batcher.key_depths()}
+            for v in [
+                v
+                for v in self._resident
+                if v not in (version, prior) and v not in pending
+            ]:
+                del self._resident[v]
+        return prior
 
     # ------------------------------------------------------------ programs
 
@@ -537,9 +652,14 @@ class InferenceEngine:
         admission rejection (``queue.Full`` under ``timeout``) is counted
         as shed before it propagates to the caller's 503."""
         ttl = self.config.serving.request_timeout_s
+        with self._version_lock:
+            # bind the request to the CURRENT version at admission time;
+            # the (version, bucket) key pins its whole micro-batch to
+            # that version's resident buffer
+            key = (self.model_version, bucket)
         try:
             return self._batcher.submit(
-                bucket,
+                key,
                 entry,
                 timeout=timeout,
                 deadline_s=ttl if ttl > 0 else None,
@@ -556,11 +676,20 @@ class InferenceEngine:
 
     # ---------------------------------------------------------------- flush
 
-    def _process_bucket(self, bucket, items):
+    def _process_bucket(self, key, items):
         """One micro-batch: pad to the smallest compiled batch size,
-        dispatch the bucket's AOT program, un-pad, de-normalize boxes."""
+        dispatch the bucket's AOT program against the version the batch
+        was admitted under, un-pad, de-normalize boxes."""
+        version, bucket = key
+        with self._version_lock:
+            variables = self._resident.get(version)
+        if variables is None:
+            raise RuntimeError(
+                f"model version {version!r} was retired with requests in "
+                f"flight (resident: {sorted(self._resident)})"
+            )
         try:
-            out = self._process_bucket_inner(bucket, items)
+            out = self._process_bucket_inner(bucket, items, variables)
             for _ in items:
                 self.slo.record(True)
             return out
@@ -573,7 +702,7 @@ class InferenceEngine:
                 self.slo.record(False)
             raise
 
-    def _process_bucket_inner(self, bucket, items):
+    def _process_bucket_inner(self, bucket, items, variables):
         # entries are (image, orig_h, orig_w[, trace]); the trace slot is
         # optional so callers that build items by hand keep working
         h, w = bucket
@@ -591,7 +720,7 @@ class InferenceEngine:
             "serve/flush", cat="serve", program=name, n=n, padded=bn - n
         ):
             with self._strict_dispatch(name):
-                out = program(self._variables, jax.device_put(batch))
+                out = program(variables, jax.device_put(batch))
             out = jax.device_get(out)
         flush_s = time.perf_counter() - t_wall
         dur_dispatch = flush_s * 1e6

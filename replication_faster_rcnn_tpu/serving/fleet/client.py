@@ -55,10 +55,12 @@ class LocalReplicaClient:
         replica_id: str,
         predict_fn: Callable[[Any], Any],
         health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        swap_fn: Optional[Callable[[str], Any]] = None,
     ) -> None:
         self.replica_id = replica_id
         self._predict_fn = predict_fn
         self._health_fn = health_fn
+        self._swap_fn = swap_fn
         # flipped by the router's kill hook (dispatch threads) and by
         # test/benchmark control code — one lock covers the switch
         self._lock = threading.Lock()
@@ -91,11 +93,26 @@ class LocalReplicaClient:
             return {"ok": True}
         return self._health_fn()
 
+    def swap(self, version: str, timeout_s: float = 30.0) -> Any:
+        """Hot-swap the replica's weights to ``version`` (the rollout
+        controller's per-replica RPC). Raises on a replica with no swap
+        path — the controller treats that as a failed wave."""
+        self._check_alive()
+        if self._swap_fn is None:
+            raise RuntimeError(
+                f"replica {self.replica_id!r} has no swap endpoint"
+            )
+        return self._swap_fn(str(version))
 
-def engine_client(replica_id: str, engine) -> LocalReplicaClient:
+
+def engine_client(
+    replica_id: str, engine, loader: Optional[Callable[[str], Any]] = None
+) -> LocalReplicaClient:
     """A :class:`LocalReplicaClient` over a live InferenceEngine: the
     payload is an image array (the ``engine.submit`` contract), the
-    health dict mirrors what server.py's /healthz reports."""
+    health dict mirrors what server.py's /healthz reports. ``loader``
+    maps a model version string to inference variables; when given, the
+    client supports ``swap()`` via ``engine.swap_params``."""
 
     def _predict(payload):
         # bounded end-to-end: admission may block briefly, the result
@@ -113,9 +130,15 @@ def engine_client(replica_id: str, engine) -> LocalReplicaClient:
             "bucket_queue_depths": engine.bucket_queue_depths(),
             "params_dtype": engine.params_dtype,
             "params_bytes": engine.params_bytes,
+            "model_version": engine.model_version,
         }
 
-    return LocalReplicaClient(replica_id, _predict, _health)
+    swap_fn = None
+    if loader is not None:
+        def swap_fn(version):
+            return engine.swap_params(loader(version), version)
+
+    return LocalReplicaClient(replica_id, _predict, _health, swap_fn=swap_fn)
 
 
 class HTTPReplicaClient:
@@ -178,4 +201,26 @@ class HTTPReplicaClient:
         except (urllib.error.URLError, TimeoutError, OSError) as e:
             raise ReplicaDown(
                 f"replica {self.replica_id!r} healthz unreachable: {e}"
+            ) from e
+
+    def swap(self, version: str, timeout_s: float = 30.0) -> Any:
+        """POST /swap — ask the replica to hot-swap to ``version``."""
+        body = json.dumps({"version": str(version)}).encode()
+        req = urllib.request.Request(
+            f"{self.base_url}/swap",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:200]
+            raise RuntimeError(
+                f"replica {self.replica_id!r} swap returned {e.code}: "
+                f"{detail}"
+            ) from e
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            raise ReplicaDown(
+                f"replica {self.replica_id!r} swap unreachable: {e}"
             ) from e

@@ -21,6 +21,12 @@ States::
 
 A replica probing ``degraded: true`` is parked in DRAINING as well —
 alive (its lease renews) but routed around until it reports clean.
+The rollout controller (serving/rollout/) parks replicas the same way
+via :meth:`ReplicaRegistry.hold`: a *held* replica sits in DRAINING
+with a renewing lease and cannot re-enter rotation until
+:meth:`ReplicaRegistry.release` — clean probes accumulate but the
+HEALTHY promotion is gated on the hold, so a mid-swap replica can
+never take traffic no matter how healthy it looks.
 
 The :class:`Prober` drives ``probe_once`` on a cadence from its own
 thread (non-daemon, Event-stopped, joined — it may run forever but must
@@ -82,6 +88,14 @@ class Replica:
         # (float32 | bfloat16 | int8) — mixed-precision fleets surface
         # it per replica in /stats and /metrics
         self.params_dtype: Optional[str] = None
+        # model version the replica last reported via /healthz — the
+        # rollout controller's convergence signal, and the version-skew
+        # view in /stats + the fleet_replica_model_version info gauge
+        self.model_version: Optional[str] = None
+        # a held replica is parked in DRAINING by the rollout controller
+        # (registry-side — the replica itself probes healthy) and cannot
+        # re-enter rotation until release(), whatever its probes say
+        self.held = False
 
 
 class ReplicaRegistry:
@@ -142,6 +156,7 @@ class ReplicaRegistry:
         for replica_id, client in targets:
             ok, draining, degraded, detail = False, False, False, None
             params_dtype = None
+            model_version = None
             try:
                 failpoints.fire("router.probe", replica=replica_id)
                 health = client.healthz(timeout_s=timeout)
@@ -149,6 +164,7 @@ class ReplicaRegistry:
                 draining = bool(health.get("draining", False))
                 degraded = bool(health.get("degraded", False))
                 params_dtype = health.get("params_dtype")
+                model_version = health.get("model_version")
                 if degraded:
                     detail = health.get("degraded_reason") or "degraded"
                 elif draining:
@@ -157,7 +173,7 @@ class ReplicaRegistry:
                 detail = f"probe failed: {type(e).__name__}: {e}"
             self._note_probe(
                 replica_id, ok, draining, degraded, detail,
-                params_dtype=params_dtype,
+                params_dtype=params_dtype, model_version=model_version,
             )
 
     def _note_probe(
@@ -168,6 +184,7 @@ class ReplicaRegistry:
         degraded: bool,
         detail: Optional[str],
         params_dtype: Optional[str] = None,
+        model_version: Optional[str] = None,
     ) -> None:
         now = self._clock()
         with self._lock:
@@ -180,6 +197,10 @@ class ReplicaRegistry:
                 # keep the last reported dtype across failed probes — a
                 # dead replica's residency does not change by dying
                 rep.params_dtype = str(params_dtype)
+            if model_version is not None:
+                # same rule: the last reported version sticks until a
+                # successful probe reports a different one
+                rep.model_version = str(model_version)
             if not ok:
                 rep.failed_probes += 1
                 rep.consecutive_ok = 0
@@ -203,6 +224,7 @@ class ReplicaRegistry:
                 rep.consecutive_ok += 1
                 if (
                     rep.state != HEALTHY
+                    and not rep.held
                     and rep.consecutive_ok >= self._config.rejoin_probes
                 ):
                     self._events.append(
@@ -230,6 +252,63 @@ class ReplicaRegistry:
             )
             rep.state = DEAD
             rep.consecutive_ok = 0
+
+    # ------------------------------------------------------------- rollout
+
+    def hold(self, replica_id: str, reason: Optional[str] = None) -> None:
+        """Park a replica in DRAINING under a registry-side hold (the
+        rollout controller's drain step). The replica keeps probing
+        healthy — its lease renews as usual, DRAINING keeps the lease —
+        but it cannot be promoted back to HEALTHY until :meth:`release`,
+        however many clean probes it accumulates."""
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None:
+                raise KeyError(f"unknown replica {replica_id!r}")
+            if rep.held:
+                return
+            rep.held = True
+            rep.consecutive_ok = 0
+            rep.detail = reason or "held for rollout"
+            if rep.state == HEALTHY:
+                rep.state = DRAINING
+            self._events.append(
+                {
+                    "event": "replica_held",
+                    "replica": replica_id,
+                    "reason": reason,
+                }
+            )
+
+    def release(self, replica_id: str) -> None:
+        """Lift a rollout hold. The replica does NOT re-enter rotation
+        here: its consecutive-OK streak restarts, so it must pass the
+        same ``rejoin_probes`` gate as any recovering replica — now at
+        whatever version it reports post-swap."""
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None:
+                raise KeyError(f"unknown replica {replica_id!r}")
+            if not rep.held:
+                return
+            rep.held = False
+            rep.consecutive_ok = 0
+            self._events.append(
+                {"event": "replica_released", "replica": replica_id}
+            )
+
+    def model_version_of(self, replica_id: str) -> Optional[str]:
+        with self._lock:
+            return self._replicas[replica_id].model_version
+
+    def model_versions(self) -> Dict[str, Optional[str]]:
+        """``replica_id -> last reported model version`` — the fleet's
+        version-skew view during a rolling upgrade."""
+        with self._lock:
+            return {
+                rep.replica_id: rep.model_version
+                for rep in self._replicas.values()
+            }
 
     # ---------------------------------------------------------------- reads
 
@@ -294,6 +373,8 @@ class ReplicaRegistry:
                     "lease_age_s": round(self._clock() - rep.last_ok, 3),
                     "detail": rep.detail,
                     "params_dtype": rep.params_dtype,
+                    "model_version": rep.model_version,
+                    "held": rep.held,
                 }
                 for rep in self._replicas.values()
             }

@@ -277,6 +277,23 @@ class FleetRouter:
                     replica=rid,
                     params_dtype=dtype,
                 ).set(1)
+            version = info.get("model_version")
+            if version:
+                # version-skew view during a rolling rollout: each
+                # replica's current version as a label series (1 = the
+                # version it reports now, stale series drop to 0)
+                for c in self.metrics.find("fleet_replica_model_version"):
+                    if (
+                        c.labels.get("replica") == rid
+                        and c.labels.get("model_version") != version
+                    ):
+                        c.set(0)
+                self.metrics.gauge(
+                    "fleet_replica_model_version",
+                    help="replica resident model version (info gauge)",
+                    replica=rid,
+                    model_version=version,
+                ).set(1)
 
     def _replica_counter(self, replica_id: str, outcome: str):
         return self.metrics.counter(
@@ -528,6 +545,25 @@ class FleetRouter:
             tspans.current_tracer().instant(
                 "fleet/canary_demoted", cat="fleet", replica=replica_id
             )
+
+    def canary_report(self, replica_id: str) -> Dict[str, Any]:
+        """The rollout controller's promote/rollback evidence for one
+        canary: its private burn-tracker snapshot (or ``None`` before
+        any canary traffic landed), the routed canary request count,
+        and the fleet-wide shadow-diff counters over the same period."""
+        with self._lock:
+            tracker = self._canary_slo.get(replica_id)
+        counters = {
+            k: int(v)
+            for k, v in self.metrics.counters_flat().items()
+            if "{" not in k
+        }
+        return {
+            "slo": tracker.snapshot() if tracker is not None else None,
+            "canary_requests": counters.get("fleet_canary_requests_total", 0),
+            "shadow_requests": counters.get("fleet_shadow_requests_total", 0),
+            "shadow_diffs": counters.get("fleet_shadow_diffs_total", 0),
+        }
 
     def _dispatch_sequential(
         self,

@@ -83,6 +83,12 @@ class _FleetHandler(BaseHTTPRequestHandler):
                     "draining": bool(getattr(self.server, "draining", False)),
                     "in_rotation": sorted(in_rotation),
                     "replicas": snap["registry"],
+                    # version-skew at a glance: replica -> last reported
+                    # model version (None before its first clean probe)
+                    "model_versions": {
+                        rid: r.get("model_version")
+                        for rid, r in snap["registry"].items()
+                    },
                 },
             )
         elif self.path == "/stats":

@@ -34,6 +34,9 @@ Endpoints:
                  the replica's structured sections (stats, queue depths,
                  compile_seconds, slo)
   GET  /metrics  the same registry in Prometheus text exposition format
+  POST /swap     {"version": "<step>"} — hot-swap weights to a checkpoint
+                 version via the server's swap_handler (501 without one);
+                 in-flight requests finish on their admission version
 """
 
 from __future__ import annotations
@@ -118,6 +121,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "batch_sizes": list(engine.batch_sizes),
                     "params_dtype": engine.params_dtype,
                     "params_bytes": engine.params_bytes,
+                    "model_version": engine.model_version,
                 },
             )
         elif self.path == "/stats":
@@ -128,6 +132,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "compile_seconds": dict(engine.compile_seconds),
                 "params_dtype": engine.params_dtype,
                 "params_bytes": engine.params_bytes,
+                "model_version": engine.model_version,
+                "resident_versions": engine.resident_versions(),
                 "slo": engine.slo.snapshot(),
             }
             if engine.deadline_controller is not None:
@@ -146,6 +152,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self) -> None:  # noqa: N802 - stdlib casing
+        if self.path == "/swap":
+            self._handle_swap()
+            return
         if self.path != "/predict":
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
@@ -178,6 +187,42 @@ class _Handler(BaseHTTPRequestHandler):
                     cat="serve",
                     **trace.span_args(),
                 )
+
+    def _handle_swap(self) -> None:
+        """POST /swap {"version": "<step>"} — hot-swap the engine to a
+        new model version via the server's ``swap_handler`` (wired by
+        `frcnn serve --workdir`; 501 when the replica has no checkpoint
+        source to swap from). In-flight requests finish on the version
+        they were admitted under; the response reports both versions."""
+        engine = self.server.engine
+        handler = getattr(self.server, "swap_handler", None)
+        if handler is None:
+            self._reply(
+                501, {"error": "this replica has no swap handler configured"}
+            )
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            version = str(req["version"])
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"need a \"version\": {e}"})
+            return
+        try:
+            prior = handler(version)
+        except Exception as e:  # noqa: BLE001 - surfaced to the controller
+            self._reply(
+                500, {"error": f"swap failed: {type(e).__name__}: {e}"}
+            )
+            return
+        self._reply(
+            200,
+            {
+                "ok": True,
+                "model_version": engine.model_version,
+                "prior_version": prior,
+            },
+        )
 
     def _handle_predict(self, trace) -> None:
         engine = self.server.engine
@@ -298,17 +343,21 @@ def make_server(
     port: int = 8008,
     score_thresh: Optional[float] = None,
     replica_id: Optional[str] = None,
+    swap_handler=None,
 ) -> ThreadingHTTPServer:
     """A ready-to-``serve_forever`` HTTP server bound to ``engine``.
     ``port=0`` binds a free port (read ``server.server_address``).
     ``replica_id`` names this replica in /healthz for fleet membership;
     setting ``server.draining = True`` (the SIGTERM grace window) makes
     /healthz advertise it so the fleet router stops routing here before
-    the listener closes."""
+    the listener closes. ``swap_handler(version) -> prior_version``
+    enables POST /swap (rolling weight rollout); without one the
+    endpoint answers 501."""
     server = ThreadingHTTPServer((host, port), _Handler)
     server.engine = engine
     server.replica_id = replica_id
     server.draining = False
+    server.swap_handler = swap_handler
     server.score_thresh = (
         engine.config.eval.score_thresh if score_thresh is None else score_thresh
     )

@@ -473,6 +473,42 @@ def load_manifest(workdir: str, step: int) -> Optional[Dict[str, Any]]:
     return manifest
 
 
+FEED_BASENAME = "feed.jsonl"
+
+
+def feed_path(workdir: str) -> str:
+    return os.path.join(
+        os.path.abspath(workdir), MANIFEST_DIRNAME, FEED_BASENAME
+    )
+
+
+def publish_manifest_event(
+    workdir: str, step: int, kind: str = "scheduled", writer: str = "sync"
+) -> None:
+    """Append one line to ``manifests/feed.jsonl`` — the rollout feed.
+
+    The manifest files themselves are the versions; this append-only log
+    records *publication order* so the serving-side watcher
+    (serving/rollout/) can tail it instead of re-scanning and
+    re-validating every manifest per poll, and so a step that is later
+    pruned still leaves a publication record. Best-effort: a failed
+    append never fails the save that produced the checkpoint (the
+    watcher falls back to directory scans)."""
+    event = {
+        "step": int(step),
+        "kind": kind,
+        "writer": writer,
+        "published_utc": datetime.now(timezone.utc).isoformat(),
+    }
+    try:
+        path = feed_path(workdir)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(event, sort_keys=True) + "\n")
+    except OSError:  # pragma: no cover - best-effort publication
+        pass
+
+
 def prune_manifests(workdir: str, live_steps) -> None:
     """Drop manifests whose checkpoints orbax has garbage-collected."""
     d = os.path.join(os.path.abspath(workdir), MANIFEST_DIRNAME)

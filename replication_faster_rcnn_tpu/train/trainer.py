@@ -646,6 +646,11 @@ class Trainer:
                 workdir, step, host_state, config,
                 kind="scheduled", writer="async", topology=topology,
             )
+            # rollout feed: announce the new version to serving-side
+            # watchers (serving/rollout/) AFTER the manifest is durable
+            fault.publish_manifest_event(
+                workdir, step, kind="scheduled", writer="async"
+            )
             fault.prune_manifests(workdir, mgr.all_steps())
             if inj is not None and inj.kind in ("torn_write", "crc_corrupt"):
                 failpoints.apply_file_fault(
@@ -716,6 +721,11 @@ class Trainer:
                 fault.write_manifest(
                     self.workdir, step, host_state, self.config, kind=kind,
                     topology=self._topology,
+                )
+                # rollout feed: announce the new version to serving-side
+                # watchers once the manifest is durable
+                fault.publish_manifest_event(
+                    self.workdir, step, kind=kind, writer="sync"
                 )
                 fault.prune_manifests(
                     self.workdir, self.checkpoint_manager.all_steps()

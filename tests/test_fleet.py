@@ -762,6 +762,108 @@ class TestCanaryAutoDemote:
         assert router.stats["canary_demotions"] == 0
 
 
+class TestRoleTransitionsUnderProbeRace:
+    """The router's CANARY auto-demote racing a rollout-driven DRAINING
+    hold. A demoted role must survive held probes, release, and the
+    rejoin gate — nothing in the probe state machine may resurrect
+    CANARY, however the prober ticks interleave."""
+
+    def _held_canary(self):
+        now = [0.0]
+        client = LocalReplicaClient("canary0", lambda p: "canary0")
+        reg = ReplicaRegistry(
+            _cfg(lease_timeout_s=600.0), clock=lambda: now[0]
+        )
+        reg.add("canary0", client, role=CANARY)
+        reg.probe_once(), reg.probe_once()
+        assert reg.in_rotation(CANARY) == ["canary0"]
+        return reg, now
+
+    def test_demotion_during_hold_sticks_through_rejoin(self):
+        reg, now = self._held_canary()
+        reg.hold("canary0", reason="rollout to 2")  # rollout drains it
+        assert reg.state_of("canary0") == DRAINING
+        assert reg.role_of("canary0") == CANARY  # a hold is not demotion
+        now[0] += 0.5
+        reg.probe_once()  # prober tick lands mid-hold
+        # the router's burn-rate alarm demotes the held canary
+        reg.set_role("canary0", SERVING, reason="slo burn-rate alarm")
+        for _ in range(4):  # clean held probes: role AND state pinned
+            now[0] += 0.5
+            reg.probe_once()
+            assert reg.role_of("canary0") == SERVING
+            assert reg.state_of("canary0") == DRAINING
+        reg.release("canary0")
+        reg.probe_once(), reg.probe_once()  # the rejoin_probes gate
+        assert reg.state_of("canary0") == HEALTHY
+        assert reg.role_of("canary0") == SERVING  # NOT resurrected
+        assert reg.in_rotation(CANARY) == []
+        assert reg.in_rotation() == ["canary0"]
+        role_events = [
+            e for e in reg.events()
+            if e["event"] == "replica_role_changed"
+        ]
+        assert [(e["from"], e["to"]) for e in role_events] == [
+            (CANARY, SERVING)
+        ]
+
+    def test_concurrent_demotes_and_probes_record_one_transition(self):
+        """Eight demoters firing into four live prober threads must
+        produce exactly ONE role transition — set_role's unchanged-role
+        no-op makes the demote idempotent under any interleaving."""
+        reg, _ = self._held_canary()
+        reg.hold("canary0", reason="rollout to 2")
+        stop = threading.Event()
+
+        def _probe_loop():
+            while not stop.is_set():
+                reg.probe_once()
+
+        probers = [
+            threading.Thread(target=_probe_loop) for _ in range(4)
+        ]
+        for t in probers:
+            t.start()
+        barrier = threading.Barrier(8)
+
+        def _demote():
+            barrier.wait()  # maximize the set_role collision window
+            reg.set_role("canary0", SERVING, reason="slo burn-rate alarm")
+
+        demoters = [threading.Thread(target=_demote) for _ in range(8)]
+        try:
+            for t in demoters:
+                t.start()
+            for t in demoters:
+                t.join()
+            assert reg.role_of("canary0") == SERVING
+            assert reg.state_of("canary0") == DRAINING  # still held
+            reg.release("canary0")
+            deadline = time.monotonic() + 10.0
+            while (
+                reg.state_of("canary0") != HEALTHY
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.001)
+        finally:
+            stop.set()
+            for t in probers:
+                t.join()
+        assert reg.state_of("canary0") == HEALTHY
+        assert reg.role_of("canary0") == SERVING
+        role_events = [
+            e for e in reg.events()
+            if e["event"] == "replica_role_changed"
+        ]
+        assert [(e["from"], e["to"]) for e in role_events] == [
+            (CANARY, SERVING)
+        ]
+        events = [e["event"] for e in reg.events()]
+        assert events.count("replica_held") == 1
+        assert events.count("replica_released") == 1
+        assert events.count("replica_joined") == 2  # admit + rejoin
+
+
 # ------------------------------------------------------------- HTTP front
 
 

@@ -1,0 +1,758 @@
+"""Rolling weight rollout (ISSUE 18 tentpole): versioned train→serve
+control plane — feed eligibility, engine hot-swap, registry holds, the
+wave controller, and the feed watcher.
+
+The VersionFeed tests exercise the real trainer manifest surface
+(train/fault.py writes the same files `frcnn train` does); the engine
+tests run a real 32x32 resnet18 engine (the test_serving live idiom)
+because the hot-swap transparency pin is a bitwise claim about compiled
+programs.  Everything fleet-shaped runs on LocalReplicaClient fakes
+with injected clocks — the controller's `sleep` seam advances the same
+fake clock the registry leases read, so waves are deterministic and
+instant.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from replication_faster_rcnn_tpu.config import (
+    DataConfig,
+    EvalConfig,
+    FasterRCNNConfig,
+    FleetConfig,
+    MeshConfig,
+    ModelConfig,
+    ProposalConfig,
+    ROITargetConfig,
+    RolloutConfig,
+    ServingConfig,
+    TrainConfig,
+)
+from replication_faster_rcnn_tpu.faultlib import failpoints
+from replication_faster_rcnn_tpu.serving.fleet import (
+    LocalReplicaClient,
+    ReplicaRegistry,
+)
+from replication_faster_rcnn_tpu.serving.fleet.registry import (
+    CANARY,
+    DEAD,
+    DRAINING,
+    HEALTHY,
+    SERVING,
+)
+from replication_faster_rcnn_tpu.serving.rollout import (
+    Eligibility,
+    RolloutController,
+    RolloutWatcher,
+    VersionFeed,
+)
+from replication_faster_rcnn_tpu.telemetry.metrics import MetricsRegistry
+from replication_faster_rcnn_tpu.train import fault
+
+
+def _publish(wd, step, config=None, publish=True, step_dir=True):
+    """One trainer-shaped version: step dir + manifest (+ feed line)."""
+    rng = np.random.RandomState(step)
+    state = {"params": {"w": rng.rand(4, 4).astype(np.float32)}}
+    if step_dir:
+        os.makedirs(os.path.join(wd, str(step)), exist_ok=True)
+    fault.write_manifest(wd, step, state, config, kind="scheduled")
+    if publish:
+        fault.publish_manifest_event(wd, step)
+
+
+def _manifest_path(wd, step):
+    return os.path.join(wd, fault.MANIFEST_DIRNAME, f"{step}.json")
+
+
+# ------------------------------------------------------------ version feed
+
+
+class TestVersionFeed:
+    def test_poll_feed_order_then_scan_merge(self, tmp_path):
+        wd = str(tmp_path)
+        _publish(wd, 3)
+        _publish(wd, 1)
+        _publish(wd, 2, publish=False)  # manifest the feed missed
+        feed = VersionFeed(wd, config=None)
+        # publication order first, scan-merged strays after (ascending)
+        assert feed.poll() == [3, 1, 2]
+
+    def test_torn_feed_lines_skipped(self, tmp_path):
+        wd = str(tmp_path)
+        _publish(wd, 1)
+        with open(fault.feed_path(wd), "a") as f:
+            f.write('{"truncated": tr\n')  # torn append mid-write
+            f.write('{"kind": "scheduled"}\n')  # no step field
+            f.write("\n")
+        _publish(wd, 2)
+        assert VersionFeed(wd, config=None).poll() == [1, 2]
+
+    def test_validate_accepts_published_version(self, tmp_path):
+        wd = str(tmp_path)
+        _publish(wd, 7)
+        verdict = VersionFeed(wd, config=None).validate(7)
+        assert verdict.eligible and verdict.reasons == []
+        assert verdict.version == "7"
+        assert verdict.manifest["step"] == 7
+
+    def test_missing_manifest_ineligible(self, tmp_path):
+        verdict = VersionFeed(str(tmp_path), config=None).validate(99)
+        assert not verdict.eligible
+        assert "manifest missing" in verdict.reasons[0]
+
+    def test_tampered_leaf_count_rejected(self, tmp_path):
+        wd = str(tmp_path)
+        _publish(wd, 5)
+        path = _manifest_path(wd, 5)
+        with open(path) as f:
+            doc = json.load(f)
+        doc["leaf_count"] = doc["leaf_count"] + 1
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        verdict = VersionFeed(wd, config=None).validate(5)
+        assert not verdict.eligible
+        assert any("leaf_count" in r for r in verdict.reasons)
+
+    def test_pruned_step_dir_rejected(self, tmp_path):
+        wd = str(tmp_path)
+        _publish(wd, 4, step_dir=False)
+        verdict = VersionFeed(wd, config=None).validate(4)
+        assert not verdict.eligible
+        assert any("no checkpoint step directory" in r for r in verdict.reasons)
+
+    def test_config_hash_gate(self, tmp_path):
+        wd = str(tmp_path)
+        trained = FasterRCNNConfig()
+        _publish(wd, 1, config=trained)
+        # same config: eligible
+        assert VersionFeed(wd, config=trained).validate(1).eligible
+        # different config: the hash gate rejects ...
+        other = trained.replace(
+            model=dataclasses.replace(trained.model, backbone="resnet50")
+        )
+        verdict = VersionFeed(wd, config=other).validate(1)
+        assert not verdict.eligible
+        assert any("config hash" in r for r in verdict.reasons)
+        # ... unless the operator opted out
+        relaxed = other.replace(
+            rollout=RolloutConfig(require_config_hash=False)
+        )
+        assert VersionFeed(wd, config=relaxed).validate(1).eligible
+
+    def _int8_config(self):
+        base = FasterRCNNConfig()
+        return base.replace(
+            serving=dataclasses.replace(base.serving, params_dtype="int8")
+        )
+
+    def test_int8_missing_sidecar_rejected(self, tmp_path):
+        wd = str(tmp_path)
+        _publish(wd, 1)
+        verdict = VersionFeed(wd, config=self._int8_config()).validate(1)
+        assert not verdict.eligible
+        assert any(
+            r.startswith("int8 quant sidecar rejected") for r in verdict.reasons
+        )
+
+    def test_int8_corrupt_sidecar_rejected(self, tmp_path):
+        from replication_faster_rcnn_tpu.quant import save_artifact
+
+        wd = str(tmp_path)
+        _publish(wd, 1)
+        path = os.path.join(wd, "quant_artifact.json")
+        save_artifact(
+            path,
+            {
+                "activation_ranges": {"a": 1.0},
+                "groups": {"g": ["p"]},
+                "plan": {"g": "int8"},
+                "weight_scales": {"p": np.ones((2,), np.float32)},
+            },
+        )
+        feed = VersionFeed(wd, config=self._int8_config())
+        assert feed.validate(1).eligible  # intact sidecar passes
+        with open(path) as f:
+            doc = json.load(f)
+        doc["weight_scales"]["p"]["crc32"] ^= 1  # flip one CRC bit
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        verdict = feed.validate(1)
+        assert not verdict.eligible
+        assert any("int8 quant sidecar rejected" in r for r in verdict.reasons)
+        assert any("CRC mismatch" in r for r in verdict.reasons)
+
+    def test_corrupt_sidecar_blocks_wave_before_any_drain(self, tmp_path):
+        """Satellite: an int8 fleet must reject the version at the feed
+        gate — no replica drains for a sidecar that cannot be served."""
+        wd = str(tmp_path)
+        _publish(wd, 1)  # no sidecar at all: hardest rejection
+        feed = VersionFeed(wd, config=self._int8_config())
+        fl = _fake_fleet(feed=feed)
+        result = fl["controller"].rollout("1")
+        assert result.outcome == "ineligible"
+        assert "int8 quant sidecar rejected" in result.reason
+        assert [e["event"] for e in result.events] == [
+            "wave_ineligible",
+            "wave_done",
+        ]
+        snap = fl["registry"].snapshot()
+        assert all(not info["held"] for info in snap.values())
+        assert all(info["state"] == HEALTHY for info in snap.values())
+
+    def test_latest_eligible_and_after_cursor(self, tmp_path):
+        wd = str(tmp_path)
+        _publish(wd, 1)
+        _publish(wd, 2)
+        feed = VersionFeed(wd, config=None)
+        assert feed.latest_eligible().step == 2
+        assert feed.latest_eligible(after=2) is None
+        # newest ineligible: the feed falls back to the best older one
+        _publish(wd, 3, step_dir=False)
+        assert feed.latest_eligible().step == 2
+
+
+# -------------------------------------------------------- engine hot-swap
+
+
+def _live_cfg(**serving_kw):
+    serving_kw.setdefault("resolutions", ((32, 32),))
+    serving_kw.setdefault("batch_sizes", (1, 2))
+    serving_kw.setdefault("max_delay_ms", 20.0)
+    serving_kw.setdefault("queue_depth", 8)
+    serving_kw.setdefault("params_dtype", "float32")
+    return FasterRCNNConfig(
+        model=ModelConfig(
+            backbone="resnet18", roi_op="align", compute_dtype="float32"
+        ),
+        data=DataConfig(dataset="synthetic", image_size=(32, 32), max_boxes=8),
+        train=TrainConfig(batch_size=1, n_epoch=1),
+        mesh=MeshConfig(num_data=1),
+        proposals=ProposalConfig(
+            pre_nms_train=128, post_nms_train=32,
+            pre_nms_test=16, post_nms_test=4,
+        ),
+        roi_targets=ROITargetConfig(n_sample=8),
+        eval=EvalConfig(max_detections=4),
+        serving=ServingConfig(**serving_kw),
+    )
+
+
+@pytest.fixture(scope="module")
+def hotswap():
+    import jax
+
+    from replication_faster_rcnn_tpu.eval.evaluator import Evaluator
+    from replication_faster_rcnn_tpu.models.faster_rcnn import init_variables
+
+    cfg = _live_cfg()
+    model, v1 = init_variables(cfg, jax.random.PRNGKey(0))
+    _, v2 = init_variables(cfg, jax.random.PRNGKey(1))
+    ev = Evaluator(cfg, model)
+    rng = np.random.RandomState(0)
+    images = [
+        (rng.rand(32, 32, 3) * 2.0 - 1.0).astype(np.float32)
+        for _ in range(3)
+    ]
+    return {
+        "cfg": cfg, "model": model, "v1": v1, "v2": v2,
+        "ev": ev, "images": images,
+    }
+
+
+def _assert_bitwise(out, ref, what):
+    for k in ("boxes", "scores", "classes", "valid"):
+        np.testing.assert_array_equal(
+            out[k], np.asarray(ref[k][0]),
+            err_msg=f"{what}: engine vs Evaluator mismatch on {k}",
+        )
+
+
+class TestEngineHotSwap:
+    def test_swap_lifecycle_retirement_and_bad_checkpoint(self, hotswap):
+        from replication_faster_rcnn_tpu.serving.engine import InferenceEngine
+
+        env = hotswap
+        img = env["images"][0]
+        engine = InferenceEngine(
+            env["cfg"], env["model"], env["v1"],
+            warmup=True, model_version="1",
+        )
+        try:
+            assert engine.model_version == "1"
+            assert engine.resident_versions() == {"1": True}
+            ref1 = env["ev"].predict_batch(env["v1"], img[None])
+            _assert_bitwise(
+                engine.submit(img).result(timeout=60), ref1, "v1 serve"
+            )
+            # a wrong-shaped checkpoint raises during staging and leaves
+            # the engine serving the old version untouched
+            with pytest.raises(ValueError, match="leaves"):
+                engine.swap_params(
+                    {"params": {"w": np.zeros((3,), np.float32)}}, "99"
+                )
+            assert engine.model_version == "1"
+            assert engine.resident_versions() == {"1": True}
+            _assert_bitwise(
+                engine.submit(img).result(timeout=60), ref1,
+                "v1 serve after failed swap",
+            )
+            # real swap: new admissions bind to v2, v1 stays resident as
+            # the instant rollback target
+            assert engine.swap_params(env["v2"], "2") == "1"
+            assert engine.model_version == "2"
+            assert engine.resident_versions() == {"1": False, "2": True}
+            ref2 = env["ev"].predict_batch(env["v2"], img[None])
+            _assert_bitwise(
+                engine.submit(img).result(timeout=60), ref2, "v2 serve"
+            )
+            # second swap retires the drained v1 buffer, keeps v2 (the
+            # new prior); swapping the v1 weights back in as "3" is the
+            # rollback path and must reproduce v1's outputs bitwise
+            assert engine.swap_params(env["v1"], "3") == "2"
+            assert engine.resident_versions() == {"2": False, "3": True}
+            _assert_bitwise(
+                engine.submit(img).result(timeout=60), ref1,
+                "rollback serve",
+            )
+            # no program recompiled across three swaps: versions share
+            # the compiled signatures, so fingerprints cannot move
+            assert sorted(engine.compile_seconds) == [
+                "serve_32x32_b1", "serve_32x32_b2"
+            ]
+        finally:
+            engine.close()
+        # every flush key names exactly one version — version-mixed
+        # batches are impossible by construction
+        for key, _n in engine._batcher.flush_log:
+            assert key[0] in {"1", "2", "3"} and key[1] == (32, 32)
+
+    def test_inflight_request_answered_by_admission_version(self, hotswap):
+        """The pinned transparency claim: a request admitted BEFORE the
+        flip is answered entirely by the old version — its flush key
+        still names v1, so it drains against v1's buffer bitwise."""
+        from replication_faster_rcnn_tpu.serving.engine import InferenceEngine
+
+        env = hotswap
+        # a huge flush delay parks the first request in the ("1", 32x32)
+        # queue (bucket max_batch is 2, so one item never force-flushes)
+        cfg = _live_cfg(max_delay_ms=60_000.0)
+        engine = InferenceEngine(
+            cfg, env["model"], env["v1"], warmup=True, model_version="1"
+        )
+        imgs = env["images"]
+        try:
+            f1 = engine.submit(imgs[0])
+            assert engine._batcher.key_depths() == {("1", (32, 32)): 1}
+            assert engine.swap_params(env["v2"], "2") == "1"
+            # v2 admissions fill their own key and flush immediately
+            f2, f3 = engine.submit(imgs[1]), engine.submit(imgs[2])
+            r2, r3 = f2.result(timeout=60), f3.result(timeout=60)
+            # the pre-swap request is still queued — and still keyed v1
+            assert not f1.done()
+            assert engine._batcher.key_depths() == {("1", (32, 32)): 1}
+        finally:
+            engine.close()  # drain-and-stop flushes the parked v1 batch
+        r1 = f1.result(timeout=1)
+        _assert_bitwise(
+            r1, env["ev"].predict_batch(env["v1"], imgs[0][None]),
+            "pre-swap request",
+        )
+        for img, out in ((imgs[1], r2), (imgs[2], r3)):
+            ref = env["ev"].predict_batch(env["v2"], img[None])
+            np.testing.assert_allclose(
+                out["boxes"], np.asarray(ref["boxes"][0]), atol=1e-5
+            )
+            np.testing.assert_array_equal(
+                out["classes"], np.asarray(ref["classes"][0])
+            )
+        flushed = engine._batcher.flush_log
+        assert (("2", (32, 32)), 2) in flushed  # v2 pair coalesced
+        assert (("1", (32, 32)), 1) in flushed  # v1 straggler drained
+        for key, _n in flushed:
+            assert key[0] in {"1", "2"}
+
+
+# ------------------------------------------------------- registry rollout
+
+
+def _fleet_cfg(**kw):
+    kw.setdefault("hedge", False)
+    kw.setdefault("probe_interval_s", 0.5)
+    kw.setdefault("lease_timeout_s", 2.0)
+    kw.setdefault("rejoin_probes", 2)
+    kw.setdefault("canary_fraction", 0.25)
+    kw.setdefault("cache_entries", 0)
+    return FleetConfig(**kw)
+
+
+class TestRegistryHoldRelease:
+    def _one(self, versions):
+        now = [0.0]
+        client = LocalReplicaClient(
+            "r0", lambda p: p,
+            health_fn=lambda: {"ok": True, "model_version": versions["r0"]},
+        )
+        reg = ReplicaRegistry(_fleet_cfg(), clock=lambda: now[0])
+        reg.add("r0", client)
+        reg.probe_once(), reg.probe_once()
+        assert reg.in_rotation() == ["r0"]
+        return reg, now
+
+    def test_hold_parks_draining_and_blocks_promotion(self):
+        versions = {"r0": "1"}
+        reg, now = self._one(versions)
+        reg.hold("r0", reason="rollout to 2")
+        snap = reg.snapshot()["r0"]
+        assert snap["state"] == DRAINING and snap["held"]
+        assert snap["detail"] == "rollout to 2"
+        assert reg.in_rotation() == []
+        # clean probes accumulate but CANNOT promote a held replica —
+        # and the lease keeps renewing (DRAINING keeps the lease), so
+        # probing straight through lease_timeout_s never kills it
+        for _ in range(6):
+            now[0] += 0.5
+            reg.probe_once()
+        snap = reg.snapshot()["r0"]
+        assert snap["state"] == DRAINING and snap["state"] != DEAD
+        assert reg.in_rotation() == []
+
+    def test_release_rejoins_via_probe_gate_at_new_version(self):
+        versions = {"r0": "1"}
+        reg, now = self._one(versions)
+        reg.hold("r0")
+        versions["r0"] = "2"  # the hot-swap happened while held
+        reg.release("r0")
+        reg.probe_once()
+        assert reg.in_rotation() == []  # 1 of rejoin_probes=2
+        reg.probe_once()
+        assert reg.in_rotation() == ["r0"]
+        assert reg.model_version_of("r0") == "2"
+
+    def test_hold_and_release_are_idempotent_and_validated(self):
+        versions = {"r0": "1"}
+        reg, _ = self._one(versions)
+        with pytest.raises(KeyError):
+            reg.hold("ghost")
+        with pytest.raises(KeyError):
+            reg.release("ghost")
+        reg.release("r0")  # not held: no-op
+        reg.hold("r0")
+        reg.hold("r0")  # second hold: no-op, no duplicate event
+        events = [e["event"] for e in reg.events()]
+        assert events.count("replica_held") == 1
+        assert events.count("replica_released") == 0
+
+
+# --------------------------------------------------------- wave controller
+
+
+_BASE_REPORT = {
+    "slo": None, "canary_requests": 0,
+    "shadow_requests": 0, "shadow_diffs": 0,
+}
+
+_ALARM_SLO = {
+    "alarm": True,
+    "burn_rates": {"short": 30.0, "long": 15.0},
+}
+
+
+class ScriptedRouter:
+    """The router surface the controller needs: a metrics registry and
+    a programmable per-canary report. The first scripted entry is what
+    the controller samples as its pre-swap baseline; the final entry
+    repeats forever."""
+
+    def __init__(self):
+        self.metrics = MetricsRegistry()
+        self._scripts = {}
+
+    def script(self, rid, *reports):
+        self._scripts[rid] = [dict(r) for r in reports]
+
+    def canary_report(self, rid):
+        seq = self._scripts.get(rid)
+        if not seq:
+            return dict(_BASE_REPORT)
+        return dict(seq.pop(0)) if len(seq) > 1 else dict(seq[0])
+
+
+def _fake_fleet(n=3, version="1", feed=None, router=None,
+                rollout_kw=None, swap_fail=()):
+    """Admitted n-replica fleet on fakes + a controller whose injected
+    `sleep` advances the registry's clock — waves run instantly."""
+    now = [0.0]
+    versions = {f"r{i}": version for i in range(n)}
+
+    def _mk(rid):
+        def _swap(v, rid=rid):
+            if rid in swap_fail:
+                raise RuntimeError("swap endpoint exploded")
+            versions[rid] = v
+
+        return LocalReplicaClient(
+            rid, lambda p: p,
+            health_fn=lambda rid=rid: {
+                "ok": True,
+                "model_version": versions[rid],
+                "bucket_queue_depths": {},
+            },
+            swap_fn=_swap,
+        )
+
+    clients = {rid: _mk(rid) for rid in sorted(versions)}
+    fleet_cfg = _fleet_cfg()
+    rkw = dict(
+        drain_timeout_s=2.0, swap_timeout_s=5.0, rejoin_timeout_s=10.0,
+        canary_hold_s=1.0, canary_min_requests=0,
+    )
+    rkw.update(rollout_kw or {})
+    cfg = FasterRCNNConfig().replace(
+        fleet=fleet_cfg, rollout=RolloutConfig(**rkw)
+    )
+    registry = ReplicaRegistry(fleet_cfg, clock=lambda: now[0])
+    for rid, client in clients.items():
+        registry.add(rid, client)
+    for _ in range(fleet_cfg.rejoin_probes):
+        registry.probe_once()
+        now[0] += fleet_cfg.probe_interval_s
+    assert registry.in_rotation() == sorted(versions)
+    router = router if router is not None else ScriptedRouter()
+    controller = RolloutController(
+        registry, router, cfg, feed=feed,
+        clock=lambda: now[0],
+        sleep=lambda s: now.__setitem__(0, now[0] + s),
+    )
+    return {
+        "now": now, "versions": versions, "clients": clients, "cfg": cfg,
+        "registry": registry, "router": router, "controller": controller,
+    }
+
+
+def _events(result):
+    return [e["event"] for e in result.events]
+
+
+def _counter(fl, name, **labels):
+    return fl["router"].metrics.counter(name, **labels).value
+
+
+class TestRolloutController:
+    def test_promote_wave_rolls_whole_fleet(self):
+        fl = _fake_fleet()
+        result = fl["controller"].rollout("2")
+        assert result.outcome == "promoted" and result.reason is None
+        assert result.swapped == ["r0", "r1", "r2"]
+        assert fl["versions"] == {"r0": "2", "r1": "2", "r2": "2"}
+        assert fl["registry"].model_versions() == fl["versions"]
+        assert fl["registry"].in_rotation() == ["r0", "r1", "r2"]
+        assert all(
+            fl["registry"].role_of(r) == SERVING for r in fl["versions"]
+        )
+        ev = _events(result)
+        assert ev[0] == "wave_started" and ev[-1] == "wave_done"
+        assert ev.count("replica_hold") == 3
+        assert ev.count("replica_swapped") == 3
+        assert ev.count("replica_rejoined") == 3
+        # the canary gate ran before the fleet-wide roll
+        holds = [i for i, e in enumerate(ev) if e == "replica_hold"]
+        assert ev.index("canary_promoted") < holds[1]
+        assert _counter(fl, "rollout_waves_total", outcome="promoted") == 1
+        assert _counter(fl, "rollout_swaps_total") == 3
+        assert _counter(fl, "rollout_promotions_total") == 1
+
+    def test_noop_when_fleet_already_at_version(self):
+        fl = _fake_fleet(version="2")
+        result = fl["controller"].rollout("2")
+        assert result.outcome == "noop" and result.swapped == []
+        assert _counter(fl, "rollout_waves_total", outcome="noop") == 1
+
+    def test_ineligible_verdict_never_touches_the_fleet(self):
+        fl = _fake_fleet()
+        verdict = Eligibility(9, False, ["manifest missing"])
+        result = fl["controller"].rollout("9", verdict=verdict)
+        assert result.outcome == "ineligible"
+        assert result.reason == "manifest missing"
+        assert _events(result) == ["wave_ineligible", "wave_done"]
+        assert all(
+            not info["held"] for info in fl["registry"].snapshot().values()
+        )
+
+    def test_swap_rpc_failure_aborts_and_recovers_the_replica(self):
+        fl = _fake_fleet(swap_fail=("r0",))
+        result = fl["controller"].rollout("2")
+        assert result.outcome == "aborted"
+        assert "swap RPC failed" in result.reason
+        assert result.rolled_back == ["r0"]
+        # the failed wave left the fleet converged on the old version
+        assert fl["versions"] == {"r0": "1", "r1": "1", "r2": "1"}
+        assert fl["registry"].in_rotation() == ["r0", "r1", "r2"]
+        assert _counter(fl, "rollout_waves_total", outcome="aborted") == 1
+
+    def test_mid_swap_kill_failpoint_aborts_wave(self):
+        fl = _fake_fleet()
+        failpoints.configure(
+            [failpoints.Rule("rollout.swap", "drop", 1.0, 0, max_fires=1)]
+        )
+        try:
+            result = fl["controller"].rollout("2")
+        finally:
+            failpoints.disarm()
+        assert result.outcome == "aborted"
+        assert "injected mid-swap kill" in result.reason
+        assert fl["versions"] == {"r0": "1", "r1": "1", "r2": "1"}
+        assert fl["registry"].in_rotation() == ["r0", "r1", "r2"]
+        ev = _events(result)
+        assert "wave_aborted" in ev and "replica_rolled_back" in ev
+
+    def test_canary_slo_alarm_rolls_back_whole_wave(self):
+        router = ScriptedRouter()
+        router.script("r0", _BASE_REPORT, {**_BASE_REPORT, "slo": _ALARM_SLO})
+        fl = _fake_fleet(router=router)
+        result = fl["controller"].rollout("2")
+        assert result.outcome == "rolled_back"
+        assert "slo burn-rate alarm" in result.reason
+        assert result.swapped == ["r0"]
+        assert result.rolled_back == ["r0"]
+        assert fl["versions"] == {"r0": "1", "r1": "1", "r2": "1"}
+        assert fl["registry"].in_rotation() == ["r0", "r1", "r2"]
+        assert fl["registry"].role_of("r0") == SERVING  # canary role lifted
+        assert _counter(fl, "rollout_waves_total", outcome="rolled_back") == 1
+        assert _counter(fl, "rollout_rollbacks_total") == 1
+        assert _counter(fl, "rollout_promotions_total") == 0
+
+    def test_router_auto_demotion_is_a_rollback_verdict(self):
+        """The router demoting the canary mid-hold (its own burn-rate
+        alarm) must read as rollback — the controller never resurrects
+        a demoted role."""
+        fl = _fake_fleet()
+        real_tick = fl["controller"]._tick
+
+        def demote_then_tick():
+            fl["registry"].set_role("r0", SERVING, reason="slo alarm")
+            real_tick()
+
+        fl["controller"]._tick = demote_then_tick
+        result = fl["controller"].rollout("2")
+        assert result.outcome == "rolled_back"
+        assert "auto-demoted" in result.reason
+        assert fl["registry"].role_of("r0") == SERVING
+        assert fl["versions"]["r0"] == "1"
+        # exactly one promotion + one demotion role change — rollback
+        # left the router's demotion alone instead of re-flipping it
+        roles = [
+            (e["from"], e["to"])
+            for e in fl["registry"].events()
+            if e["event"] == "replica_role_changed"
+        ]
+        assert roles == [(SERVING, CANARY), (CANARY, SERVING)]
+
+    def test_shadow_diff_fraction_rolls_back(self):
+        router = ScriptedRouter()
+        router.script(
+            "r0",
+            _BASE_REPORT,
+            {**_BASE_REPORT, "shadow_requests": 10, "shadow_diffs": 9},
+        )
+        fl = _fake_fleet(
+            router=router, rollout_kw={"max_shadow_diff_fraction": 0.25}
+        )
+        result = fl["controller"].rollout("2")
+        assert result.outcome == "rolled_back"
+        assert "shadow diff fraction" in result.reason
+        assert fl["versions"] == {"r0": "1", "r1": "1", "r2": "1"}
+
+    def test_promote_failpoint_forces_the_rollback_path(self):
+        fl = _fake_fleet()
+        failpoints.configure(
+            [failpoints.Rule("rollout.promote", "drop", 1.0, 0, max_fires=1)]
+        )
+        try:
+            result = fl["controller"].rollout("2")
+        finally:
+            failpoints.disarm()
+        assert result.outcome == "rolled_back"
+        assert "injected promote failure" in result.reason
+        assert fl["versions"] == {"r0": "1", "r1": "1", "r2": "1"}
+        assert fl["registry"].in_rotation() == ["r0", "r1", "r2"]
+
+    def test_auto_rollback_off_holds_canary_for_the_operator(self):
+        router = ScriptedRouter()
+        router.script("r0", _BASE_REPORT, {**_BASE_REPORT, "slo": _ALARM_SLO})
+        fl = _fake_fleet(router=router, rollout_kw={"auto_rollback": False})
+        result = fl["controller"].rollout("2")
+        assert result.outcome == "aborted"
+        assert result.rolled_back == []
+        # nothing reversed: the canary keeps the new version and role
+        assert fl["versions"]["r0"] == "2"
+        assert fl["registry"].role_of("r0") == CANARY
+        assert fl["versions"]["r1"] == "1" and fl["versions"]["r2"] == "1"
+
+    def test_mid_fleet_failure_reverses_already_swapped_replicas(self):
+        """A failure AFTER promotion (replica 2 of 3) must reverse the
+        replicas already at the new version, newest first."""
+        fl = _fake_fleet(swap_fail=("r1",))
+        result = fl["controller"].rollout("2")
+        assert result.outcome == "rolled_back"
+        assert "swap RPC failed" in result.reason
+        assert result.swapped == ["r0"]
+        # the failed replica's reversal is attempted too (best-effort),
+        # then the promoted canary reverses newest-first
+        assert result.rolled_back == ["r1", "r0"]
+        assert fl["versions"] == {"r0": "1", "r1": "1", "r2": "1"}
+        assert fl["registry"].in_rotation() == ["r0", "r1", "r2"]
+
+
+# --------------------------------------------------------------- watcher
+
+
+class TestRolloutWatcher:
+    def _watching(self, tmp_path):
+        wd = str(tmp_path)
+        _publish(wd, 1)
+        _publish(wd, 2)
+        feed = VersionFeed(wd, config=None)
+        fl = _fake_fleet(feed=feed)
+        log = os.path.join(wd, "rollout.jsonl")
+        watcher = RolloutWatcher(
+            feed, fl["controller"], poll_interval_s=0.05, log_path=log
+        )
+        return wd, fl, watcher, log
+
+    def test_poll_once_runs_one_wave_then_waits_for_news(self, tmp_path):
+        wd, fl, watcher, log = self._watching(tmp_path)
+        result = watcher.poll_once()
+        assert result.version == "2" and result.outcome == "promoted"
+        assert fl["versions"] == {"r0": "2", "r1": "2", "r2": "2"}
+        # same feed state: the cursor holds, no second wave
+        assert watcher.poll_once() is None
+        _publish(wd, 3)
+        result = watcher.poll_once()
+        assert result.version == "3" and result.outcome == "promoted"
+        assert [r.version for r in watcher.results] == ["2", "3"]
+        with open(log) as f:
+            lines = [json.loads(line) for line in f]
+        assert [(r["version"], r["outcome"]) for r in lines] == [
+            ("2", "promoted"), ("3", "promoted"),
+        ]
+
+    def test_watcher_thread_is_non_daemon_and_joins(self, tmp_path):
+        _, _, watcher, _ = self._watching(tmp_path)
+        # durable rollout records ride this thread: TL006 discipline
+        assert watcher._thread.daemon is False
+        watcher.start()
+        assert watcher._thread.is_alive()
+        watcher.stop()
+        assert not watcher._thread.is_alive()
+        # the background loop ran the same wave poll_once would have
+        assert [r.version for r in watcher.results] == ["2"]
+
+    def test_bad_poll_interval_rejected(self, tmp_path):
+        _, fl, _, _ = self._watching(tmp_path)
+        with pytest.raises(ValueError, match="poll_interval_s"):
+            RolloutWatcher(None, fl["controller"], poll_interval_s=0.0)

@@ -774,7 +774,8 @@ class TestLiveEngine:
         futs = [engine.submit(img) for img in live["images"][:2]]
         outs = [f.result(timeout=60) for f in futs]
         new = engine._batcher.flush_log[flushes_before:]
-        assert ((32, 32), 2) in new, f"no coalesced flush in {new}"
+        # flush keys are (model_version, bucket) since the hot-swap work
+        assert (("0", (32, 32)), 2) in new, f"no coalesced flush in {new}"
         for img, out in zip(live["images"][:2], outs):
             ref = live["ev"].predict_batch(live["variables"], img[None])
             np.testing.assert_allclose(
@@ -794,7 +795,7 @@ class TestLiveEngine:
         engine.batch_sizes = (2,)
         try:
             out = engine._process_bucket(
-                (32, 32), [(img, 32, 32)]
+                (engine.model_version, (32, 32)), [(img, 32, 32)]
             )
         finally:
             engine.batch_sizes = orig_sizes
@@ -889,7 +890,7 @@ class TestLiveEngine:
             for det in d:
                 assert set(det) == {"box", "score", "class_id", "class_name"}
         # both paths coalesced into one shared flush
-        assert ((32, 32), 2) in engine._batcher.flush_log[flushes_before:]
+        assert (("0", (32, 32)), 2) in engine._batcher.flush_log[flushes_before:]
 
     def test_strict_session_zero_transfers_zero_recompiles(self, live):
         from replication_faster_rcnn_tpu.analysis.strict import StrictHarness
