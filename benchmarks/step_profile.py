@@ -48,6 +48,14 @@ DEFAULT_TOL = 0.15
 # --strict-phases
 GATE_KEY = "images_per_sec"
 
+# on-device augmentation gate (ISSUE 19): host staging must stay flat
+# (≤1.1×) as the augment op count scales 0→3 — the transforms run inside
+# the jitted step, so their cost lands in device dispatch, never on the
+# host feed thread. The absolute floor keeps a sub-ms CPU staging
+# baseline from turning quotient-of-noise into a failure.
+AUGMENT_STAGE_TOL = 0.10
+AUGMENT_STAGE_FLOOR_MS = 0.3
+
 
 # ---------------------------------------------------------------------------
 # pure record logic (no jax): unit-testable without timing anything
@@ -138,6 +146,39 @@ def check_regression(current, banked, tol: float = DEFAULT_TOL,
                 f"banked {old_ms:.2f} ms"
             )
             (failures if strict_phases else warnings).append(msg)
+    # augmentation flatness gate (ISSUE 19). Two arms: the in-run one
+    # (every level's host stage vs this run's own 0-op baseline) is the
+    # acceptance number itself; the vs-banked one catches a slow creep
+    # where every level degrades together. Records banked before the
+    # augment section simply skip the second arm.
+    aug_levels = (current.get("augment") or {}).get("levels") or []
+    if len(aug_levels) >= 2:
+        base_ms = aug_levels[0].get("host_stage_ms") or 0.0
+        worst = max(lv.get("host_stage_ms") or 0.0 for lv in aug_levels)
+        ceiling = (
+            base_ms * (1.0 + AUGMENT_STAGE_TOL) + AUGMENT_STAGE_FLOOR_MS
+        )
+        if worst > ceiling:
+            failures.append(
+                f"augment host_stage_ms not flat: worst level {worst:.3f} ms"
+                f" vs 0-op baseline {base_ms:.3f} ms (ceiling {ceiling:.3f}"
+                f" = baseline × {1.0 + AUGMENT_STAGE_TOL:.2f} + "
+                f"{AUGMENT_STAGE_FLOOR_MS} ms floor)"
+            )
+        banked_levels = (banked.get("augment") or {}).get("levels") or []
+        if banked_levels:
+            old_worst = max(
+                lv.get("host_stage_ms") or 0.0 for lv in banked_levels
+            )
+            b_ceiling = (
+                old_worst * (1.0 + AUGMENT_STAGE_TOL) + AUGMENT_STAGE_FLOOR_MS
+            )
+            if worst > b_ceiling:
+                failures.append(
+                    f"augment host_stage_ms {worst:.3f} exceeds banked worst "
+                    f"{old_worst:.3f} × {1.0 + AUGMENT_STAGE_TOL:.2f} + "
+                    f"{AUGMENT_STAGE_FLOOR_MS} ms floor ({b_ceiling:.3f})"
+                )
     return failures, warnings
 
 
@@ -339,6 +380,111 @@ def _measure_overlap(step, state, batch, n_dispatches: int = 8,
     }
 
 
+def _measure_augment(cfg, n_dispatches: int = 12, n_steps: int = 5):
+    """Host-stage flatness as on-device augmentation ops scale 0→3.
+
+    With ``data.augment_device`` the host loader ships pixels untouched
+    plus a 2-int32 ``aug`` tag per row; every transform (hflip, scale
+    jitter, translation jitter) runs inside the jitted train step. So
+    the host staging cost — the same collate copy + device_put the
+    trainer pays per dispatch — must stay FLAT as the op count grows,
+    and the augmentation milliseconds must show up in the device step
+    wall instead. One level per op count, each compiling the step that
+    traces exactly that level's transforms."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from replication_faster_rcnn_tpu.data import SyntheticDataset
+    from replication_faster_rcnn_tpu.data.loader import collate
+    from replication_faster_rcnn_tpu.train.train_step import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    batch_size = cfg.train.batch_size
+    tx, _ = make_optimizer(cfg, steps_per_epoch=100)
+    model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
+    ds = SyntheticDataset(cfg.data, length=batch_size)
+    base = collate([ds[i] for i in range(batch_size)])
+    # the loader's AugmentTagView tag: (dataset idx, epoch) per row
+    aug_tag = np.stack(
+        [np.asarray([i, 0], np.int32) for i in range(batch_size)]
+    )
+
+    LEVELS = (
+        (),
+        ("hflip",),
+        ("hflip", "scale"),
+        ("hflip", "scale", "translate"),
+    )
+    wait_transfer = jax.default_backend() != "cpu"
+    levels = []
+    for ops_on in LEVELS:
+        dcfg = dataclasses.replace(
+            cfg.data,
+            augment_device=bool(ops_on),
+            augment_hflip="hflip" in ops_on,
+            augment_scale=((0.75, 1.25) if "scale" in ops_on else None),
+            augment_translate=(0.1 if "translate" in ops_on else 0.0),
+        )
+        vcfg = cfg.replace(data=dcfg)
+        batch = dict(base)
+        if ops_on:
+            batch["aug"] = aug_tag
+        step = jax.jit(make_train_step(model, vcfg, tx))
+
+        # the trainer's per-dispatch feed work (same stage as
+        # _measure_overlap): fresh collate copy + device_put. Median, not
+        # mean — a single scheduler hiccup must not fake a slope.
+        stage_ms = []
+        staged = None
+        for _ in range(n_dispatches):
+            t0 = time.perf_counter()
+            collated = {key: np.array(v) for key, v in batch.items()}
+            staged = jax.device_put(collated)
+            if wait_transfer:
+                for leaf in jax.tree_util.tree_leaves(staged):
+                    leaf.block_until_ready()
+            stage_ms.append((time.perf_counter() - t0) * 1e3)
+
+        out = step(state, staged)  # compile + stabilize
+        jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            out = step(state, staged)
+            jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        step_ms = (time.perf_counter() - t0) / n_steps * 1e3
+
+        levels.append({
+            "n_ops": len(ops_on),
+            "ops": list(ops_on),
+            "host_stage_ms": round(float(np.median(stage_ms)), 3),
+            "step_ms": round(step_ms, 3),
+        })
+
+    base_stage = levels[0]["host_stage_ms"]
+    ratio = (
+        max(lv["host_stage_ms"] / base_stage for lv in levels)
+        if base_stage > 0
+        else None
+    )
+    return {
+        "levels": levels,
+        "host_stage_ratio_max": (
+            round(ratio, 4) if ratio is not None else None
+        ),
+        # the transforms' cost, attributed where it belongs: the device
+        # step wall of the 3-op level over the 0-op level (raw — small
+        # negatives are CPU timing noise, not a speedup claim)
+        "device_augment_ms": round(
+            levels[-1]["step_ms"] - levels[0]["step_ms"], 3
+        ),
+    }
+
+
 def _measure_async_save(step, state, batch_staged, n_saves: int = 3):
     """Trainer-side checkpoint cost, synchronous vs background writer.
 
@@ -509,6 +655,9 @@ def profile(cfg, config_token: str, n_steps: int = 5):
     overlap = _measure_overlap(step, state, batch)
     overlap.update(_measure_async_save(step, state, jax.device_put(batch)))
 
+    # on-device augmentation flatness: host staging vs augment op count
+    augment = _measure_augment(cfg)
+
     peak, basis = peak_flops_per_sec(jax.device_count())
     mfu = compute_mfu(flops_per_step, images_per_sec / batch_size, peak)
     if mfu is None or basis is None:
@@ -540,6 +689,7 @@ def profile(cfg, config_token: str, n_steps: int = 5):
         },
         "analytic": analytic,
         "overlap": overlap,
+        "augment": augment,
         "flops_per_step": flops_per_step,
         "mfu": round(mfu, 4),
         "mfu_basis": basis,

@@ -76,10 +76,12 @@ PALLAS_CALL_MARKERS = ("tpu_custom_call", "mosaic", "triton")
 # and the serving engine's bucket matrix (audit_config's 2 resolutions ×
 # 2 batch sizes = 4 more) — plus the three ops.backend=pallas twins
 # (train/warmup.py::pallas_twin_base_names: loader k=1, eval, one
-# serving bucket), plus the multi-scale TRAIN bucket programs
-# (audit_config's 2 train_resolutions × the loader/cached feeds × both
-# Ks = 8 more), plus the quantized serving twins (4 ``serve_*__int8``
-# bucket programs + 1 int8 pallas twin), 35 programs total
+# serving bucket), plus the multi-scale TRAIN bucket programs —
+# EVERY train feed buckets (the shard_map/mp in/out specs shard batch
+# dims only, so they are resolution-independent): audit_config's 2
+# train_resolutions × all 7 feeds × both Ks = 28 more — plus the
+# quantized serving twins (4 ``serve_*__int8`` bucket programs + 1 int8
+# pallas twin), 55 programs total
 AUDIT_FEEDS = ("loader", "cached", "spmd", "zero", "zero_lamb", "mp", "mp_zero")
 AUDIT_KS = (1, 2)
 AUDIT_BANK_NAME = "ci"
@@ -178,7 +180,7 @@ def expected_program_names(
 ) -> List[str]:
     """The audited program set; with ``config`` the serving engine's
     bucket programs (serving.resolutions × batch_sizes), the multi-scale
-    TRAIN bucket programs (data.train_resolutions × loader/cached × ks)
+    TRAIN bucket programs (data.train_resolutions × every feed × ks)
     and the ops.backend=pallas twin programs are included."""
     from replication_faster_rcnn_tpu.train.warmup import (
         bucket_train_program_names,

@@ -949,6 +949,15 @@ def abstract_step_inputs(cfg, tx):
         k: jax.ShapeDtypeStruct((b,) + v.shape[1:], v.dtype)
         for k, v in sample.items()
     }
+    if cfg.data.augment_device and (
+        cfg.data.augment_hflip
+        or cfg.data.augment_scale
+        or cfg.data.augment_translate
+    ):
+        # device-mode augmentation ships an int32 (idx, epoch) row per
+        # sample (data/augment.py::AugmentTagView) — the fixture must
+        # carry it so warmup/audit lower the runtime trace, not a twin
+        batch_abs["aug"] = jax.ShapeDtypeStruct((b, 2), np.int32)
     return model, state_abs, batch_abs
 
 

@@ -90,6 +90,10 @@ def _build_config(args):
         data_kw["augment_scale"] = tuple(args.augment_scale)
     if getattr(args, "augment_scale_device", False):
         data_kw["augment_scale_device"] = True
+    if getattr(args, "augment_device", False):
+        data_kw["augment_device"] = True
+    if getattr(args, "augment_translate", None) is not None:
+        data_kw["augment_translate"] = args.augment_translate
     if getattr(args, "cache_ram", False):
         data_kw["loader_cache_ram"] = True
     if getattr(args, "cache_device", False):
@@ -372,6 +376,18 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="run the jitter's image resample on device (host "
                         "transforms boxes only; removes the per-sample "
                         "host resample cost from ingest)")
+    p.add_argument("--augment-device", action="store_true",
+                   help="run ALL enabled augmentations (flip/scale/"
+                        "translate) as jitted batch ops inside the "
+                        "compiled step; the host loader ships raw pixels "
+                        "plus per-row (index, epoch) tags and never "
+                        "touches image bytes (data.augment_device)")
+    p.add_argument("--augment-translate", type=float, default=None,
+                   metavar="FRAC",
+                   help="random translation jitter up to FRAC of the "
+                        "canvas per axis (device-mode only: requires "
+                        "--augment-device; boxes shifted and clamped, "
+                        "collapsed rows masked; data.augment_translate)")
     p.add_argument("--train-resolutions", default=None, metavar="HxW,HxW",
                    help="multi-scale bucketed training, e.g. "
                         "'300x300,600x600': each dispatch chunk is "
@@ -811,6 +827,8 @@ def cmd_bench(args) -> int:
     ) or (
         args.spatial or args.remat or args.shard_opt or args.augment_hflip
         or args.frozen_bn or args.augment_scale_device
+        or getattr(args, "augment_device", False)
+        or getattr(args, "augment_translate", None) is not None
         or args.no_augment_hflip or args.cache_ram or args.device_normalize
         or getattr(args, "cache_device", False)
         or args.async_checkpoint

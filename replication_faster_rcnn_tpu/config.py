@@ -253,6 +253,28 @@ class DataConfig:
     # (measured 37 samples/s host-side on one core vs the 210 img/s
     # one-chip demand). Requires augment_scale.
     augment_scale_device: bool = False
+    # FULLY on-device augmentation (ops/image.py::augment_batch): the
+    # host loader ships raw samples plus an int32 [idx, epoch] row, and
+    # the compiled train step draws every decision (flip coin, scale
+    # geometry, translation offsets) from the splitmix hash of
+    # (seed, epoch, idx) and applies flip/translate/scale-jitter as one
+    # fused batch transform ahead of the bucket resample — the host
+    # stops touching pixels entirely. Supersedes augment_scale_device
+    # (which still ran the flip and the box affine on host). Composes
+    # with every train backend: the draws are a pure function of
+    # per-sample metadata, so all ranks and any resume agree with zero
+    # communication. Requires augment_hflip, augment_scale, or
+    # augment_translate; incompatible with cache_device (the device
+    # cache already augments inside its gather).
+    augment_device: bool = False
+    # translation jitter amplitude as a fraction of the canvas: each
+    # sample's content shifts by integer (dy, dx) drawn uniformly from
+    # [-t*h, t*h] x [-t*w, t*w], channel-mean fill, boxes tracked and
+    # collapsed rows masked. 0 = off. Device-mode only (augment_device):
+    # the legacy host pipeline never had this op, so there is no host
+    # path to keep parity with — the numpy oracle lives in
+    # data/augment.py::translate_sample.
+    augment_translate: float = 0.0
     # device-resident dataset cache (data/device_cache.py): upload every
     # sample to HBM once, then each step ships only indices + augment
     # decisions and the batch is gathered/flipped/jittered INSIDE the
@@ -305,6 +327,39 @@ class DataConfig:
             raise ValueError(
                 "augment_scale_device requires augment_scale to be set"
             )
+        if not 0.0 <= self.augment_translate < 1.0:
+            raise ValueError(
+                "augment_translate must be in [0, 1), got "
+                f"{self.augment_translate!r}"
+            )
+        if self.augment_translate and not self.augment_device:
+            raise ValueError(
+                "augment_translate is a device-mode op: set "
+                "data.augment_device=True (the host pipeline has no "
+                "translation path)"
+            )
+        if self.augment_device:
+            if not (
+                self.augment_hflip
+                or self.augment_scale is not None
+                or self.augment_translate
+            ):
+                raise ValueError(
+                    "augment_device is set but no augmentation op is "
+                    "enabled (augment_hflip / augment_scale / "
+                    "augment_translate)"
+                )
+            if self.augment_scale_device:
+                raise ValueError(
+                    "augment_device supersedes augment_scale_device — "
+                    "set only one"
+                )
+            if self.cache_device:
+                raise ValueError(
+                    "augment_device is incompatible with cache_device: "
+                    "the device cache already flips/jitters inside its "
+                    "gather (data/device_cache.py)"
+                )
         if self.train_resolutions:
             res = tuple(
                 (int(r[0]), int(r[1])) for r in self.train_resolutions

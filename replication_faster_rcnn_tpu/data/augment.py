@@ -25,7 +25,11 @@ def hflip_sample(sample: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     Keyed on ``labels >= 0``, not the training ``mask``: difficult
     objects keep their geometry consistent with the mirrored pixels even
     when masked out of training (they are ignore-regions at eval time)."""
-    image = sample["image"][:, ::-1, :]
+    # C-contiguous copy, NOT the negative-stride view `[:, ::-1, :]`:
+    # consumers that stage samples individually (device_put, per-sample
+    # caches) would silently re-copy a strided view per image; collate's
+    # np.stack hid that for the batch path only
+    image = np.ascontiguousarray(sample["image"][:, ::-1, :])
     w = float(image.shape[1])
     boxes = sample["boxes"].copy()
     valid = np.asarray(sample["labels"] >= 0, bool)
@@ -35,7 +39,6 @@ def hflip_sample(sample: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         axis=1,
     )
     out = dict(sample)
-    # negative-stride view, no copy: collate's np.stack materializes it
     out["image"] = image
     out["boxes"] = boxes
     return out
@@ -209,6 +212,80 @@ def draw_decisions(seed: int, epoch: int, idx: int, scale_range=None):
     return flip, scale, off_y, off_x
 
 
+def device_decisions(seed: int, epoch: int, idx: int):
+    """Host-numpy oracle for the ON-DEVICE draw stream
+    (`ops/image.py::augment_draws`): (flip, u_scale, u_off_y, u_off_x,
+    u_translate_y, u_translate_x), the uniforms as exact np.float32.
+
+    Same splitmix64 counter-mix as :func:`draw_decisions`, but the +GAMMA
+    chain wraps at 64 bits (the device limbs must) and each uniform takes
+    the TOP 24 bits scaled by 2^-24 — both exactly representable in f32,
+    so device and host compute bit-identical values. A separate stream on
+    purpose: the legacy host draws burn 53-bit f64 uniforms that f32
+    can't reproduce."""
+    z = _splitmix(
+        (
+            seed * 0x9E3779B97F4A7C15
+            + epoch * 0xBF58476D1CE4E5B9
+            + idx * 0x94D049BB133111EB
+        )
+        & 0xFFFFFFFFFFFFFFFF
+    )
+    flip = bool(z & 1)
+    us = []
+    for _ in range(5):
+        z = _splitmix((z + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF)
+        us.append(np.float32(z >> 40) * np.float32(1.0 / (1 << 24)))
+    return (flip, *us)
+
+
+def translate_sample(
+    sample: Dict[str, np.ndarray], dy: int, dx: int
+) -> Dict[str, np.ndarray]:
+    """Host-numpy oracle for `ops/image.py::translate_batch_with_boxes`:
+    output pixel (y, x) reads input (y + dy, x + dx), out-of-range reads
+    take the channel-mean fill; real boxes move by (-dy, -dx) with canvas
+    clipping, sub-1px rows collapse to the padded-row convention."""
+    image = sample["image"]
+    h, w = image.shape[:2]
+    iy = np.arange(h) + int(dy)
+    ix = np.arange(w) + int(dx)
+    out_img = image[np.clip(iy, 0, h - 1)][:, np.clip(ix, 0, w - 1)].copy()
+    fill = image.astype(np.float32).mean(axis=(0, 1))
+    if image.dtype == np.uint8:
+        fill = np.clip(np.rint(fill), 0, 255)
+    fill = fill.astype(image.dtype)
+    invalid = ~(
+        ((iy >= 0) & (iy < h))[:, None] & ((ix >= 0) & (ix < w))[None, :]
+    )
+    out_img[invalid] = fill
+
+    boxes = sample["boxes"].copy()
+    labels = sample["labels"].copy()
+    mask = sample["mask"].copy() if "mask" in sample else None
+    valid = np.asarray(labels >= 0, bool)
+    if valid.any():
+        b = boxes[valid] - np.asarray(
+            [dy, dx, dy, dx], boxes.dtype
+        )
+        b[:, 0::2] = np.clip(b[:, 0::2], 0.0, float(h))
+        b[:, 1::2] = np.clip(b[:, 1::2], 0.0, float(w))
+        collapsed = ((b[:, 2] - b[:, 0]) < 1.0) | ((b[:, 3] - b[:, 1]) < 1.0)
+        b[collapsed] = -1.0
+        boxes[valid] = b
+        vi = np.flatnonzero(valid)[collapsed]
+        labels[vi] = -1
+        if mask is not None:
+            mask[vi] = False
+    out = dict(sample)
+    out["image"] = out_img
+    out["boxes"] = boxes
+    out["labels"] = labels
+    if mask is not None:
+        out["mask"] = mask
+    return out
+
+
 def bucket_index(
     seed: int, epoch: int, batch: int, n_buckets: int, chunk: int = 1
 ) -> int:
@@ -236,6 +313,27 @@ def bucket_index(
         & 0xFFFFFFFFFFFFFFFF
     )
     return int(z % n_buckets)
+
+
+class AugmentTagView:
+    """Device-augmentation feed (data.augment_device): samples pass
+    through UNTOUCHED except an attached int32 ``aug = [idx, epoch]`` row.
+    The compiled train step draws every augmentation decision from
+    (seed, epoch, idx) itself (`ops/image.py::augment_batch`), so the
+    host loader stops touching pixels entirely — the last host per-image
+    loop of the reference pipeline is gone, not moved."""
+
+    def __init__(self, dataset, epoch: int) -> None:
+        self.dataset = dataset
+        self.epoch = int(epoch)
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    def __getitem__(self, idx: int):
+        out = dict(self.dataset[idx])
+        out["aug"] = np.asarray([int(idx), self.epoch], np.int32)
+        return out
 
 
 class AugmentedView:

@@ -169,6 +169,8 @@ class DataLoader:
         augment_hflip: bool = False,
         augment_scale=None,
         augment_scale_device: bool = False,
+        augment_device: bool = False,
+        augment_translate: float = 0.0,
         stall_timeout: float = 120.0,
         cache_ram: bool = False,
         sample_skip_budget: int = 8,
@@ -203,6 +205,8 @@ class DataLoader:
         self.augment_hflip = augment_hflip
         self.augment_scale = augment_scale
         self.augment_scale_device = augment_scale_device
+        self.augment_device = augment_device
+        self.augment_translate = float(augment_translate)
         if cache_ram:
             from replication_faster_rcnn_tpu.data.cache import CachedView
 
@@ -342,6 +346,15 @@ class DataLoader:
         deterministic hflip/scale-jitter augmentations keyed on
         (seed, epoch, idx) — computed per-iteration so set_epoch()
         re-rolls the draws while resume replays them exactly."""
+        if self.augment_device and (
+            self.augment_hflip or self.augment_scale or self.augment_translate
+        ):
+            # fully on-device mode: the host ships raw pixels plus the
+            # int32 (idx, epoch) row the compiled step's splitmix draws
+            # key on — no host flip, no host box affine, no host resample
+            from replication_faster_rcnn_tpu.data.augment import AugmentTagView
+
+            return AugmentTagView(self.dataset, self.epoch)
         if not (self.augment_hflip or self.augment_scale):
             return self.dataset
         from replication_faster_rcnn_tpu.data.augment import AugmentedView

@@ -74,6 +74,266 @@ def batched_scale_jitter(images: Array, params: Array) -> Array:
     return jax.vmap(scale_jitter_image)(images, params)
 
 
+# ---------------------------------------------------------------------------
+# Fully on-device augmentation (data.augment_device)
+#
+# The host loader ships RAW samples plus one int32 ``aug = [idx, epoch]``
+# row per sample (`data/augment.py::AugmentTagView`); every augmentation
+# decision — the flip coin, the scale-jitter geometry, the translation
+# offsets — is drawn INSIDE the compiled step from the same splitmix64
+# counter-mix the host pipeline uses, keyed on (seed, epoch, dataset idx).
+# A pure function of per-sample metadata needs no communication: every
+# rank of an spmd/MP fleet and every checkpoint resume computes identical
+# draws from the rows it holds, and elastic re-sharding just re-partitions
+# the rows. jax default config has no uint64, so the 64-bit hash runs on
+# two uint32 limbs (16-bit partial products for the multiplies); uniforms
+# take the top 24 bits so the f32 math is exact and the numpy oracle
+# (`data/augment.py::device_decisions`) can pin it bitwise.
+# ---------------------------------------------------------------------------
+
+_MASK32 = 0xFFFFFFFF
+_GAMMA = 0x9E3779B97F4A7C15
+
+
+def _const32(c: int) -> tuple:
+    return jnp.uint32((c >> 32) & _MASK32), jnp.uint32(c & _MASK32)
+
+
+def _mul32(a: Array, b: Array) -> tuple:
+    """Full 32x32 -> 64-bit product as (hi, lo) uint32 limbs."""
+    a0, a1 = a & 0xFFFF, a >> 16
+    b0, b1 = b & 0xFFFF, b >> 16
+    p00 = a0 * b0
+    mid = a1 * b0 + (p00 >> 16)
+    mid2 = a0 * b1 + (mid & 0xFFFF)
+    lo = (p00 & 0xFFFF) | ((mid2 & 0xFFFF) << 16)
+    hi = a1 * b1 + (mid >> 16) + (mid2 >> 16)
+    return hi, lo
+
+
+def _mul64(zh: Array, zl: Array, ch, cl) -> tuple:
+    """Low 64 bits of z * c (c as uint32 halves); uint32 wrap IS mod 2^32."""
+    hi, lo = _mul32(zl, cl)
+    return hi + zl * ch + zh * cl, lo
+
+
+def _add64(ah, al, bh, bl) -> tuple:
+    lo = al + bl
+    carry = (lo < al).astype(jnp.uint32)
+    return ah + bh + carry, lo
+
+
+def _shr_xor(zh: Array, zl: Array, n: int) -> tuple:
+    """z ^ (z >> n) for 0 < n < 32."""
+    return zh ^ (zh >> n), zl ^ ((zl >> n) | (zh << (32 - n)))
+
+
+def _splitmix64(zh: Array, zl: Array) -> tuple:
+    """data/augment.py::_splitmix on uint32 limbs, bit-for-bit."""
+    zh, zl = _shr_xor(zh, zl, 30)
+    zh, zl = _mul64(zh, zl, *_const32(0xBF58476D1CE4E5B9))
+    zh, zl = _shr_xor(zh, zl, 27)
+    zh, zl = _mul64(zh, zl, *_const32(0x94D049BB133111EB))
+    return _shr_xor(zh, zl, 31)
+
+
+def augment_draws(seed: int, epoch: Array, idx: Array) -> tuple:
+    """Per-row draws: (flip bool, u_scale, u_off_y, u_off_x, u_ty, u_tx).
+
+    Bitwise-identical to `data/augment.py::device_decisions` (the numpy
+    oracle): same masked (seed, epoch, idx) counter-mix, same +GAMMA
+    chaining, uniforms = top 24 bits of each output scaled by 2^-24 —
+    exactly representable in f32 on both sides."""
+    s = (int(seed) * _GAMMA) & 0xFFFFFFFFFFFFFFFF
+    sh, sl = jnp.uint32(s >> 32), jnp.uint32(s & _MASK32)
+    e = epoch.astype(jnp.uint32)
+    i = idx.astype(jnp.uint32)
+    zero = jnp.zeros_like(e)
+    eh, el = _mul64(zero, e, *_const32(0xBF58476D1CE4E5B9))
+    ih, il = _mul64(zero, i, *_const32(0x94D049BB133111EB))
+    mh, ml = _add64(*_add64(sh, sl, eh, el), ih, il)
+    gh, gl = _const32(_GAMMA)
+    zh, zl = _splitmix64(mh, ml)
+    flip = (zl & 1).astype(bool)
+
+    def _next(z):
+        return _splitmix64(*_add64(z[0], z[1], gh, gl))
+
+    def _uniform(z):
+        return (z[0] >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+    z2 = _next((zh, zl))
+    z3 = _next(z2)
+    z4 = _next(z3)
+    z5 = _next(z4)
+    z6 = _next(z5)
+    return (flip, _uniform(z2), _uniform(z3), _uniform(z4),
+            _uniform(z5), _uniform(z6))
+
+
+def hflip_batch_with_boxes(
+    images: Array, boxes: Array, labels: Array, flip: Array
+) -> tuple:
+    """Mirror the rows of a batch where ``flip`` is set: image columns
+    reversed, each real (labels >= 0) box's x-span reflected
+    ((y1,x1,y2,x2) -> (y1, W-x2, y2, W-x1)); padded rows untouched.
+    Bitwise parity with `data/augment.py::hflip_sample`."""
+    w = images.shape[2]
+    images = jnp.where(
+        flip[:, None, None, None], images[:, :, ::-1, :], images
+    )
+    mirrored = jnp.stack(
+        [boxes[..., 0], w - boxes[..., 3], boxes[..., 2], w - boxes[..., 1]],
+        axis=-1,
+    )
+    take = flip[:, None] & (labels >= 0)
+    return images, jnp.where(take[..., None], mirrored, boxes)
+
+
+def _translate_image(image: Array, dy: Array, dx: Array) -> Array:
+    """Integer content shift on a fixed canvas: output (y, x) reads input
+    (y + dy, x + dx); out-of-range reads take the channel-mean fill (the
+    same fill convention as `scale_jitter_image`). Pure gather — no
+    interpolation, so in-range pixels are bitwise-exact."""
+    h, w = image.shape[0], image.shape[1]
+    iy = jnp.arange(h, dtype=jnp.int32) + dy
+    ix = jnp.arange(w, dtype=jnp.int32) + dx
+    out = image[jnp.clip(iy, 0, h - 1)][:, jnp.clip(ix, 0, w - 1)]
+    fill = image.astype(jnp.float32).mean(axis=(0, 1))
+    if image.dtype == jnp.uint8:
+        fill = jnp.clip(jnp.round(fill), 0, 255)
+    fill = fill.astype(image.dtype)
+    valid = ((iy >= 0) & (iy < h))[:, None, None] & (
+        (ix >= 0) & (ix < w)
+    )[None, :, None]
+    return jnp.where(valid, out, fill[None, None, :])
+
+
+def translate_batch_with_boxes(
+    images: Array,
+    boxes: Array,
+    labels: Array,
+    mask: Array,
+    shifts: Array,
+) -> tuple:
+    """Batch translation jitter: images gather-shifted by int32 ``shifts``
+    [N, 2] = (dy, dx); real boxes move by (-dy, -dx) with canvas clip;
+    rows collapsing below 1 px take the padded-row convention (label -1,
+    mask False, -1 geometry). (dy, dx) == (0, 0) is an exact identity."""
+    h, w = images.shape[1], images.shape[2]
+    images = jax.vmap(_translate_image)(images, shifts[:, 0], shifts[:, 1])
+    d = shifts.astype(boxes.dtype)
+    d = jnp.concatenate([d, d], axis=-1)[:, None, :]  # (dy, dx, dy, dx)
+    lim = jnp.asarray([h, w, h, w], jnp.float32).astype(boxes.dtype)
+    b = jnp.clip(boxes - d, 0.0, lim)
+    valid = labels >= 0
+    collapsed = ((b[..., 2] - b[..., 0]) < 1.0) | (
+        (b[..., 3] - b[..., 1]) < 1.0
+    )
+    kill = valid & collapsed
+    boxes = jnp.where(valid[..., None], b, boxes)
+    boxes = jnp.where(kill[..., None], -1.0, boxes)
+    labels = jnp.where(kill, -1, labels)
+    mask = jnp.where(kill, False, mask)
+    return images, boxes, labels, mask
+
+
+def jitter_boxes_batch(
+    boxes: Array,
+    labels: Array,
+    mask: Array,
+    geom: Array,
+    h: int,
+    w: int,
+    apply: Array,
+) -> tuple:
+    """Batch half of `data/augment.py::jitter_boxes`: the affine
+    b*s - shift with canvas clip; sub-1px rows collapse to the padded-row
+    convention. ``apply`` [N] masks the rows whose geometry is not the
+    identity (identity rows pass through untouched, like the host path's
+    integer deadband)."""
+    g = geom.astype(jnp.float32)
+    sy, sx = g[:, 0] / h, g[:, 1] / w
+    scale = jnp.stack([sy, sx, sy, sx], axis=-1)[:, None, :]
+    shift = jnp.stack([g[:, 2], g[:, 3], g[:, 2], g[:, 3]], axis=-1)[
+        :, None, :
+    ]
+    lim = jnp.asarray([h, w, h, w], jnp.float32)
+    b = jnp.clip(boxes * scale - shift, 0.0, lim).astype(boxes.dtype)
+    take = apply[:, None] & (labels >= 0)
+    collapsed = ((b[..., 2] - b[..., 0]) < 1.0) | (
+        (b[..., 3] - b[..., 1]) < 1.0
+    )
+    kill = take & collapsed
+    boxes = jnp.where(take[..., None], b, boxes)
+    boxes = jnp.where(kill[..., None], -1.0, boxes)
+    labels = jnp.where(kill, -1, labels)
+    mask = jnp.where(kill, False, mask)
+    return boxes, labels, mask
+
+
+def augment_batch(
+    images: Array,
+    boxes: Array,
+    labels: Array,
+    mask: Array,
+    aug: Array,
+    *,
+    seed: int,
+    hflip: bool = False,
+    scale_range=None,
+    translate: float = 0.0,
+) -> tuple:
+    """The whole train augmentation as ONE jitted batch transform.
+
+    ``aug`` int32 [N, 2] = (dataset idx, epoch) per row; ``seed`` is
+    static (baked into the trace from config). Order: flip, then
+    translation jitter, then fixed-canvas scale jitter — each applied on
+    the base canvas, ahead of any bucket resample
+    (`resize_batch_with_boxes`). Rows whose draws are the identity pass
+    through bitwise-untouched."""
+    flip, u_s, u_oy, u_ox, u_ty, u_tx = augment_draws(
+        seed, aug[:, 1], aug[:, 0]
+    )
+    h, w = images.shape[1], images.shape[2]
+    if hflip:
+        images, boxes = hflip_batch_with_boxes(images, boxes, labels, flip)
+    if translate:
+        amp_y = jnp.float32(translate * h)
+        amp_x = jnp.float32(translate * w)
+        dy = jnp.round((2.0 * u_ty - 1.0) * amp_y).astype(jnp.int32)
+        dx = jnp.round((2.0 * u_tx - 1.0) * amp_x).astype(jnp.int32)
+        images, boxes, labels, mask = translate_batch_with_boxes(
+            images, boxes, labels, mask, jnp.stack([dy, dx], axis=-1)
+        )
+    if scale_range is not None:
+        # scale_range is the static config tuple — plain Python floats
+        lo, hi = scale_range
+        scale = jnp.float32(lo) + jnp.float32(hi - lo) * u_s
+        ch = jnp.maximum(1, jnp.round(jnp.float32(h) * scale)).astype(
+            jnp.int32
+        )
+        cw = jnp.maximum(1, jnp.round(jnp.float32(w) * scale)).astype(
+            jnp.int32
+        )
+        shy = jnp.round(
+            (ch - h).astype(jnp.float32) * jnp.clip(u_oy, 0.0, 1.0)
+        ).astype(jnp.int32)
+        shx = jnp.round(
+            (cw - w).astype(jnp.float32) * jnp.clip(u_ox, 0.0, 1.0)
+        ).astype(jnp.int32)
+        geom = jnp.stack([ch, cw, shy, shx], axis=-1)
+        jittered = jnp.any(
+            geom != jnp.asarray([h, w, 0, 0], jnp.int32), axis=-1
+        )
+        resampled = batched_scale_jitter(images, geom)
+        images = jnp.where(jittered[:, None, None, None], resampled, images)
+        boxes, labels, mask = jitter_boxes_batch(
+            boxes, labels, mask, geom, h, w, jittered
+        )
+    return images, boxes, labels, mask
+
+
 def resize_batch_with_boxes(
     images: Array, boxes: Array, out_hw: tuple
 ) -> tuple:

@@ -158,6 +158,10 @@ class PlanContext:
     n_devices: int = 1
     process_count: int = 1
     train_buckets: int = 0  # len(data.train_resolutions); 0 = off
+    # the actual bucket resolutions, for per-resolution cells (empty when
+    # multi-scale is off; kept alongside train_buckets so cells that only
+    # need the count stay constructible without inventing shapes)
+    train_resolutions: Tuple[Tuple[int, int], ...] = ()
 
     @property
     def n_model(self) -> int:
@@ -189,6 +193,9 @@ class PlanContext:
             n_devices=n_devices,
             process_count=process_count,
             train_buckets=len(config.data.train_resolutions),
+            train_resolutions=tuple(
+                tuple(r) for r in config.data.train_resolutions
+            ),
         )
 
 
@@ -362,26 +369,31 @@ DECISION_TABLE: Tuple[Cell, ...] = (
             "explicit shard_map backend feeds host batches"
         ),
     ),
+    # Bucketed multi-scale composes with every backend: the shard_map
+    # in/out specs shard batch dims only, so they are resolution-
+    # independent, and each bucket compiles its own program with the
+    # resample traced into the body (train/warmup.py bucket builders).
+    # The only genuine constraint is spatial row divisibility, checked
+    # PER RESOLUTION below — a bucket set is rejected only when a named
+    # resolution actually violates it.
     Cell(
-        "buckets_backend",
+        "buckets_spatial_rows",
         "error",
-        lambda c: c.train_buckets > 0 and c.backend == "spmd",
         lambda c: (
-            "multi-scale buckets (data.train_resolutions) compile one "
-            "jit auto-partitioned program per bucket; the explicit "
-            "shard_map backend builds its in/out specs for a single "
-            "static canvas — use train.backend='auto' with buckets"
+            c.train_buckets > 0
+            and c.spatial
+            and c.num_model >= 2
+            and any(r[0] % c.num_model != 0 for r in c.train_resolutions)
         ),
-    ),
-    Cell(
-        "buckets_spatial",
-        "error",
-        lambda c: c.train_buckets > 0 and c.spatial,
         lambda c: (
-            "multi-scale buckets and spatial partitioning both change "
-            "the per-program image rows; the row-divisibility contract "
-            "cannot hold across buckets — drop --spatial or "
-            "data.train_resolutions"
+            "spatial partitioning needs every bucket's image rows "
+            f"divisible by the model axis ({c.num_model}); offending "
+            "data.train_resolutions: "
+            + ", ".join(
+                f"{r[0]}x{r[1]} ({r[0]} rows)"
+                for r in c.train_resolutions
+                if r[0] % c.num_model != 0
+            )
         ),
     ),
     Cell(
@@ -429,4 +441,5 @@ SPATIAL_CELLS: Tuple[str, ...] = (
     "spatial_backend",
     "spatial_num_model",
     "spatial_rows",
+    "buckets_spatial_rows",
 )

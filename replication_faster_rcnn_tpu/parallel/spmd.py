@@ -67,6 +67,7 @@ def make_shard_map_train_step(
     mesh: Mesh,
     steps_per_dispatch: int = 1,
     state_template: TrainState = None,
+    train_resolution=None,
 ):
     """Build the explicitly-collectivized (state, batch) -> (state, metrics)
     step. State must be replicated on ``mesh``; batch arrays sharded on
@@ -88,6 +89,14 @@ def make_shard_map_train_step(
     then axis 1), psum'ing grads/metrics every fused step; metrics return
     stacked [K, ...]. The carry state never leaves the program between the
     fused steps — one dispatch, K updates.
+
+    ``train_resolution`` (STATIC ``(h, w)`` or None) builds the step for
+    ONE multi-scale training bucket: the resample to the bucket's shape
+    is traced into the per-shard body (`compute_losses`), so each bucket
+    is its own shard_map program. The in/out specs are untouched — they
+    shard only batch dims (``P(axis)`` / ``P(None, axis)``), which is
+    resolution-independent; only the traced body and the Plan label
+    (``train_step_{h}x{w}``) differ between buckets.
 
     ``config.train.grad_allreduce_dtype`` = "bfloat16" casts the gradient
     tree to bf16 BEFORE the psum — THE all-reduce then moves half the
@@ -123,6 +132,7 @@ def make_shard_map_train_step(
             return compute_losses(
                 model, cfg, params, state.batch_stats, batch, step_rng,
                 True, axis_name=axis, positions=positions,
+                train_resolution=train_resolution,
             )
 
         (_, (metrics, new_stats)), grads = jax.value_and_grad(
@@ -246,6 +256,7 @@ def make_shard_map_train_step(
                 return compute_losses(
                     model, cfg, params, state.batch_stats, batch, step_rng,
                     True, axis_name=axis, positions=positions,
+                    train_resolution=train_resolution,
                 )
 
             (_, (metrics, new_stats)), grads = jax.value_and_grad(
@@ -345,14 +356,22 @@ def make_shard_map_train_step(
     else:
         body, batch_spec = step_body, P(axis)
 
+    label = (
+        "train_step"
+        if steps_per_dispatch <= 1
+        else f"multi_step_k{steps_per_dispatch}"
+    )
+    if train_resolution is not None:
+        # per-bucket program: same label convention as the trainer's
+        # cached/loader bucket steps, so strict dispatch accounting and
+        # the warmup registry agree on names across backends
+        label = f"{label}_{int(train_resolution[0])}x{int(train_resolution[1])}"
     plan = Plan(
         mesh=mesh,
         in_specs=(state_spec, batch_spec),
         out_specs=(state_spec, P()),
         donate_argnums=(0,),
         param_specs=state_spec,
-        label="train_step"
-        if steps_per_dispatch <= 1
-        else f"multi_step_k{steps_per_dispatch}",
+        label=label,
     )
     return compile_step_with_plan(body, plan), model

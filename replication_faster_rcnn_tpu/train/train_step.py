@@ -121,6 +121,26 @@ def compute_losses(
     gt_boxes = batch["boxes"]
     gt_labels = batch["labels"]
     gt_mask = batch["mask"]
+    if "aug" in batch:
+        # FULLY on-device augmentation (data.augment_device): the host
+        # shipped raw samples + int32 (idx, epoch) rows; flip, translate
+        # and scale-jitter decisions are splitmix draws of
+        # (seed, epoch, idx) computed here, identical on every shard and
+        # every resume with zero communication. Runs at the base canvas,
+        # ahead of the bucket resample below.
+        from replication_faster_rcnn_tpu.ops.image import augment_batch
+
+        images, gt_boxes, gt_labels, gt_mask = augment_batch(
+            images,
+            gt_boxes,
+            gt_labels,
+            gt_mask,
+            batch["aug"],
+            seed=config.train.seed,
+            hflip=config.data.augment_hflip,
+            scale_range=config.data.augment_scale,
+            translate=config.data.augment_translate,
+        )
     if train_resolution is not None:
         # multi-scale bucket resample (static shape, per-bucket program)
         from replication_faster_rcnn_tpu.ops.image import (

@@ -249,6 +249,8 @@ class Trainer:
                 augment_hflip=config.data.augment_hflip,
                 augment_scale=config.data.augment_scale,
                 augment_scale_device=config.data.augment_scale_device,
+                augment_device=config.data.augment_device,
+                augment_translate=config.data.augment_translate,
                 cache_ram=config.data.loader_cache_ram,
                 process_index=self._rank,
                 process_count=self._process_count,
@@ -380,8 +382,9 @@ class Trainer:
         # training, so the strict harness, warmup registry and HLO audit
         # all see per-bucket programs as first-class citizens. The
         # unbucketed programs above stay (jit is lazy; they only compile
-        # if dispatched). Feed/backend compatibility was already rejected
-        # by the Plan.validate decision table.
+        # if dispatched). Buckets compose with every backend — the only
+        # genuine constraint (spatial row divisibility per resolution) was
+        # already checked by the Plan.validate decision table.
         self._bucket_resolutions = tuple(config.data.train_resolutions)
         self.jitted_bucket_steps = None
         self.jitted_bucket_multi_steps = None
@@ -394,6 +397,35 @@ class Trainer:
             k = self.steps_per_dispatch
             steps, multis = [], []
             for bh, bw in self._bucket_resolutions:
+                if config.train.backend == "spmd":
+                    # per-bucket shard_map program: the in/out specs shard
+                    # batch dims only (resolution-independent), so each
+                    # bucket reuses the same Plan shape with the bucket's
+                    # resample traced into the per-shard body — bucketed
+                    # multi-scale composes with spmd and ZeRO-1 unchanged
+                    from replication_faster_rcnn_tpu.parallel import (
+                        make_shard_map_train_step,
+                    )
+
+                    jitted, _ = make_shard_map_train_step(
+                        config, self.tx, self.mesh,
+                        state_template=self.state,
+                        train_resolution=(bh, bw),
+                    )
+                    steps.append(
+                        scope_jitted(jitted, config) if pallas else jitted
+                    )
+                    if k > 1:
+                        mj, _ = make_shard_map_train_step(
+                            config, self.tx, self.mesh,
+                            steps_per_dispatch=k,
+                            state_template=self.state,
+                            train_resolution=(bh, bw),
+                        )
+                        multis.append(
+                            scope_jitted(mj, config) if pallas else mj
+                        )
+                    continue
                 plan = dataclasses.replace(
                     self._step_plan, label=f"train_step_{bh}x{bw}"
                 )

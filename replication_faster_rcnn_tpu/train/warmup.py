@@ -174,11 +174,13 @@ def bucket_train_program_names(
     ks: Sequence[int] = (1,),
 ) -> Tuple[str, ...]:
     """Every per-bucket train program the config's trainer would compile
-    (empty when data.train_resolutions is unset)."""
+    (empty when data.train_resolutions is unset). EVERY train feed
+    buckets: the shard_map/mp feeds compile one program per resolution
+    with the resample traced into the body, the in/out specs unchanged
+    (they shard batch dims only, which is resolution-independent)."""
     return tuple(
         bucket_train_program_name(feed, k, h, w)
         for feed in feeds
-        if feed in ("loader", "cached")
         for k in ks
         for h, w in config.data.train_resolutions
     )
@@ -606,7 +608,11 @@ def build_program_specs(
         # (train/train_step.py::make_cached_train_step)
         return compile_step_with_plan(fn, _pjit_plan(state_shardings)), args
 
-    def _mp(k: int, shard_opt: bool = False):
+    def _mp(
+        k: int,
+        shard_opt: bool = False,
+        res: Optional[Tuple[int, int]] = None,
+    ):
         # model-parallel feed: the mp (dp, mp) mesh, params sharded 1/mp
         # over the model axis in BOTH the abstract inputs and the
         # out_shardings; the step function itself is the plain auto-
@@ -633,7 +639,7 @@ def build_program_specs(
             )
             for key, v in batch_raw.items()
         }
-        step_fn = make_train_step(model, mcfg, tx)
+        step_fn = make_train_step(model, mcfg, tx, train_resolution=res)
         if k == 1:
             fn, args = step_fn, (state_mp, batch_mp)
         else:
@@ -650,7 +656,7 @@ def build_program_specs(
             args,
         )
 
-    def _spmd(k: int):
+    def _spmd(k: int, res: Optional[Tuple[int, int]] = None):
         from replication_faster_rcnn_tpu.parallel.spmd import (
             make_shard_map_train_step,
         )
@@ -658,12 +664,14 @@ def build_program_specs(
         scfg = config.replace(
             train=dataclasses.replace(config.train, shard_opt_state=False)
         )
-        jitted, _ = make_shard_map_train_step(scfg, tx, mesh, steps_per_dispatch=k)
+        jitted, _ = make_shard_map_train_step(
+            scfg, tx, mesh, steps_per_dispatch=k, train_resolution=res
+        )
         if k == 1:
             return jitted, (state_rep, batch_abs)
         return jitted, (state_rep, _chunk_abs(k))
 
-    def _zero(k: int):
+    def _zero(k: int, res: Optional[Tuple[int, int]] = None):
         from replication_faster_rcnn_tpu.parallel.spmd import (
             make_shard_map_train_step,
         )
@@ -676,13 +684,14 @@ def build_program_specs(
         zero_shardings = train_state_shardings(state_raw, mesh, mesh_cfg, True)
         state_zero = _attach(state_raw, zero_shardings)
         jitted, _ = make_shard_map_train_step(
-            zcfg, tx, mesh, steps_per_dispatch=k, state_template=state_raw
+            zcfg, tx, mesh, steps_per_dispatch=k, state_template=state_raw,
+            train_resolution=res,
         )
         if k == 1:
             return jitted, (state_zero, batch_abs)
         return jitted, (state_zero, _chunk_abs(k))
 
-    def _zero_lamb(k: int):
+    def _zero_lamb(k: int, res: Optional[Tuple[int, int]] = None):
         from replication_faster_rcnn_tpu.parallel.spmd import (
             make_shard_map_train_step,
         )
@@ -708,7 +717,8 @@ def build_program_specs(
         lamb_shardings = train_state_shardings(lstate_raw, mesh, mesh_cfg, True)
         state_lamb = _attach(lstate_raw, lamb_shardings)
         jitted, _ = make_shard_map_train_step(
-            lcfg, ltx, mesh, steps_per_dispatch=k, state_template=lstate_raw
+            lcfg, ltx, mesh, steps_per_dispatch=k, state_template=lstate_raw,
+            train_resolution=res,
         )
         if k == 1:
             return jitted, (state_lamb, batch_abs)
@@ -778,7 +788,15 @@ def build_program_specs(
         # on-device resample into the trace (the Trainer's own per-bucket
         # jit sites) — registered here so warmup pre-compiles them and
         # the HLO audit banks them exactly like serving buckets.
-        bucket_builders = {"loader": _loader, "cached": _cached}
+        bucket_builders = {
+            "loader": _loader,
+            "cached": _cached,
+            "spmd": _spmd,
+            "zero": _zero,
+            "zero_lamb": _zero_lamb,
+            "mp": _mp,
+            "mp_zero": (lambda k, res=None: _mp(k, shard_opt=True, res=res)),
+        }
         for feed in feeds:
             if feed not in bucket_builders:
                 continue
@@ -795,7 +813,18 @@ def build_program_specs(
                                 f
                             ](kk, res=(hh, ww))
                         ),
-                        meta={**meta, "bucket": [bh, bw]},
+                        # mp bucket programs lower on the (dp, mp) mesh —
+                        # they need ITS shape for the model-axis
+                        # collective classification, same as their
+                        # non-bucket rows
+                        meta={
+                            **(
+                                mp_meta
+                                if feed in ("mp", "mp_zero")
+                                else meta
+                            ),
+                            "bucket": [bh, bw],
+                        },
                     )
     if include_eval:
         specs["eval_infer"] = ProgramSpec(

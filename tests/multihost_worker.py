@@ -76,6 +76,8 @@ def main() -> int:
 
     if len(sys.argv) > 4 and sys.argv[4] == "preempt":
         return _preempt_zero_spmd(process_id, sys.argv[5])
+    if len(sys.argv) > 4 and sys.argv[4] == "buckets":
+        return _buckets_augment_spmd(process_id, sys.argv[5])
     if len(sys.argv) > 4 and sys.argv[4] == "trainstep":
         _train_step_across_processes(process_id, n_global)
         # default workdir is scoped to the coordinator address AND cleaned
@@ -321,6 +323,140 @@ def _preempt_zero_spmd(process_id: int, workdir: str) -> int:
         mark(f"preempted step={exc.step} emergency saved")
         return fault.EXIT_PREEMPTED
     raise AssertionError("run completed without being preempted")
+
+
+def _buckets_augment_spmd(process_id: int, workdir: str) -> int:
+    """The multi-scale acceptance leg: the coco_overfit bucketed recipe
+    (coco-format synthetic data, 2 train buckets) on a REAL 2-process
+    gloo fleet with the shard_map backend AND fully on-device
+    augmentation (hflip + scale + translation jitter), reproduced
+    BITWISE across a SIGTERM kill-and-resume mid-epoch.
+
+    Three phases in one process, same global mesh throughout:
+
+    1. baseline — train 8 global steps uninterrupted, hash the params;
+    2. preempt  — fresh workdir, SIGTERM at step 5 (mid-epoch-2), the
+       collective emergency save lands on both ranks;
+    3. resume   — restore the emergency checkpoint on the SAME topology
+       and finish.
+
+    Same reduction topology + f32 grad exchange + counter-keyed bucket
+    and augmentation streams (`bucket_index`, `augment_draws` on (seed,
+    epoch, dataset idx)) ⇒ the resumed trajectory must equal the
+    baseline bit for bit — tolerance here would hide a replay bug.
+    """
+    import hashlib
+    import signal
+    import time
+
+    import jax
+    import numpy as np
+
+    from benchmarks.coco_overfit import MINI_BUCKETS, write_synthetic_coco
+    from replication_faster_rcnn_tpu.config import (
+        DataConfig,
+        FasterRCNNConfig,
+        MeshConfig,
+        ModelConfig,
+        ProposalConfig,
+        ROITargetConfig,
+        TrainConfig,
+    )
+    from replication_faster_rcnn_tpu.data import make_dataset
+    from replication_faster_rcnn_tpu.train import fault
+    from replication_faster_rcnn_tpu.train.trainer import Trainer
+
+    def mark(msg: str) -> None:
+        print(f"proc {process_id}: buckets-leg {msg}", flush=True)
+
+    n_global = len(jax.devices())
+    # rank-local copy of the coco-format synthetic set: the writer is
+    # seed-deterministic, so both ranks hold identical data without any
+    # cross-process filesystem coordination
+    data_root = os.path.join(workdir, f"coco_rank{process_id}")
+    write_synthetic_coco(data_root, "train2017", 32, 64, seed=0)
+    cfg = FasterRCNNConfig(
+        model=ModelConfig(
+            backbone="resnet18", roi_op="align", compute_dtype="float32",
+            num_classes=9,
+        ),
+        data=DataConfig(
+            dataset="coco", root_dir=data_root, image_size=(64, 64),
+            max_boxes=8,
+            train_resolutions=tuple(MINI_BUCKETS),
+            augment_device=True, augment_hflip=True,
+            augment_scale=(0.75, 1.25), augment_translate=0.1,
+        ),
+        train=TrainConfig(
+            batch_size=n_global,
+            n_epoch=2,
+            backend="spmd",
+            # f32 grad exchange: the bitwise contract must not depend on
+            # bf16 rounding staying reassociation-stable
+            grad_allreduce_dtype="float32",
+        ),
+        mesh=MeshConfig(num_data=n_global),
+        proposals=ProposalConfig(pre_nms_train=128, post_nms_train=32),
+        roi_targets=ROITargetConfig(n_sample=8),
+    )
+    # 32 images / global batch 8 -> 4 steps per epoch, 8 total; the
+    # kill at step 5 lands mid-epoch-2 so the resume replays a bucketed,
+    # augmented epoch from a nonzero start_batch offset
+    ds = make_dataset(cfg.data, "train")
+
+    def params_hash(trainer) -> str:
+        host = jax.device_get(trainer._host_state())
+        h = hashlib.sha256()
+        for leaf in jax.tree_util.tree_leaves(
+            {"p": host.params, "bn": host.batch_stats}
+        ):
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+        return h.hexdigest()
+
+    # phase 1: uninterrupted baseline
+    base = Trainer(cfg, workdir=os.path.join(workdir, "base"), dataset=ds)
+    mark("baseline trainer built")
+    base.train(log_every=1)
+    assert int(jax.device_get(base.state.step)) == 8
+    base_hash = params_hash(base)
+    mark(f"baseline done hash={base_hash}")
+    del base
+
+    # phase 2: fresh run, SIGTERM at the step-5 dispatch boundary
+    pre_dir = os.path.join(workdir, "pre")
+    pre = Trainer(cfg, workdir=pre_dir, dataset=ds)
+    orig_check = pre._check_preemption
+
+    def check(step: int) -> None:
+        sd = pre._shutdown
+        if step >= 5 and sd is not None and not sd.requested:
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.time() + 10.0
+            while not sd.requested and time.time() < deadline:
+                time.sleep(0.01)
+        orig_check(step)
+
+    pre._check_preemption = check
+    try:
+        pre.train(log_every=1)
+    except fault.Preempted as exc:
+        mark(f"preempted step={exc.step} emergency saved")
+        assert exc.step == 5, exc.step
+    else:
+        raise AssertionError("run completed without being preempted")
+    del pre
+
+    # phase 3: resume the emergency checkpoint on the SAME topology
+    resumed = Trainer(cfg, workdir=pre_dir, dataset=ds)
+    resumed.train(log_every=1, resume=True)
+    assert int(jax.device_get(resumed.state.step)) == 8
+    resume_hash = params_hash(resumed)
+    mark(f"resume done hash={resume_hash}")
+    assert resume_hash == base_hash, (
+        f"bucketed+augmented resume diverged: {resume_hash} != {base_hash}"
+    )
+    mark("bitwise parity OK")
+    return 0
 
 
 def _train_step_across_processes(process_id: int, n_global: int) -> None:

@@ -205,15 +205,22 @@ class TestBucketProgramNames:
             expected_program_names,
         )
 
+        from replication_faster_rcnn_tpu.analysis.hlolint import (
+            AUDIT_FEEDS,
+            AUDIT_KS,
+        )
+
         names = expected_program_names(config=audit_config())
         buckets = [n for n in names if n.endswith(("_32x32", "_64x64"))
                    and n.startswith("train_")]
-        assert sorted(buckets) == [
-            "train_cached_k1_32x32", "train_cached_k1_64x64",
-            "train_cached_k2_32x32", "train_cached_k2_64x64",
-            "train_loader_k1_32x32", "train_loader_k1_64x64",
-            "train_loader_k2_32x32", "train_loader_k2_64x64",
-        ]
+        # EVERY train feed buckets (ISSUE 19): feeds x ks x 2 resolutions
+        expected = sorted(
+            f"train_{feed}_k{k}_{res}"
+            for feed in AUDIT_FEEDS
+            for k in AUDIT_KS
+            for res in ("32x32", "64x64")
+        )
+        assert sorted(buckets) == expected
 
     def test_committed_bank_covers_bucket_programs(self):
         bank = os.path.join(

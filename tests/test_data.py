@@ -227,6 +227,17 @@ class TestAugment:
         np.testing.assert_array_equal(ff["image"], s["image"])
         np.testing.assert_allclose(ff["boxes"][m], s["boxes"][m])
 
+    def test_hflip_sample_returns_contiguous(self):
+        """The flipped image must be C-contiguous, not a negative-stride
+        view — downstream np.stack/device_put copy paths assume owned
+        row-major memory, and a view pins the unflipped parent buffer."""
+        from replication_faster_rcnn_tpu.data.augment import hflip_sample
+
+        ds = SyntheticDataset(_cfg(), length=1)
+        f = hflip_sample(ds[0])
+        assert f["image"].flags["C_CONTIGUOUS"]
+        assert all(s >= 0 for s in f["image"].strides)
+
     def test_hflip_flips_difficult_rows_too(self):
         """Geometry is keyed on labels >= 0, not the training mask —
         difficult objects (masked from training) must still track the
@@ -780,6 +791,258 @@ class TestDeviceScaleJitter:
 
         with pytest.raises(ValueError, match="augment_scale_device"):
             DataConfig(augment_scale_device=True)
+
+
+class TestDeviceAugment:
+    """data.augment_device: the fully on-device augmentation pipeline
+    (`ops/image.py::augment_batch`) against its host-numpy oracles in
+    `data/augment.py` — the host ships raw pixels + an (idx, epoch) tag,
+    every decision and every transform happens inside the jitted step."""
+
+    def _batch(self, n=3, epoch=0, seed=7):
+        ds = SyntheticDataset(_cfg(), length=n)
+        batch = collate([ds[i] for i in range(n)])
+        batch["aug"] = np.stack(
+            [np.asarray([i, epoch], np.int32) for i in range(n)]
+        )
+        return ds, batch
+
+    def test_draws_match_host_oracle_bitwise(self):
+        import jax.numpy as jnp
+
+        from replication_faster_rcnn_tpu.data.augment import device_decisions
+        from replication_faster_rcnn_tpu.ops.image import augment_draws
+
+        seeds = [0, 1, 123, 2**31 - 1]
+        epochs = [0, 1, 7, 500]
+        idxs = [0, 1, 2, 999, 123456, 2**31 - 1]
+        for seed in seeds:
+            e = jnp.asarray(
+                [ep for ep in epochs for _ in idxs], jnp.int32
+            )
+            i = jnp.asarray(
+                [ix for _ in epochs for ix in idxs], jnp.int32
+            )
+            dev = augment_draws(seed, e, i)
+            for row, (ep, ix) in enumerate(
+                [(ep, ix) for ep in epochs for ix in idxs]
+            ):
+                host = device_decisions(seed, ep, ix)
+                assert bool(dev[0][row]) == host[0]
+                for d, hval in zip(dev[1:], host[1:]):
+                    # bitwise: both sides are exact f32
+                    assert np.float32(d[row]) == hval
+
+    def test_hflip_batch_matches_host_oracle(self):
+        import jax.numpy as jnp
+
+        from replication_faster_rcnn_tpu.data.augment import hflip_sample
+        from replication_faster_rcnn_tpu.ops.image import (
+            hflip_batch_with_boxes,
+        )
+
+        ds, batch = self._batch(n=2)
+        flip = jnp.asarray([True, False])
+        imgs, boxes = hflip_batch_with_boxes(
+            jnp.asarray(batch["image"]),
+            jnp.asarray(batch["boxes"]),
+            jnp.asarray(batch["labels"]),
+            flip,
+        )
+        want = hflip_sample(ds[0])
+        np.testing.assert_array_equal(np.asarray(imgs[0]), want["image"])
+        np.testing.assert_array_equal(np.asarray(boxes[0]), want["boxes"])
+        # unflipped row bitwise-untouched
+        np.testing.assert_array_equal(np.asarray(imgs[1]), batch["image"][1])
+        np.testing.assert_array_equal(
+            np.asarray(boxes[1]), batch["boxes"][1]
+        )
+
+    def test_translate_batch_matches_host_oracle(self):
+        import jax.numpy as jnp
+
+        from replication_faster_rcnn_tpu.data.augment import translate_sample
+        from replication_faster_rcnn_tpu.ops.image import (
+            translate_batch_with_boxes,
+        )
+
+        ds, batch = self._batch(n=3)
+        shifts = np.asarray([[5, -3], [0, 0], [-7, 9]], np.int32)
+        imgs, boxes, labels, mask = translate_batch_with_boxes(
+            jnp.asarray(batch["image"]),
+            jnp.asarray(batch["boxes"]),
+            jnp.asarray(batch["labels"]),
+            jnp.asarray(batch["mask"]),
+            jnp.asarray(shifts),
+        )
+        for r in range(3):
+            want = translate_sample(ds[r], *shifts[r])
+            # in-range pixels are a pure gather — bitwise; the fill rows
+            # take a channel mean whose reduction order may differ in the
+            # last float bit
+            np.testing.assert_allclose(
+                np.asarray(imgs[r]), want["image"], rtol=1e-6, atol=1e-5
+            )
+            np.testing.assert_allclose(
+                np.asarray(boxes[r]), want["boxes"], rtol=1e-6
+            )
+            np.testing.assert_array_equal(np.asarray(labels[r]), want["labels"])
+            np.testing.assert_array_equal(np.asarray(mask[r]), want["mask"])
+        # (0, 0) row is an exact identity
+        np.testing.assert_array_equal(np.asarray(imgs[1]), batch["image"][1])
+
+    def test_jitter_boxes_batch_matches_host(self):
+        import jax.numpy as jnp
+
+        from replication_faster_rcnn_tpu.data.augment import (
+            jitter_boxes,
+            jitter_geometry,
+        )
+        from replication_faster_rcnn_tpu.ops.image import jitter_boxes_batch
+
+        ds, batch = self._batch(n=2)
+        h, w = batch["image"].shape[1:3]
+        geoms = [
+            jitter_geometry(h, w, 0.8, 0.3, 0.6),
+            jitter_geometry(h, w, 1.2, 0.7, 0.2),
+        ]
+        boxes, labels, mask = jitter_boxes_batch(
+            jnp.asarray(batch["boxes"]),
+            jnp.asarray(batch["labels"]),
+            jnp.asarray(batch["mask"]),
+            jnp.asarray(np.asarray(geoms, np.int32)),
+            h,
+            w,
+            jnp.asarray([True, True]),
+        )
+        for r in range(2):
+            want = jitter_boxes(ds[r], geoms[r], h, w)
+            np.testing.assert_allclose(
+                np.asarray(boxes[r]), want["boxes"], atol=1e-4
+            )
+            np.testing.assert_array_equal(np.asarray(labels[r]), want["labels"])
+            np.testing.assert_array_equal(np.asarray(mask[r]), want["mask"])
+
+    def test_loader_ships_aug_tag_and_raw_pixels(self):
+        ds = SyntheticDataset(_cfg(), length=8)
+        loader = DataLoader(
+            ds, batch_size=4, shuffle=False, prefetch=0, seed=5,
+            augment_hflip=True, augment_device=True,
+        )
+        loader.set_epoch(3)
+        batch = next(iter(loader))
+        assert batch["aug"].shape == (4, 2)
+        assert batch["aug"].dtype == np.int32
+        np.testing.assert_array_equal(batch["aug"][:, 1], 3)
+        np.testing.assert_array_equal(batch["aug"][:, 0], np.arange(4))
+        # pixels are untouched — the host loop is gone, not moved
+        np.testing.assert_array_equal(batch["image"][0], ds[0]["image"])
+
+    def test_augment_batch_deterministic_and_epoch_varying(self):
+        import jax
+        import jax.numpy as jnp
+
+        from replication_faster_rcnn_tpu.ops.image import augment_batch
+
+        _, batch = self._batch(n=4, epoch=0)
+        _, batch2 = self._batch(n=4, epoch=1)
+
+        @jax.jit
+        def run(b):
+            return augment_batch(
+                jnp.asarray(b["image"]),
+                jnp.asarray(b["boxes"]),
+                jnp.asarray(b["labels"]),
+                jnp.asarray(b["mask"]),
+                jnp.asarray(b["aug"]),
+                seed=7,
+                hflip=True,
+                scale_range=(0.75, 1.25),
+                translate=0.1,
+            )
+
+        a0 = run(batch)
+        a0b = run(batch)
+        a1 = run(batch2)
+        for x, y in zip(a0, a0b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        assert not np.array_equal(np.asarray(a0[0]), np.asarray(a1[0]))
+
+    def test_train_step_consumes_aug_batch(self):
+        import jax
+        import jax.numpy as jnp
+
+        from replication_faster_rcnn_tpu.config import (
+            DataConfig,
+            FasterRCNNConfig,
+            MeshConfig,
+            ModelConfig,
+            TrainConfig,
+        )
+        from replication_faster_rcnn_tpu.train.train_step import (
+            create_train_state,
+            make_optimizer,
+            make_train_step,
+        )
+
+        cfg = FasterRCNNConfig(
+            model=ModelConfig(
+                backbone="resnet18", roi_op="align", compute_dtype="float32"
+            ),
+            data=DataConfig(
+                dataset="synthetic", image_size=(64, 64), max_boxes=8,
+                augment_hflip=True, augment_scale=(0.75, 1.25),
+                augment_translate=0.1, augment_device=True,
+            ),
+            train=TrainConfig(batch_size=2),
+            mesh=MeshConfig(num_data=1),
+        )
+        ds = SyntheticDataset(cfg.data, length=4)
+        loader = DataLoader(
+            ds, batch_size=2, shuffle=False, prefetch=0,
+            seed=cfg.train.seed,
+            augment_hflip=True, augment_scale=(0.75, 1.25),
+            augment_device=True, augment_translate=0.1,
+        )
+        batch = next(iter(loader))
+        assert batch["aug"].shape == (2, 2)
+        tx, _ = make_optimizer(cfg, steps_per_epoch=10)
+        model, state = create_train_state(cfg, jax.random.PRNGKey(0), tx)
+        step = jax.jit(make_train_step(model, cfg, tx))
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        _, metrics = step(state, jb)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_config_validation(self):
+        from replication_faster_rcnn_tpu.config import DataConfig
+
+        # needs at least one op
+        with pytest.raises(ValueError, match="augment_device"):
+            DataConfig(augment_device=True)
+        # translate requires the device pipeline
+        with pytest.raises(ValueError, match="augment_translate"):
+            DataConfig(augment_translate=0.1)
+        with pytest.raises(ValueError, match="augment_translate"):
+            DataConfig(
+                augment_device=True, augment_hflip=True,
+                augment_translate=1.5,
+            )
+        # supersedes the host-decision device-resample path
+        with pytest.raises(ValueError, match="augment_scale_device"):
+            DataConfig(
+                augment_device=True, augment_scale=(0.75, 1.25),
+                augment_scale_device=True,
+            )
+        # mutually exclusive with the device-resident cache
+        with pytest.raises(ValueError, match="cache_device"):
+            DataConfig(
+                augment_device=True, augment_hflip=True, cache_device=True
+            )
+        # valid spelling constructs
+        DataConfig(
+            augment_device=True, augment_hflip=True,
+            augment_scale=(0.75, 1.25), augment_translate=0.1,
+        )
 
 
 class TestCOCOHardening:

@@ -235,17 +235,55 @@ class TestDecisionTableCells:
         with pytest.raises(ValueError, match="spatial"):
             apply_table(ctx)
 
-    def test_buckets_backend(self):
-        ctx = _ctx(train_buckets=2, backend="spmd")
-        assert "buckets_backend" in _fired(ctx)
-        with pytest.raises(ValueError, match="buckets"):
+    def test_buckets_spmd_composes(self):
+        # the old buckets_backend blanket rejection is gone: the shard_map
+        # specs shard batch dims only, so buckets compile per-resolution
+        ctx = _ctx(
+            train_buckets=2,
+            train_resolutions=((32, 32), (64, 64)),
+            backend="spmd",
+        )
+        assert _fired(ctx) == []
+        apply_table(ctx)  # must not raise
+
+    def test_buckets_spatial_rows(self):
+        # per-resolution check: only the indivisible bucket is named
+        ctx = _ctx(
+            train_buckets=2,
+            train_resolutions=((30, 30), (64, 64)),
+            spatial=True,
+            num_model=4,
+        )
+        [(cell, msg)] = check_cells(ctx)
+        assert cell.name == "buckets_spatial_rows"
+        assert "30x30" in msg and "64x64" not in msg
+        with pytest.raises(ValueError, match="30x30"):
             apply_table(ctx)
 
-    def test_buckets_spatial(self):
-        ctx = _ctx(train_buckets=2, spatial=True, num_model=2)
-        assert "buckets_spatial" in _fired(ctx)
-        with pytest.raises(ValueError, match="buckets"):
-            apply_table(ctx)
+    def test_buckets_spatial_divisible_composes(self):
+        # every bucket's rows divide the model axis -> spatial + buckets
+        # is legal (the old buckets_spatial blanket rejection is gone)
+        ctx = _ctx(
+            train_buckets=2,
+            train_resolutions=((32, 32), (64, 64)),
+            spatial=True,
+            num_model=2,
+        )
+        assert _fired(ctx) == []
+        apply_table(ctx)  # must not raise
+
+    def test_buckets_mp_zero_composes(self):
+        # bucket x model-parallel mesh x ZeRO-1: no cell fires
+        ctx = _ctx(
+            train_buckets=2,
+            train_resolutions=((32, 32), (64, 64)),
+            param_sharding=True,
+            num_model=4,
+            num_data=2,
+            shard_opt_state=True,
+        )
+        assert _fired(ctx) == []
+        apply_table(ctx)  # must not raise
 
     def test_names_filter_restricts_cells(self):
         ctx = _ctx(optimizer="lamb", lars=True, spatial=True, num_model=1)

@@ -589,7 +589,8 @@ class TestServingSpecs:
         }
         # the only other config-dependent names are the remaining pallas
         # twins and the per-bucket training programs (ISSUE 15: the audit
-        # config sets data.train_resolutions)
+        # config sets data.train_resolutions; ISSUE 19: EVERY train feed
+        # buckets, so the matrix is feeds x Ks x resolutions)
         from replication_faster_rcnn_tpu.train.warmup import (
             bucket_train_program_names,
         )
@@ -601,7 +602,10 @@ class TestServingSpecs:
                 ks=hlolint.AUDIT_KS,
             )
         )
-        assert buckets <= extra and len(buckets) == 8
+        expected_buckets = (
+            len(hlolint.AUDIT_FEEDS) * len(hlolint.AUDIT_KS) * 2
+        )
+        assert buckets <= extra and len(buckets) == expected_buckets
         assert extra - serving - buckets == {
             "train_loader_k1__pallas",
             "eval_infer__pallas",
