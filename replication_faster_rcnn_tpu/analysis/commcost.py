@@ -1,0 +1,239 @@
+"""Static collective-communication cost model (pure text work, no jax).
+
+For each collective op in a program's artifacts this module estimates the
+WIRE BYTES PER DEVICE a ring implementation moves, from nothing but the
+op's tensor type and the participating axis size `n`:
+
+    wire_bytes = tensor_bytes × factor(kind, n)
+
+with the standard ring factors (Rabenseifner-style trees change constants,
+not asymptotics, so the ring numbers are the stable thing to bank):
+
+    all_reduce        2(n−1)/n × full          (reduce-scatter + all-gather)
+    reduce_scatter     (n−1)/n × full
+    all_gather         (n−1)   × shard   ==    (n−1)/n × full
+    all_to_all         (n−1)/n × full
+    collective_permute       1 × tensor        (one send per device)
+
+Two inventories, two bases — matching how analysis/fingerprint.py splits
+the collective story:
+
+* LOWERED (StableHLO): collectives the program *wrote* (shard_map
+  bodies). Operand types are read from the lowered text, where
+  all_reduce/reduce_scatter operands are the FULL per-device tensor and
+  all_gather operands are the SHARD. The participating axis is the mesh's
+  data axis (the only axis shard_map programs collect over here).
+* PARTITIONED (compiled HLO): collectives GSPMD inserted after lowering.
+  Result shapes are read from the compiled text — all-reduce/all-gather
+  results are the FULL (per-device) tensor, reduce-scatter results the
+  SHARD — and each op's replica groups are classified against the mesh
+  axes by fingerprint's parser to pick `n`.
+
+A program's headline `wire_bytes_per_device` uses the lowered inventory
+when one exists (shard_map feeds: the compiled text re-shows the same
+ops, but XLA:CPU legalizes bf16 collectives to f32 there, inflating the
+estimate) and falls back to the partitioned inventory for pjit/GSPMD
+programs, whose lowered text has no collectives at all. The `basis` field
+records which. shardlint's SL005 gates this number against
+`analysis.comm_budget_bytes`; `frcnn audit` re-derives it live and fails
+on drift from the bank.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from replication_faster_rcnn_tpu.analysis import fingerprint as _fp
+
+# lowered operand-type regexes: reuse fingerprint's ar/rs/ag patterns and
+# extend with the region-free kinds it has no size patterns for
+_LOWERED_OPERAND_RES = dict(_fp._ELEMENT_TYPE_RES)
+_LOWERED_OPERAND_RES["all_to_all"] = re.compile(
+    r'"stablehlo\.all_to_all"\([^)]*\)\s*<\{.*?\}>\s*:\s*\(tensor<([^>]*)>',
+    re.S,
+)
+_LOWERED_OPERAND_RES["collective_permute"] = re.compile(
+    r'"stablehlo\.collective_permute"\([^)]*\)\s*<\{.*?\}>\s*:\s*'
+    r"\(tensor<([^>]*)>",
+    re.S,
+)
+
+# compiled-HLO instruction line: `%name = <result types> <opcode>(...)`
+# where the result is either one `f32[2,64]{1,0}` or a tuple of them
+_HLO_LINE_RE = re.compile(
+    r"=\s+(?P<res>\(?[a-z]\w*\[[^=]*?)\s+"
+    r"(?P<kind>all-reduce|all-gather|reduce-scatter|all-to-all|"
+    r"collective-permute)"
+    r"(?:-start)?\("
+)
+_HLO_SHAPE_RE = re.compile(r"([a-z]\w*)\[([\d,]*)\]")
+
+# wire-byte factor per unit of the FULL per-device tensor
+_FULL_FACTORS = {
+    "all_reduce": lambda n: 2.0 * (n - 1) / n,
+    "all-reduce": lambda n: 2.0 * (n - 1) / n,
+    "reduce_scatter": lambda n: (n - 1) / n,
+    "reduce-scatter": lambda n: (n - 1) / n,
+    "all_gather": lambda n: (n - 1) / n,
+    "all-gather": lambda n: (n - 1) / n,
+    "all_to_all": lambda n: (n - 1) / n,
+    "all-to-all": lambda n: (n - 1) / n,
+    "collective_permute": lambda n: 1.0,
+    "collective-permute": lambda n: 1.0,
+    "collective_broadcast": lambda n: 1.0,
+}
+
+
+def dtype_bytes(name: str) -> int:
+    """Bytes per element for a StableHLO/HLO element-type name ('bf16',
+    'f32', 's32', 'i1', 'pred', 'u8', ...). Sub-byte types round up."""
+    if name == "pred":
+        return 1
+    m = re.search(r"(\d+)$", name)
+    if not m:
+        raise ValueError(f"unrecognized element type {name!r}")
+    return max(1, int(m.group(1)) // 8)
+
+
+def tensor_type_bytes(tensor: str) -> int:
+    """Bytes of one StableHLO tensor-type body, e.g. '512x21xbf16' ->
+    21504, 'f32' (scalar) -> 4."""
+    parts = tensor.strip().split("x")
+    elems = 1
+    for p in parts[:-1]:
+        elems *= int(p)
+    return elems * dtype_bytes(parts[-1])
+
+
+def _hlo_result_bytes(res: str) -> int:
+    """Bytes of a compiled-HLO result chunk — one shape or a tuple of
+    shapes, e.g. '(f32[4]{0}, f32[8]{0})'."""
+    total = 0
+    for elem, dims in _HLO_SHAPE_RE.findall(res):
+        elems = 1
+        for d in dims.split(","):
+            if d:
+                elems *= int(d)
+        total += elems * dtype_bytes(elem)
+    return total
+
+
+def lowered_comm(
+    stablehlo_text: str, mesh_shape: Optional[Dict[str, int]] = None
+) -> Dict[str, Any]:
+    """Per-kind {ops, operand_bytes, wire_bytes} over a lowered module's
+    hand-written collectives, pricing each op on the mesh's data axis
+    (n=1 -> zero wire bytes: nothing crosses a device boundary)."""
+    n = int((mesh_shape or {}).get("data", 1) or 1)
+    inv: Dict[str, Any] = {}
+    for kind, pattern in _LOWERED_OPERAND_RES.items():
+        sizes = [tensor_type_bytes(t) for t in pattern.findall(stablehlo_text)]
+        if not sizes:
+            continue
+        operand = sum(sizes)
+        if kind == "all_gather":
+            # the lowered operand is the shard; (n−1) × shard on the wire
+            wire = (n - 1) * operand
+        else:
+            wire = _FULL_FACTORS[kind](n) * operand if n > 1 else 0.0
+        inv[kind] = {
+            "ops": len(sizes),
+            "operand_bytes": int(operand),
+            "wire_bytes": int(round(wire)),
+        }
+    return dict(sorted(inv.items()))
+
+
+def _axis_size(axis: str, mesh_shape: Dict[str, int]) -> int:
+    """Participant count for one classified replica-group bucket: a named
+    mesh axis uses its declared size; 'all'/'world'/'other' conservatively
+    use the whole device grid."""
+    if axis in mesh_shape:
+        return max(1, int(mesh_shape[axis] or 1))
+    total = 1
+    for s in mesh_shape.values():
+        total *= max(1, int(s or 1))
+    return max(2, total)
+
+
+def partitioned_comm(
+    compiled_text: str, mesh_shape: Optional[Dict[str, int]] = None
+) -> Dict[str, Any]:
+    """Per-kind {ops, result_bytes, wire_bytes, axes:{axis: {...}}} over a
+    COMPILED module's collectives, result shapes priced per classified
+    replica-group axis. reduce-scatter results are shards, so their wire
+    factor is (n−1) × result; all-reduce/all-gather results are full."""
+    mesh_shape = dict(mesh_shape or {})
+    inv: Dict[str, Any] = {}
+    for line in compiled_text.splitlines():
+        m = _HLO_LINE_RE.search(line)
+        if not m:
+            continue
+        kind = m.group("kind")
+        size = _hlo_result_bytes(m.group("res"))
+        gm = _fp._REPLICA_GROUPS_RE.search(line)
+        groups = _fp._parse_replica_groups(gm.group(1)) if gm else None
+        axis = (
+            _fp._classify_groups(groups, mesh_shape) if groups else "world"
+        )
+        n = _axis_size(axis, mesh_shape)
+        if kind == "reduce-scatter":
+            wire = (n - 1) * size
+        else:
+            wire = _FULL_FACTORS[kind](n) * size if n > 1 else 0.0
+        entry = inv.setdefault(
+            kind, {"ops": 0, "result_bytes": 0, "wire_bytes": 0, "axes": {}}
+        )
+        entry["ops"] += 1
+        entry["result_bytes"] += size
+        entry["wire_bytes"] += int(round(wire))
+        a = entry["axes"].setdefault(
+            axis, {"ops": 0, "result_bytes": 0, "wire_bytes": 0}
+        )
+        a["ops"] += 1
+        a["result_bytes"] += size
+        a["wire_bytes"] += int(round(wire))
+    for entry in inv.values():
+        entry["axes"] = dict(sorted(entry["axes"].items()))
+    return dict(sorted(inv.items()))
+
+
+def collect_comm(
+    stablehlo_text: str,
+    compiled_text: str,
+    mesh_shape: Optional[Dict[str, int]] = None,
+) -> Dict[str, Any]:
+    """The full comm record fingerprint_program banks: both inventories,
+    the chosen basis, and the headline wire_bytes_per_device."""
+    lowered = lowered_comm(stablehlo_text, mesh_shape)
+    partitioned = partitioned_comm(compiled_text, mesh_shape)
+    if lowered:
+        basis = "lowered"
+        total = sum(e["wire_bytes"] for e in lowered.values())
+    elif partitioned:
+        basis = "partitioned"
+        total = sum(e["wire_bytes"] for e in partitioned.values())
+    else:
+        basis = "none"
+        total = 0
+    return {
+        "lowered": lowered,
+        "partitioned": partitioned,
+        "basis": basis,
+        "wire_bytes_per_device": int(total),
+    }
+
+
+def recompute_wire_total(comm: Dict[str, Any]) -> Optional[int]:
+    """Re-derive wire_bytes_per_device from a banked comm record's own
+    per-kind tallies — shardlint's SL005 self-consistency check against a
+    hand-edited bank. None when the record is too malformed to re-sum."""
+    try:
+        basis = comm["basis"]
+        if basis == "none":
+            return 0
+        inv = comm[basis]
+        return int(sum(int(e["wire_bytes"]) for e in inv.values()))
+    except (KeyError, TypeError, ValueError):
+        return None

@@ -41,6 +41,14 @@ HX008  quantization provenance: a ``serve_*__int8`` program whose plan
        other program may contain an i8 dot/conv — quantized weights in
        an uncalibrated program would be a silent numerics break.
 
+SL005  (shardlint's comm-budget rule, live arm) the static collective
+       wire-byte estimate (analysis/commcost.py) of a live program must
+       stay within ``analysis.comm_budget_bytes`` AND within
+       ``COMM_REL_TOL`` of its banked value — accidental collective
+       growth fails the audit naming rule + program. The bank-only arm
+       (and SL001-SL004/SL006) runs in `frcnn check` via
+       analysis/shardlint.py.
+
 `frcnn audit` drives this (``--json``, ``--update`` to re-bank, nonzero
 exit on any violation); tests/test_hlolint.py gates a CPU subset in
 tier 1 against the committed bank under ``analysis/fingerprints/``.
@@ -64,6 +72,20 @@ HLO_RULES: Dict[str, str] = {
     "HX007": "ops-backend provenance: pallas custom-calls in an xla program, or a pallas twin indistinguishable from its base",
     "HX008": "quantization provenance: int8 dot/conv missing from a quantized program, or present anywhere else",
 }
+
+# shardlint rules the audit enforces live (the rest are bank-static and
+# run under `frcnn check`); merged into the audit's JSON rules payload
+AUDIT_SHARD_RULES: Dict[str, str] = {
+    "SL005": (
+        "static collective wire bytes exceed analysis.comm_budget_bytes "
+        "or drifted beyond tolerance vs the banked record"
+    ),
+}
+
+# relative tolerance for live-vs-banked comm wire bytes: the partitioned
+# half of the estimate wobbles with XLA's SPMD pass pipeline across
+# versions, but a real collective regression moves the total far more
+COMM_REL_TOL = 0.10
 
 # custom-call targets that witness a pallas lowering (Mosaic on TPU,
 # Triton on GPU) — matched as substrings of the call_target_name
@@ -107,6 +129,9 @@ class AuditResult:
     programs: Dict[str, Dict[str, Any]]
     bank_file: str
     updated: bool = False
+    # per-program comm-byte section: {program: {wire_bytes_per_device,
+    # basis, banked_wire_bytes_per_device}} — the SL005 evidence
+    comm: Dict[str, Dict[str, Any]] = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -114,11 +139,12 @@ class AuditResult:
 
     def to_dict(self) -> Dict[str, Any]:
         return {
-            "rules": HLO_RULES,
+            "rules": {**HLO_RULES, **AUDIT_SHARD_RULES},
             "violations": [v.to_dict() for v in self.violations],
             "programs": self.programs,
             "bank_file": self.bank_file,
             "updated": self.updated,
+            "comm": self.comm,
             "ok": self.ok,
         }
 
@@ -607,6 +633,59 @@ def check_drift(
     return out
 
 
+def check_comm(
+    fingerprints: Dict[str, Dict[str, Any]],
+    bank: Optional[Dict[str, Any]],
+    comm_budget_bytes: int,
+    comm_tol: float = COMM_REL_TOL,
+):
+    """SL005's live arm: every program's statically-priced collective
+    wire bytes must fit the absolute budget, and (when a banked comm
+    record exists — pass bank=None while re-banking) stay within
+    ``comm_tol`` of the bank. Returns (violations, per-program comm
+    summary). Records without a `comm` field (legacy banks passed in as
+    pre-collected fingerprints) skip the rule, mirroring HX007/HX008."""
+    banked_programs = (bank or {}).get("programs", {})
+    out: List[Violation] = []
+    summary: Dict[str, Dict[str, Any]] = {}
+    for name, fp in sorted(fingerprints.items()):
+        comm = fp.get("comm")
+        if comm is None:
+            continue
+        wire = int(comm.get("wire_bytes_per_device", 0) or 0)
+        bcomm = (banked_programs.get(name) or {}).get("comm") or {}
+        banked_wire = bcomm.get("wire_bytes_per_device")
+        summary[name] = {
+            "wire_bytes_per_device": wire,
+            "basis": comm.get("basis", "none"),
+            "banked_wire_bytes_per_device": banked_wire,
+        }
+        if wire > comm_budget_bytes:
+            out.append(
+                Violation(
+                    "SL005",
+                    name,
+                    f"static collective cost {wire / 2**20:.1f} MiB/device/"
+                    "step exceeds analysis.comm_budget_bytes "
+                    f"({comm_budget_bytes / 2**20:.1f} MiB)",
+                )
+            )
+        if banked_wire is not None:
+            d = fp_mod._rel_delta(float(wire), float(banked_wire))
+            if d > comm_tol:
+                out.append(
+                    Violation(
+                        "SL005",
+                        name,
+                        f"collective wire bytes drifted {d:+.1%} vs bank "
+                        f"(now {wire}, banked {int(banked_wire)}, tol "
+                        f"{comm_tol:.0%}) — the collective volume per "
+                        "step changed; re-bank if intended",
+                    )
+                )
+    return out, summary
+
+
 # -------------------------------------------------------------------- driver
 
 
@@ -655,15 +734,24 @@ def run_audit(
     bank_file = resolve_bank_file(config, fingerprint_dir, bank_name)
     platform = jax.default_backend()
     n_devices = len(jax.devices())
+    bank = fp_mod.load_bank(bank_file)
+    bank_matches = (
+        bank is not None
+        and bank.get("platform") == platform
+        and bank.get("n_devices") == n_devices
+    )
+    # SL005 live arm: absolute budget always; drift vs bank only when a
+    # matching bank exists and we are not about to overwrite it
+    comm_violations, comm_summary = check_comm(
+        fingerprints,
+        bank if (bank_matches and not update) else None,
+        config.analysis.comm_budget_bytes,
+    )
+    violations.extend(comm_violations)
     updated = False
     if update:
-        bank = fp_mod.load_bank(bank_file)
         banked_programs: Dict[str, Any] = {}
-        if (
-            bank is not None
-            and bank.get("platform") == platform
-            and bank.get("n_devices") == n_devices
-        ):
+        if bank_matches:
             banked_programs = dict(bank.get("programs", {}))
         banked_programs.update(fingerprints)
         fp_mod.save_bank(
@@ -695,7 +783,6 @@ def run_audit(
                 )
             )
     else:
-        bank = fp_mod.load_bank(bank_file)
         violations.extend(
             check_drift(
                 fingerprints, bank, bank_file, expected, platform, n_devices
@@ -706,4 +793,5 @@ def run_audit(
         programs=fingerprints,
         bank_file=bank_file,
         updated=updated,
+        comm=comm_summary,
     )

@@ -1435,25 +1435,38 @@ def cmd_trace_summary(args) -> int:
 def cmd_check(args) -> int:
     """Static lint gate over the package (or explicit paths): jaxlint's
     jit-hygiene rules JX001-JX007, threadlint's host-concurrency rules
-    TL001-TL006, and obslint's unified-metrics contract OB001, resolved
-    against the shared analysis/baseline.toml. Pure AST work — no jax
-    import, fast enough to gate every PR. Exits nonzero on any
-    unsuppressed finding or stale waiver; --rules narrows to a
-    comma-separated subset (an analyzer with no selected rule is
-    skipped entirely)."""
+    TL001-TL006, obslint's unified-metrics contract OB001, and
+    shardlint's sharding & collective-cost rules SL001-SL006 (over the
+    committed fingerprint bank — pass bank JSON paths to lint one
+    bank), resolved against the shared analysis/baseline.toml. No
+    lowering or compilation anywhere — fast enough to gate every PR.
+    Exits nonzero on any unsuppressed finding or stale waiver; --rules
+    narrows to a comma-separated subset (an analyzer with no selected
+    rule is skipped entirely)."""
     import json
 
-    from replication_faster_rcnn_tpu.analysis import jaxlint, obslint, threadlint
+    from replication_faster_rcnn_tpu.analysis import (
+        jaxlint,
+        obslint,
+        shardlint,
+        threadlint,
+    )
 
     analyzers = [
         ("jaxlint", jaxlint),
         ("threadlint", threadlint),
         ("obslint", obslint),
+        ("shardlint", shardlint),
     ]
     selected = None
     if getattr(args, "rules", None):
         selected = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
-        known = set(jaxlint.RULES) | set(threadlint.RULES) | set(obslint.RULES)
+        known = (
+            set(jaxlint.RULES)
+            | set(threadlint.RULES)
+            | set(obslint.RULES)
+            | set(shardlint.RULES)
+        )
         unknown = selected - known
         if unknown:
             print(
@@ -1542,10 +1555,12 @@ def cmd_audit(args) -> int:
     """HLO program auditor (analysis/hlolint.py): AOT-lower every
     registered (feed × K) train program + eval for the audited config,
     enforce the compiled-artifact contracts HX001-HX004 (donation
-    aliasing, dtype, collectives, memory budget), and compare against the
-    committed fingerprint bank (HX005/HX006). The third static gate next
-    to `frcnn check` (AST) and --strict (runtime); exits nonzero on any
-    contract violation or unexplained fingerprint drift."""
+    aliasing, dtype, collectives, memory budget), the SL005 comm-byte
+    budget (static wire-byte estimate vs analysis.comm_budget_bytes and
+    the banked value), and compare against the committed fingerprint
+    bank (HX005/HX006). The third static gate next to `frcnn check`
+    (AST + bank) and --strict (runtime); exits nonzero on any contract
+    violation or unexplained fingerprint drift."""
     import json
     import os
 
@@ -2035,15 +2050,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "check",
         help="static lint gate: jit-hygiene (jaxlint JX001-JX007) + "
              "host-concurrency contracts (threadlint TL001-TL006) + "
-             "unified-metrics contract (obslint OB001) against "
-             "the committed suppression baseline; exits nonzero on any "
-             "unsuppressed finding",
+             "unified-metrics contract (obslint OB001) + sharding/"
+             "collective-cost contracts over the fingerprint bank "
+             "(shardlint SL001-SL006) against the committed suppression "
+             "baseline; exits nonzero on any unsuppressed finding",
     )
     p_check.add_argument("paths", nargs="*",
                          help="files to lint (default: the whole package)")
     p_check.add_argument("--rules", default=None, metavar="R1,R2,...",
                          help="run/report only these rules (e.g. "
-                              "'TL001,TL004'; default: all JX + TL rules)")
+                              "'TL001,SL005'; default: all JX + TL + OB "
+                              "+ SL rules)")
     p_check.add_argument("--baseline", default=None, metavar="TOML",
                          help="suppression file (default: the committed "
                               "analysis/baseline.toml; pass /dev/null to "
@@ -2056,10 +2073,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     p_audit = sub.add_parser(
         "audit",
-        help="HLO program auditor (rules HX001-HX006): donation/dtype/"
-             "collective/memory contracts + fingerprint drift over the "
-             "compiled (feed x K) programs; third gate next to 'check' "
-             "and --strict",
+        help="HLO program auditor (rules HX001-HX006 + SL005 comm-byte "
+             "budget): donation/dtype/collective/memory contracts + "
+             "fingerprint drift over the compiled (feed x K) programs; "
+             "third gate next to 'check' and --strict",
     )
     p_audit.add_argument("--config", default="ci",
                          help="'ci' = the small audited-matrix config "

@@ -689,10 +689,21 @@ class AnalysisConfig:
     16 GiB HBM. ``fingerprint_dir`` overrides where `frcnn audit` reads
     and re-banks compiled-program fingerprints; empty string (default)
     uses the committed bank under the package's ``analysis/fingerprints``.
+
+    ``replicated_bytes_threshold`` is shardlint's SL001 floor: an arg
+    buffer at least this large, replicated over a >1 model axis despite a
+    divisible dim, is a finding (default 1 MiB — batch-norm vectors pass,
+    conv kernels and optimizer moments do not). ``comm_budget_bytes``
+    caps any one program's statically-priced collective wire bytes per
+    device per step (shardlint SL005 / `frcnn audit`); the default is
+    ~2x the largest banked CI program, so growth trips the gate before
+    it doubles a step's interconnect traffic.
     """
 
     hbm_budget_bytes: int = 16 << 30
     fingerprint_dir: str = ""
+    replicated_bytes_threshold: int = 1 << 20
+    comm_budget_bytes: int = 512 << 20
 
     def __post_init__(self):
         if not isinstance(self.hbm_budget_bytes, int) or self.hbm_budget_bytes <= 0:
@@ -704,6 +715,19 @@ class AnalysisConfig:
             raise ValueError(
                 "analysis.fingerprint_dir must be a string path, got "
                 f"{self.fingerprint_dir!r}"
+            )
+        if (
+            not isinstance(self.replicated_bytes_threshold, int)
+            or self.replicated_bytes_threshold <= 0
+        ):
+            raise ValueError(
+                "analysis.replicated_bytes_threshold must be a positive "
+                f"int, got {self.replicated_bytes_threshold!r}"
+            )
+        if not isinstance(self.comm_budget_bytes, int) or self.comm_budget_bytes <= 0:
+            raise ValueError(
+                "analysis.comm_budget_bytes must be a positive int, got "
+                f"{self.comm_budget_bytes!r}"
             )
 
 

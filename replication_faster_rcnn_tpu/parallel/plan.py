@@ -27,28 +27,61 @@ cell unit-testable in isolation (tests/test_plan.py).
 
 This module deliberately imports nothing from the config layer, so the
 config module stays jax-free (the elastic supervisor and `frcnn audit`
-rely on configuring XLA_FLAGS before jax loads).
+rely on configuring XLA_FLAGS before jax loads) — and it imports jax
+lazily, so the decision table and the sharding-intent declarations below
+are readable by the jax-free static gates (`frcnn check` runs shardlint
+over the fingerprint bank without initializing a backend).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import warnings
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
-import jax
 
-# jax >= 0.6 promotes shard_map to the top level and renames the
-# replication-check kwarg check_rep -> check_vma; 0.4.x only has the
-# experimental module. Resolve once at import so every Plan consumer
-# works on both.
-if hasattr(jax, "shard_map"):  # pragma: no cover - jax >= 0.6 only
-    _shard_map = jax.shard_map
-    _NO_CHECK = {"check_vma": False}
-else:
-    from jax.experimental.shard_map import shard_map as _shard_map
+def _resolve_shard_map():
+    """jax >= 0.6 promotes shard_map to the top level and renames the
+    replication-check kwarg check_rep -> check_vma; 0.4.x only has the
+    experimental module. Resolved lazily so importing this module (for
+    the decision table / intent declarations) needs no jax."""
+    import jax
 
-    _NO_CHECK = {"check_rep": False}
+    if hasattr(jax, "shard_map"):  # pragma: no cover - jax >= 0.6 only
+        return jax.shard_map, {"check_vma": False}
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map, {"check_rep": False}
+
+
+# ------------------------------------------------ declarative sharding intent
+#
+# What each train/serve feed DECLARES about the state tree's placement —
+# the single source shardlint (analysis/shardlint.py) audits the banked
+# program fingerprints against, and the prose the Plan docstrings tell.
+# Axes name the mesh axes a role's leaves shard over when a divisible dim
+# exists (`parallel/zero.py::shard_dim` / `compose_spec`); an empty tuple
+# means the role is replicated by design on that feed.
+
+# feeds whose optimizer state is ZeRO-1 sharded (train.shard_opt_state)
+ZERO_INTENT_FEEDS: Tuple[str, ...] = ("zero", "zero_lamb", "mp_zero")
+# feeds that shard parameters over the model axis (mesh.param_sharding)
+MP_INTENT_FEEDS: Tuple[str, ...] = ("mp", "mp_zero")
+
+FEED_STATE_INTENT: Dict[str, Dict[str, Tuple[str, ...]]] = {
+    "loader": {"params": (), "opt_state": ()},
+    "cached": {"params": (), "opt_state": ()},
+    "spmd": {"params": (), "opt_state": ()},
+    "zero": {"params": (), "opt_state": ("data",)},
+    "zero_lamb": {"params": (), "opt_state": ("data",)},
+    "mp": {"params": ("model",), "opt_state": ()},
+    "mp_zero": {"params": ("model",), "opt_state": ("model", "data")},
+    "eval": {"params": (), "opt_state": ()},
+    # serving under an mp mesh routes params through zero.param_shardings
+    # (train/warmup.py::build_serving_specs); on a 1-device/dp-only
+    # serving mesh the engine keeps them replicated
+    "serve": {"params": ("model",), "opt_state": ()},
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -120,13 +153,16 @@ def compile_step_with_plan(step_fn: Callable, plan: Plan):
             raise ValueError(
                 "a shard_map plan needs both in_specs and out_specs"
             )
-        step_fn = _shard_map(
+        shard_map_fn, no_check = _resolve_shard_map()
+        step_fn = shard_map_fn(
             step_fn,
             mesh=plan.mesh,
             in_specs=plan.in_specs,
             out_specs=plan.out_specs,
-            **_NO_CHECK,
+            **no_check,
         )
+    import jax
+
     kwargs = {}
     if plan.donate_argnums:
         kwargs["donate_argnums"] = plan.donate_argnums
@@ -174,10 +210,13 @@ class PlanContext:
         n_devices: Optional[int] = None,
         process_count: Optional[int] = None,
     ) -> "PlanContext":
-        if n_devices is None:
-            n_devices = len(jax.devices())
-        if process_count is None:
-            process_count = jax.process_count()
+        if n_devices is None or process_count is None:
+            import jax
+
+            if n_devices is None:
+                n_devices = len(jax.devices())
+            if process_count is None:
+                process_count = jax.process_count()
         return cls(
             backend=config.train.backend,
             optimizer=config.train.optimizer,

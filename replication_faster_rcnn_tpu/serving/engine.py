@@ -95,6 +95,21 @@ def _plain_dicts(tree: Any) -> Any:
     return tree
 
 
+def _batch_target(variables: Any):
+    """Placement for a flush batch next to ``variables``: replicated over
+    the resident tree's mesh when the mp serving layout sharded it (a
+    plain single-device put would put the batch on a device set disjoint
+    from the params), else None (default device)."""
+    for leaf in jax.tree_util.tree_leaves(variables):
+        sharding = getattr(leaf, "sharding", None)
+        mesh = getattr(sharding, "mesh", None)
+        if mesh is not None and getattr(sharding, "num_devices", 1) > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return NamedSharding(mesh, PartitionSpec())
+    return None
+
+
 def select_bucket(
     resolutions: Sequence[Tuple[int, int]],
     orig_h: int,
@@ -474,6 +489,20 @@ class InferenceEngine:
             else np.asarray(leaf).astype(a.dtype)
             for leaf, a in zip(leaves, abs_leaves)
         ]
+        shardings = [getattr(a, "sharding", None) for a in abs_leaves]
+        if any(s is not None for s in shardings):
+            # the mp serving layout: each leaf goes to the NamedSharding
+            # build_serving_specs banked on its abstract twin (params
+            # split over the model axis, batch_stats replicated)
+            return jax.tree_util.tree_unflatten(
+                abs_treedef,
+                [
+                    jax.device_put(leaf, s)
+                    if s is not None
+                    else jax.device_put(leaf)
+                    for leaf, s in zip(cast, shardings)
+                ],
+            )
         return jax.device_put(
             jax.tree_util.tree_unflatten(abs_treedef, cast)
         )
@@ -720,7 +749,9 @@ class InferenceEngine:
             "serve/flush", cat="serve", program=name, n=n, padded=bn - n
         ):
             with self._strict_dispatch(name):
-                out = program(variables, jax.device_put(batch))
+                out = program(
+                    variables, jax.device_put(batch, _batch_target(variables))
+                )
             out = jax.device_get(out)
         flush_s = time.perf_counter() - t_wall
         dur_dispatch = flush_s * 1e6

@@ -268,6 +268,16 @@ def build_serving_specs(
     params in. Routing serving through this registry is what lets the
     persistent compile cache pre-warm `frcnn serve` and `frcnn audit`
     enforce HX001-HX006 on the serving programs.
+
+    Under ``mesh.param_sharding`` with ``num_model > 1`` (``--mesh-shape
+    DP,MP``) the abstract params carry `zero.param_shardings` layouts on
+    a (1, num_model) serving mesh instead of the implicit single-device
+    replication: serving holds ONE model replica, so a model too large
+    for one chip's weights stays servable, and the engine's resident
+    upload (`serving/engine.py::_build_resident`) places each leaf on
+    the sharding banked here. Non-param collections (batch_stats) stay
+    replicated. The audited 'ci' matrix runs num_model=1, so the banked
+    serve fingerprints are untouched by this path.
     """
     from replication_faster_rcnn_tpu.eval.evaluator import make_infer_fn
     from replication_faster_rcnn_tpu.models.faster_rcnn import FasterRCNN
@@ -287,6 +297,9 @@ def build_serving_specs(
         ),
         variables_abs,
     )
+    mesh_meta: Optional[Dict[str, int]] = None
+    if config.mesh.param_sharding and max(1, config.mesh.num_model) > 1:
+        variables_abs, mesh_meta = _mp_serving_variables(config, variables_abs)
 
     specs: Dict[str, ProgramSpec] = {}
     for h, w in config.serving.bucket_resolutions(config.data.image_size):
@@ -318,9 +331,58 @@ def build_serving_specs(
                     "bucket": [h, w],
                     "batch": n,
                     "params_dtype": config.serving.params_dtype,
+                    **(
+                        {"mesh_shape": mesh_meta, "param_sharding": True}
+                        if mesh_meta
+                        else {}
+                    ),
                 },
             )
     return specs
+
+
+def _mp_serving_variables(config: FasterRCNNConfig, variables_abs):
+    """Attach the model-parallel serving layout to the abstract variables:
+    params get `zero.param_shardings` over a (1, num_model) mesh, every
+    other collection a replicated NamedSharding on the same mesh. Returns
+    ``(sharded_variables_abs, mesh_shape_meta)``."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    from replication_faster_rcnn_tpu.parallel import zero
+
+    n_model = config.mesh.num_model
+    devices = jax.devices()
+    if len(devices) < n_model:
+        raise ValueError(
+            f"mesh.param_sharding serving needs num_model={n_model} "
+            f"devices; only {len(devices)} visible"
+        )
+    grid = np.asarray(devices[:n_model]).reshape(1, n_model)
+    mesh = Mesh(grid, (config.mesh.data_axis, config.mesh.model_axis))
+    replicated = NamedSharding(mesh, PartitionSpec())
+    params_sh = zero.param_shardings(
+        variables_abs["params"], mesh, config.mesh
+    )
+    colls = {
+        coll: (
+            jax.tree_util.tree_map(
+                lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+                variables_abs[coll],
+                params_sh,
+            )
+            if coll == "params"
+            else jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=replicated
+                ),
+                variables_abs[coll],
+            )
+        )
+        for coll in variables_abs
+    }
+    if not isinstance(variables_abs, dict):
+        colls = type(variables_abs)(colls)
+    mesh_meta = {config.mesh.data_axis: 1, config.mesh.model_axis: n_model}
+    return colls, mesh_meta
 
 
 INT8_TWIN_SUFFIX = "__int8"

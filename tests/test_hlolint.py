@@ -624,7 +624,10 @@ class TestAuditGate:
         payload = json.loads(capsys.readouterr().out)
         assert rc == 0
         assert payload["ok"] is True
-        assert sorted(payload["rules"]) == sorted(hlolint.HLO_RULES)
+        assert sorted(payload["rules"]) == sorted(
+            {**hlolint.HLO_RULES, **hlolint.AUDIT_SHARD_RULES}
+        )
+        assert "comm" in payload
 
     def test_seeded_contract_violation_exits_nonzero(
         self, capsys, monkeypatch, collected

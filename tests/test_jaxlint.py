@@ -249,17 +249,20 @@ class TestCheckCLI:
         assert rc == 0
         payload = json.loads(capsys.readouterr().out)
         assert payload["ok"] is True
-        # check runs jaxlint + threadlint + obslint; every rule of each
-        # must be present
+        # check runs jaxlint + threadlint + obslint + shardlint; every
+        # rule of each must be present
         from replication_faster_rcnn_tpu.analysis.obslint import (
             RULES as OB_RULES,
+        )
+        from replication_faster_rcnn_tpu.analysis.shardlint import (
+            RULES as SL_RULES,
         )
         from replication_faster_rcnn_tpu.analysis.threadlint import (
             RULES as TL_RULES,
         )
 
         assert sorted(payload["rules"]) == sorted(
-            [*RULES, *TL_RULES, *OB_RULES]
+            [*RULES, *TL_RULES, *OB_RULES, *SL_RULES]
         )
         assert payload["findings"] == []
 

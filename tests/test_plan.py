@@ -71,9 +71,10 @@ class TestPlanModes:
             donate_argnums=(0,),
         )
         ours = compile_step_with_plan(fn, p).lower(x).as_text()
-        hand = plan_mod._shard_map(
+        shard_map_fn, no_check = plan_mod._resolve_shard_map()
+        hand = shard_map_fn(
             fn, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
-            **plan_mod._NO_CHECK,
+            **no_check,
         )
         theirs = jax.jit(hand, donate_argnums=(0,)).lower(x).as_text()
         assert ours == theirs

@@ -1,0 +1,593 @@
+"""shardlint sharding & collective-cost analyzer: per-rule fixtures,
+sharding-repr parsing, zero.py layout parity, waiver scoping, the
+package-wide gate over the committed fingerprint bank, the commcost
+static price model, and the SL005 comm-budget arm of `frcnn audit`
+(ISSUE 20 tentpole).
+
+Mirrors the jaxlint/threadlint suite structure: every rule SL001-SL006
+is proven by a positive fixture bank that must produce exactly that rule
+and a negative fixture exercising the same shape that must stay clean.
+The package gate asserts the committed baseline keeps every banked
+program at zero unwaived findings and zero stale waivers.
+"""
+
+import copy
+import json
+import os
+import pathlib
+
+import pytest
+
+from replication_faster_rcnn_tpu.analysis import commcost
+from replication_faster_rcnn_tpu.analysis import fingerprint as fp_mod
+from replication_faster_rcnn_tpu.analysis import hlolint, shardlint
+from replication_faster_rcnn_tpu.analysis.jaxlint import (
+    load_baseline,
+    package_root,
+)
+from replication_faster_rcnn_tpu.analysis.shardlint import (
+    RULES,
+    compose_spec_dims,
+    lint_package,
+    lint_paths,
+    parse_sharding,
+    shard_dim,
+)
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "shardlint"
+ALL_RULES = sorted(RULES)
+BANK = os.path.join(
+    package_root(), "analysis", "fingerprints", "ci_cpu.json"
+)
+
+
+def _lint(name, baseline=None, **kw):
+    return lint_paths([str(FIXTURES / name)], baseline=baseline, **kw)
+
+
+# ------------------------------------------------------------- fixtures
+
+
+class TestRuleFixtures:
+    def test_every_rule_has_fixture_pair(self):
+        for rule in ALL_RULES:
+            stem = rule.lower()
+            assert (FIXTURES / f"{stem}_pos.json").exists(), rule
+            assert (FIXTURES / f"{stem}_neg.json").exists(), rule
+
+    @pytest.mark.parametrize("rule", ALL_RULES)
+    def test_positive_fixture_flags_only_its_rule(self, rule):
+        result = _lint(f"{rule.lower()}_pos.json")
+        assert result.findings, f"{rule} positive fixture fired nothing"
+        assert {f.rule for f in result.findings} == {rule}, (
+            f"{rule} positive fixture: {[str(f) for f in result.findings]}"
+        )
+        # findings address programs: func is the banked program name
+        with open(FIXTURES / f"{rule.lower()}_pos.json") as f:
+            programs = set(json.load(f)["programs"])
+        assert {f.func for f in result.findings} <= programs
+
+    @pytest.mark.parametrize("rule", ALL_RULES)
+    def test_negative_fixture_is_clean(self, rule):
+        result = _lint(f"{rule.lower()}_neg.json")
+        assert result.findings == [], (
+            f"{rule} negative fixture: {[str(f) for f in result.findings]}"
+        )
+
+    def test_non_bank_json_is_skipped(self, tmp_path):
+        other = tmp_path / "not_a_bank.json"
+        other.write_text('{"schema": "something_else", "programs": {}}')
+        result = lint_paths([str(other)])
+        assert result.findings == []
+
+
+# ------------------------------------------------- parsing + layout math
+
+
+class TestShardingParsing:
+    def test_parse_banked_repr(self):
+        v = parse_sharding(
+            "NamedSharding(mesh=Mesh('data': 2, 'model': 4), "
+            "spec=PartitionSpec(None, 'data'), memory_kind=unpinned_host)"
+        )
+        assert v is not None
+        assert dict(v.mesh) == {"data": 2, "model": 4}
+        assert v.spec == (None, ("data",))
+        assert v.axes_used == frozenset({"data"})
+        assert v.spec_str() == "P(None, 'data')"
+
+    def test_parse_tuple_entry_and_trim(self):
+        v = parse_sharding(
+            "NamedSharding(mesh=Mesh('data': 2, 'model': 4), "
+            "spec=PartitionSpec(('data', 'model'), None), "
+            "memory_kind=device)"
+        )
+        assert v.spec == (("data", "model"),)
+        assert v.axes_used == frozenset({"data", "model"})
+
+    def test_unparseable_returns_none(self):
+        assert parse_sharding(None) is None
+        assert parse_sharding("SingleDeviceSharding(device=CPU:0)") is None
+        assert parse_sharding("NamedSharding(garbage)") is None
+
+
+class TestZeroLayoutParity:
+    """shardlint recomputes the ZeRO layout with a pure reimplementation
+    of parallel/zero.py — any divergence silently blinds SL006."""
+
+    SHAPES = [
+        (),
+        (1,),
+        (21,),
+        (84,),
+        (512, 21),
+        (512, 512),
+        (3, 3, 64, 64),
+        (7, 6),
+        (2, 8),
+        (8, 2),
+        (64,),
+    ]
+
+    @pytest.mark.parametrize("n", [1, 2, 4, 8])
+    def test_shard_dim_matches_zero(self, n):
+        from replication_faster_rcnn_tpu.parallel import zero
+
+        for shape in self.SHAPES:
+            assert shard_dim(shape, n) == zero.shard_dim(shape, n), (
+                shape,
+                n,
+            )
+
+    @pytest.mark.parametrize("n_data,n_model", [(2, 1), (2, 4), (8, 1), (1, 4)])
+    def test_compose_spec_matches_zero(self, n_data, n_model):
+        from replication_faster_rcnn_tpu.parallel import zero
+
+        for shape in self.SHAPES:
+            spec = tuple(
+                zero.compose_spec(shape, n_data, n_model, "data", "model")
+            )
+            while spec and spec[-1] is None:
+                spec = spec[:-1]
+            assert compose_spec_dims(shape, n_data, n_model) == spec, (
+                shape,
+                n_data,
+                n_model,
+            )
+
+
+class TestPlanIntentTables:
+    """The declarative feed-intent tables shardlint keys on must stay
+    consistent with each other and with the Plan feed registry."""
+
+    def test_zero_and_mp_sets_derive_from_state_intent(self):
+        from replication_faster_rcnn_tpu.parallel.plan import (
+            FEED_STATE_INTENT,
+            MP_INTENT_FEEDS,
+            ZERO_INTENT_FEEDS,
+        )
+
+        zero_feeds = {
+            feed
+            for feed, intent in FEED_STATE_INTENT.items()
+            if "data" in intent["opt_state"]
+        }
+        mp_feeds = {
+            feed
+            for feed, intent in FEED_STATE_INTENT.items()
+            if "model" in intent["params"]
+        }
+        assert set(ZERO_INTENT_FEEDS) == zero_feeds
+        assert set(MP_INTENT_FEEDS) <= mp_feeds  # serve mp-shards too
+
+    def test_intent_covers_banked_feeds(self):
+        from replication_faster_rcnn_tpu.parallel.plan import (
+            FEED_STATE_INTENT,
+        )
+
+        bank = fp_mod.load_bank(BANK)
+        assert bank is not None
+        feeds = {rec.get("feed") for rec in bank["programs"].values()}
+        assert feeds <= set(FEED_STATE_INTENT)
+
+
+# ------------------------------------------------------- waiver scoping
+
+
+def _waiver_toml(tmp_path, finding, func=None):
+    toml = tmp_path / "baseline.toml"
+    toml.write_text(
+        "[[waiver]]\n"
+        f'rule = "{finding.rule}"\n'
+        f'path = "{finding.path}"\n'
+        f'func = "{func or finding.func}"\n'
+        'reason = "fixture waiver"\n'
+    )
+    return str(toml)
+
+
+class TestWaivers:
+    def test_waiver_round_trip(self, tmp_path):
+        raw = _lint("sl001_pos.json")
+        assert raw.findings, "fixture must fire"
+        f = raw.findings[0]
+        waived = _lint(
+            "sl001_pos.json", baseline=_waiver_toml(tmp_path, f)
+        )
+        assert waived.findings == []
+        assert waived.stale_waivers == []
+        assert [(g.rule, reason) for g, reason in waived.suppressed] == [
+            (f.rule, "fixture waiver")
+        ]
+
+    def test_glob_waiver_addresses_program_family(self, tmp_path):
+        raw = _lint("sl001_pos.json")
+        f = raw.findings[0]
+        assert f.func == "train_mp_k1"
+        waived = _lint(
+            "sl001_pos.json",
+            baseline=_waiver_toml(tmp_path, f, func="train_mp_k*"),
+        )
+        assert waived.findings == [] and waived.stale_waivers == []
+
+    def test_stale_sl_waiver_reported(self, tmp_path):
+        raw = _lint("sl001_pos.json")
+        f = raw.findings[0]
+        result = _lint(
+            "sl001_neg.json", baseline=_waiver_toml(tmp_path, f)
+        )
+        assert result.findings == []
+        assert [w.rule for w in result.stale_waivers] == ["SL001"]
+
+    def test_foreign_rule_waivers_invisible(self, tmp_path):
+        """Baseline.restricted: jaxlint/threadlint entries in the shared
+        baseline never show up as stale here (and vice versa)."""
+        toml = tmp_path / "baseline.toml"
+        toml.write_text(
+            "[[waiver]]\n"
+            'rule = "JX001"\n'
+            'path = "replication_faster_rcnn_tpu/cli.py"\n'
+            'func = "*"\n'
+            'reason = "not ours"\n'
+        )
+        result = _lint("sl001_neg.json", baseline=str(toml))
+        assert result.stale_waivers == []
+
+    def test_sl_waivers_invisible_to_jaxlint(self, tmp_path):
+        from replication_faster_rcnn_tpu.analysis import jaxlint
+
+        raw = _lint("sl001_pos.json")
+        toml = _waiver_toml(tmp_path, raw.findings[0])
+        clean = tmp_path / "clean.py"
+        clean.write_text("X = 1\n")
+        result = jaxlint.lint_paths([str(clean)], baseline=toml)
+        assert result.stale_waivers == []
+
+
+# ----------------------------------------------------- the package gate
+
+
+class TestPackageGate:
+    def test_package_lints_clean_against_committed_baseline(self):
+        result = lint_package()
+        stale = [
+            f"stale: {w.rule} {w.path} [{w.func}]"
+            for w in result.stale_waivers
+        ]
+        assert result.findings == [] and result.stale_waivers == [], (
+            [str(f) for f in result.findings] + stale
+        )
+
+    def test_raw_findings_all_waived_with_reasons(self):
+        """Every raw finding must be covered by the committed baseline —
+        with a non-empty reason."""
+        raw = lint_package(baseline=None)
+        base = load_baseline(
+            os.path.join(package_root(), "analysis", "baseline.toml")
+        ).restricted(RULES)
+        for f in raw.findings:
+            w = shardlint._waive(base, f)
+            assert w is not None, f"unwaived: {f}"
+            assert w.reason.strip(), f"empty reason: {f}"
+
+    def test_bank_has_comm_and_out_shardings(self):
+        """ISSUE 20's one-time additive re-bank: every banked program
+        carries the comm record and partitioned_collectives; train/eval
+        programs carry out_shardings."""
+        bank = fp_mod.load_bank(BANK)
+        assert bank is not None
+        for name, rec in bank["programs"].items():
+            assert "comm" in rec, name
+            assert "partitioned_collectives" in rec, name
+            assert rec["comm"]["basis"] in (
+                "lowered",
+                "partitioned",
+                "none",
+            ), name
+            total = commcost.recompute_wire_total(rec["comm"])
+            assert total is not None, name
+            wire = rec["comm"]["wire_bytes_per_device"]
+            assert abs(total - wire) <= 0.01 * max(wire, 1), name
+
+
+# ------------------------------------------------------------- the CLI
+
+
+class TestCheckCli:
+    def test_seeded_violation_exits_nonzero_naming_rule_and_program(
+        self, capsys
+    ):
+        from replication_faster_rcnn_tpu import cli
+
+        rc = cli.main(
+            [
+                "check",
+                "--rules",
+                "SL006",
+                "--baseline",
+                "/dev/null",
+                str(FIXTURES / "sl006_pos.json"),
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "SL006" in out and "train_zero_k1" in out
+
+    def test_clean_fixture_exits_zero(self, capsys):
+        from replication_faster_rcnn_tpu import cli
+
+        rc = cli.main(
+            [
+                "check",
+                "--rules",
+                "SL001",
+                "--baseline",
+                "/dev/null",
+                str(FIXTURES / "sl001_neg.json"),
+            ]
+        )
+        assert rc == 0
+        assert "shardlint" in capsys.readouterr().out
+
+    def test_unknown_rule_exits_two(self, capsys):
+        from replication_faster_rcnn_tpu import cli
+
+        rc = cli.main(["check", "--rules", "SL999"])
+        assert rc == 2
+        assert "unknown rule" in capsys.readouterr().err
+
+    def test_json_payload_has_sl_rules(self, capsys):
+        from replication_faster_rcnn_tpu import cli
+
+        rc = cli.main(
+            [
+                "check",
+                "--rules",
+                ",".join(ALL_RULES),
+                "--json",
+                "--baseline",
+                "/dev/null",
+                str(FIXTURES / "sl001_neg.json"),
+            ]
+        )
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert sorted(payload["rules"]) == ALL_RULES
+        assert payload["ok"] is True
+
+
+# --------------------------------------------------- commcost price model
+
+
+LOWERED_SNIPPET = """
+  %0 = "stablehlo.all_reduce"(%arg0) <{replica_groups = dense<> :
+    tensor<0x2xi64>}> ({ body }) : (tensor<512x21xbf16>) ->
+    tensor<512x21xbf16>
+  %1 = "stablehlo.reduce_scatter"(%arg1) <{scatter_dimension = 0 : i64}>
+    ({ body }) : (tensor<8x4xf32>) -> tensor<4x4xf32>
+  %2 = "stablehlo.all_gather"(%arg2) <{all_gather_dim = 0 : i64}> :
+    (tensor<4x4xf32>) -> tensor<8x4xf32>
+"""
+
+HLO_SNIPPET = (
+    "  %ar = f32[512,21]{1,0} all-reduce(f32[512,21]{1,0} %p0), "
+    "replica_groups={{0,4},{1,5},{2,6},{3,7}}, to_apply=%add\n"
+    "  %ag = f32[8,4]{1,0} all-gather(f32[4,4]{1,0} %p1), "
+    "replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}\n"
+    "  %rs = f32[4,4]{1,0} reduce-scatter(f32[8,4]{1,0} %p2), "
+    "replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}, "
+    "to_apply=%add\n"
+)
+
+
+class TestCommCost:
+    def test_tensor_type_bytes(self):
+        assert commcost.tensor_type_bytes("512x21xbf16") == 21504
+        assert commcost.tensor_type_bytes("f32") == 4
+        assert commcost.tensor_type_bytes("2x3xpred") == 6
+        assert commcost.dtype_bytes("s8") == 1
+
+    def test_lowered_ring_factors(self):
+        inv = commcost.lowered_comm(
+            LOWERED_SNIPPET, {"data": 2, "model": 1}
+        )
+        # all_reduce: 2(n-1)/n x full = 1.0 x 21504
+        assert inv["all_reduce"]["wire_bytes"] == 21504
+        # reduce_scatter: (n-1)/n x full = 0.5 x 128
+        assert inv["reduce_scatter"]["wire_bytes"] == 64
+        # all_gather: (n-1) x shard = 1 x 64
+        assert inv["all_gather"]["wire_bytes"] == 64
+
+    def test_lowered_single_device_mesh_is_free(self):
+        inv = commcost.lowered_comm(LOWERED_SNIPPET, {"data": 1})
+        assert all(e["wire_bytes"] == 0 for e in inv.values())
+
+    def test_partitioned_axis_classification(self):
+        mesh = {"data": 2, "model": 4}
+        inv = commcost.partitioned_comm(HLO_SNIPPET, mesh)
+        # strided groups {0,4}{1,5}... -> the 2-way data axis
+        assert inv["all-reduce"]["axes"] == {
+            "data": {"ops": 1, "result_bytes": 43008, "wire_bytes": 43008}
+        }
+        # consecutive runs {0,1,2,3} -> the 4-way model axis;
+        # all-gather result is FULL: (n-1)/n x 128 = 96
+        assert inv["all-gather"]["axes"]["model"]["wire_bytes"] == 96
+        # reduce-scatter result is the SHARD: (n-1) x 64 = 192
+        assert inv["reduce-scatter"]["axes"]["model"]["wire_bytes"] == 192
+
+    def test_collect_comm_prefers_lowered_basis(self):
+        comm = commcost.collect_comm(
+            LOWERED_SNIPPET, HLO_SNIPPET, {"data": 2, "model": 1}
+        )
+        assert comm["basis"] == "lowered"
+        assert comm["wire_bytes_per_device"] == 21504 + 64 + 64
+        assert commcost.recompute_wire_total(comm) == (
+            comm["wire_bytes_per_device"]
+        )
+
+    def test_collect_comm_falls_back_to_partitioned(self):
+        comm = commcost.collect_comm(
+            "no collectives here", HLO_SNIPPET, {"data": 2, "model": 4}
+        )
+        assert comm["basis"] == "partitioned"
+        assert comm["wire_bytes_per_device"] > 0
+
+    def test_recompute_malformed_returns_none(self):
+        assert commcost.recompute_wire_total({"basis": "lowered"}) is None
+
+    def test_banked_zero_k1_matches_hand_model(self):
+        """Satellite pin: the banked train_zero_k1 comm estimate must
+        match the ZeRO-1 ring volume computed by hand from the program's
+        own state shapes — reduce-scatter of the bf16 grads over the
+        divisible param leaves and f32 all-gather of the updated param
+        shards, each within 1%. The all_reduce arm additionally carries
+        loss metrics + batch-stats sync the shape walk can't enumerate,
+        so it is pinned to the ring identity over its banked operand
+        bytes with the indivisible-grad volume contained in it."""
+        bank = fp_mod.load_bank(BANK)
+        assert bank is not None
+        rec = bank["programs"]["train_zero_k1"]
+        comm = rec["comm"]
+        assert comm["basis"] == "lowered"
+        n = 2  # the audited mesh's data axis
+        rs_full = ar_grads = ag_shard = 0
+        divisible = 0
+        for leaf in rec["args"]["state"]:
+            if not leaf["path"].startswith(".params"):
+                continue
+            elems = 1
+            for s in leaf["shape"]:
+                elems *= s
+            if shard_dim(leaf["shape"], n) >= 0:
+                divisible += 1
+                rs_full += elems * 2  # grads reduce-scatter in bf16
+                ag_shard += elems // n * 4  # updated f32 params gather
+            else:
+                ar_grads += elems * 2  # indivisible grads all-reduce
+        lowered = comm["lowered"]
+        # one rs/ag pair per divisible param leaf, nothing else
+        assert lowered["reduce_scatter"]["ops"] == divisible
+        assert lowered["all_gather"]["ops"] == divisible
+        for kind, want in (
+            ("reduce_scatter", (n - 1) / n * rs_full),
+            ("all_gather", (n - 1) * ag_shard),
+        ):
+            got = lowered[kind]["wire_bytes"]
+            assert abs(got - want) <= 0.01 * want, (kind, got, want)
+        ar = lowered["all_reduce"]
+        assert ar["wire_bytes"] == round(
+            2 * (n - 1) / n * ar["operand_bytes"]
+        )
+        # the indivisible grads ride inside the all_reduce arm, which is
+        # small next to the param ring (metrics + batch-stats sync only)
+        assert ar_grads <= ar["operand_bytes"] <= 0.01 * rs_full
+        total = sum(k["wire_bytes"] for k in lowered.values())
+        assert comm["wire_bytes_per_device"] == total
+
+
+# ------------------------------------------------- the audit's SL005 arm
+
+
+class TestAuditCommArm:
+    @pytest.fixture()
+    def banked(self):
+        bank = fp_mod.load_bank(BANK)
+        assert bank is not None
+        names = ["train_zero_k1", "train_spmd_k1"]
+        return {n: copy.deepcopy(bank["programs"][n]) for n in names}
+
+    def _run(self, monkeypatch, capsys, fingerprints):
+        from replication_faster_rcnn_tpu import cli
+
+        monkeypatch.setattr(
+            hlolint, "collect_fingerprints", lambda *a, **k: fingerprints
+        )
+        rc = cli.main(
+            [
+                "audit",
+                "--device",
+                "cpu",
+                "--programs",
+                ",".join(fingerprints),
+            ]
+        )
+        return rc, capsys.readouterr().out
+
+    def test_banked_records_pass(self, monkeypatch, capsys, banked):
+        rc, out = self._run(monkeypatch, capsys, banked)
+        assert rc == 0, out
+
+    def test_budget_violation_exits_nonzero(
+        self, monkeypatch, capsys, banked
+    ):
+        doctored = copy.deepcopy(banked)
+        comm = doctored["train_zero_k1"]["comm"]
+        big = 600 << 20
+        comm["wire_bytes_per_device"] = big
+        comm["lowered"] = {
+            "all_reduce": {
+                "ops": 1,
+                "operand_bytes": big,
+                "wire_bytes": big,
+            }
+        }
+        rc, out = self._run(monkeypatch, capsys, doctored)
+        assert rc == 1
+        assert "SL005" in out and "train_zero_k1" in out
+
+    def test_drift_vs_bank_exits_nonzero(self, monkeypatch, capsys, banked):
+        doctored = copy.deepcopy(banked)
+        comm = doctored["train_spmd_k1"]["comm"]
+        basis = comm["basis"]
+        for entry in comm[basis].values():
+            entry["wire_bytes"] = int(entry["wire_bytes"] * 1.5)
+        comm["wire_bytes_per_device"] = int(
+            comm["wire_bytes_per_device"] * 1.5
+        )
+        rc, out = self._run(monkeypatch, capsys, doctored)
+        assert rc == 1
+        assert "SL005" in out and "train_spmd_k1" in out
+
+    def test_audit_json_has_comm_section(self, monkeypatch, capsys, banked):
+        from replication_faster_rcnn_tpu import cli
+
+        monkeypatch.setattr(
+            hlolint, "collect_fingerprints", lambda *a, **k: banked
+        )
+        rc = cli.main(
+            [
+                "audit",
+                "--device",
+                "cpu",
+                "--json",
+                "--programs",
+                ",".join(banked),
+            ]
+        )
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert set(payload["comm"]) == set(banked)
+        for entry in payload["comm"].values():
+            assert "wire_bytes_per_device" in entry
+            assert "basis" in entry
+        assert "SL005" in payload["rules"]
